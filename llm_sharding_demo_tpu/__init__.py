@@ -1,0 +1,23 @@
+"""TPU-native pipeline-sharded LLM inference/training framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of
+``kanchan-rihan/llm-sharding-demo`` (reference: ``/root/reference/server.py``):
+GPT-2 partitioned at transformer-block boundaries into pipeline stages, a
+token-generation loop, and an HTTP ``/generate`` front end — redesigned
+TPU-first:
+
+- the model is a pure function over a parameter pytree (``models.gpt2``),
+  blocks stacked on a leading layer axis so a single compiled ``lax.scan``
+  covers all layers (instead of a Python loop of torch modules,
+  reference server.py:84-85);
+- stage-to-stage hidden-state handoff is an on-device ICI transfer
+  (``parallel.pipeline``) instead of JSON-over-HTTP through a coordinator
+  (reference server.py:172-181);
+- decoding is a jitted on-device loop with a KV cache (``runtime.engine``)
+  instead of an O(n^2) full re-forward per token (reference server.py:169);
+- the FastAPI surface (``serving.app``) keeps the reference's routes and
+  schemas (/generate, /forward, /forward_b — reference server.py:116-124)
+  for wire-level compatibility.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,240 @@
+"""GPT-2 as pure JAX functions over a parameter pytree.
+
+TPU-native re-design of the model layer the reference gets from HuggingFace
+(``AutoModelForCausalLM.from_pretrained`` at reference server.py:41, torch
+modules ``wte/wpe/drop/h/ln_f/lm_head`` wired into two shards at
+server.py:56-60). Differences by design, not translation:
+
+- Parameters are a plain pytree (nested dicts of ``jnp`` arrays). All
+  transformer blocks are *stacked on a leading layer axis*, so applying a
+  stage's blocks is one ``lax.scan`` — a single compiled loop body reused
+  across layers — instead of the reference's Python ``for block in
+  self.blocks`` (server.py:84-85, 99-100).
+- The LM head is weight-tied to ``wte`` (as in GPT-2 proper): logits are
+  ``h @ wte.T``. No separate lm_head tensor exists, which also fixes the
+  reference quirk of every role holding full weights (server.py:108-110).
+- Kernels use the ``[in, out]`` layout matching HF ``Conv1D`` storage so the
+  checkpoint converter (``models.hf_convert``) is copy-only.
+- Everything is shape-static and jit-friendly; positions derive from an
+  integer offset rather than re-materialized ``arange(0, seq_len)`` per call
+  (the reference recomputes positions from zero every token,
+  server.py:80, because it has no cache).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import (KVCache, cached_attention, causal_attention,
+                             merge_heads, split_heads)
+from ..ops.layers import gelu_new, layer_norm, linear
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    """Architecture hyperparameters (mirrors HF ``GPT2Config`` fields we use)."""
+
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    layer_norm_epsilon: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    def __post_init__(self):
+        if self.n_embd % self.n_head != 0:
+            raise ValueError(
+                f"n_embd={self.n_embd} not divisible by n_head={self.n_head}")
+
+
+# Named configs for the BASELINE.json measurement matrix. "tiny-gpt2" matches
+# sshleifer/tiny-gpt2 (the reference's default MODEL_ID, server.py:20);
+# "gpt2" is GPT-2 124M; "gpt2-medium" the 355M config (4-stage target).
+CONFIGS: Dict[str, GPT2Config] = {
+    "tiny-gpt2": GPT2Config(vocab_size=50257, n_positions=1024, n_embd=2,
+                            n_layer=2, n_head=2),
+    "gpt2": GPT2Config(vocab_size=50257, n_positions=1024, n_embd=768,
+                       n_layer=12, n_head=12),
+    "gpt2-medium": GPT2Config(vocab_size=50257, n_positions=1024, n_embd=1024,
+                              n_layer=24, n_head=16),
+}
+
+
+def init_params(config: GPT2Config, key: jax.Array,
+                dtype=jnp.float32) -> Params:
+    """Random-init parameters (normal(0.02) weights, zero biases, unit LN).
+
+    Block tensors carry a leading ``n_layer`` axis (see module docstring).
+    """
+    k_wte, k_wpe, k_blocks = jax.random.split(key, 3)
+    d, l = config.n_embd, config.n_layer
+    std = 0.02
+
+    def normal(k, shape):
+        return (jax.random.normal(k, shape) * std).astype(dtype)
+
+    bkeys = jax.random.split(k_blocks, 4)
+    params: Params = {
+        "wte": normal(k_wte, (config.vocab_size, d)),
+        "wpe": normal(k_wpe, (config.n_positions, d)),
+        "blocks": {
+            "ln_1": {"scale": jnp.ones((l, d), dtype), "bias": jnp.zeros((l, d), dtype)},
+            "attn": {
+                "c_attn": {"kernel": normal(bkeys[0], (l, d, 3 * d)),
+                           "bias": jnp.zeros((l, 3 * d), dtype)},
+                "c_proj": {"kernel": normal(bkeys[1], (l, d, d)),
+                           "bias": jnp.zeros((l, d), dtype)},
+            },
+            "ln_2": {"scale": jnp.ones((l, d), dtype), "bias": jnp.zeros((l, d), dtype)},
+            "mlp": {
+                "c_fc": {"kernel": normal(bkeys[2], (l, d, 4 * d)),
+                         "bias": jnp.zeros((l, 4 * d), dtype)},
+                "c_proj": {"kernel": normal(bkeys[3], (l, 4 * d, d)),
+                           "bias": jnp.zeros((l, d), dtype)},
+            },
+        },
+        "ln_f": {"scale": jnp.ones((d,), dtype), "bias": jnp.zeros((d,), dtype)},
+    }
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Forward pieces. Split into embed / blocks / final so the pipeline
+# partitioner (parallel.partition) can hand each stage exactly the pieces the
+# reference gives its shards: A = wte+wpe+blocks[:k] (server.py:68-86),
+# B = blocks[k:]+ln_f+lm_head (server.py:90-103) — generalized to N stages.
+# ---------------------------------------------------------------------------
+
+def embed(params: Params, input_ids: jnp.ndarray,
+          position_offset: jnp.ndarray | int = 0) -> jnp.ndarray:
+    """Token + position embeddings. [B, S] int32 -> [B, S, D].
+
+    ``position_offset`` is the absolute position of the first token (nonzero
+    during incremental decode). The reference always uses offset 0 because it
+    re-forwards the full sequence (server.py:80).
+    """
+    seq_len = input_ids.shape[-1]
+    positions = position_offset + jnp.arange(seq_len)
+    return params["wte"][input_ids] + params["wpe"][positions]
+
+
+def _block(block_params: Params, h: jnp.ndarray, n_head: int, eps: float,
+           cache_k: Optional[jnp.ndarray], cache_v: Optional[jnp.ndarray],
+           offset) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
+    """One pre-LN transformer block; optionally reads/writes a KV cache slice."""
+    a = layer_norm(h, block_params["ln_1"]["scale"], block_params["ln_1"]["bias"], eps)
+    qkv = linear(a, block_params["attn"]["c_attn"]["kernel"],
+                 block_params["attn"]["c_attn"]["bias"])
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k, v = (split_heads(x, n_head) for x in (q, k, v))
+    if cache_k is None:
+        attn_out = causal_attention(q, k, v, q_offset=offset)
+        new_ck = new_cv = None
+    else:
+        attn_out, new_ck, new_cv = cached_attention(q, k, v, cache_k, cache_v, offset)
+    attn_out = linear(merge_heads(attn_out),
+                      block_params["attn"]["c_proj"]["kernel"],
+                      block_params["attn"]["c_proj"]["bias"])
+    h = h + attn_out
+    m = layer_norm(h, block_params["ln_2"]["scale"], block_params["ln_2"]["bias"], eps)
+    m = linear(gelu_new(linear(m, block_params["mlp"]["c_fc"]["kernel"],
+                               block_params["mlp"]["c_fc"]["bias"])),
+               block_params["mlp"]["c_proj"]["kernel"],
+               block_params["mlp"]["c_proj"]["bias"])
+    return h + m, new_ck, new_cv
+
+
+def apply_blocks(blocks: Params, h: jnp.ndarray, config: GPT2Config,
+                 cache: Optional[KVCache] = None,
+                 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
+    """Run a stack of blocks (leading layer axis) via ``lax.scan``.
+
+    ``blocks`` leaves are ``[L, ...]``; ``cache`` (if given) carries matching
+    ``[L, B, H, max_seq, hd]`` buffers. One compiled body serves every layer —
+    the TPU-shaped replacement for the reference's per-module Python loop
+    (server.py:84-85, 99-100).
+    """
+    eps = config.layer_norm_epsilon
+    n_head = config.n_head
+
+    if cache is None:
+        def body(carry, layer_params):
+            out, _, _ = _block(layer_params, carry, n_head, eps, None, None, 0)
+            return out, None
+
+        h, _ = jax.lax.scan(body, h, blocks)
+        return h, None
+
+    offset = cache.length
+
+    def body(carry, xs):
+        layer_params, ck, cv = xs
+        out, new_ck, new_cv = _block(layer_params, carry, n_head, eps, ck, cv, offset)
+        return out, (new_ck, new_cv)
+
+    h, (new_k, new_v) = jax.lax.scan(body, h, (blocks, cache.k, cache.v))
+    new_len = cache.length + jnp.asarray(h.shape[1], dtype=jnp.int32)
+    return h, KVCache(k=new_k, v=new_v, length=new_len)
+
+
+def final_logits(params: Params, h: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """ln_f followed by the tied LM head (logits = h @ wte.T).
+
+    Equivalent of the reference's ShardB tail (ln_f -> lm_head,
+    server.py:101-102); tying to ``wte`` matches GPT-2's actual weight
+    sharing, which HF also applies.
+    """
+    h = layer_norm(h, params["ln_f"]["scale"], params["ln_f"]["bias"], eps)
+    return h @ params["wte"].T
+
+
+def forward(params: Params, input_ids: jnp.ndarray,
+            config: GPT2Config) -> jnp.ndarray:
+    """Full no-cache forward: [B, S] -> [B, S, vocab] logits.
+
+    The parity oracle against HF GPT-2 (SURVEY.md §4 item 1) and the compat
+    ``/forward`` + ``/forward_b`` composition both go through here.
+    """
+    h = embed(params, input_ids, 0)
+    h, _ = apply_blocks(params["blocks"], h, config)
+    return final_logits(params, h, config.layer_norm_epsilon)
+
+
+def forward_with_cache(params: Params, input_ids: jnp.ndarray,
+                       config: GPT2Config, cache: KVCache,
+                       ) -> Tuple[jnp.ndarray, KVCache]:
+    """Cached forward (prefill when cache.length==0, decode step otherwise).
+
+    Returns full-sequence logits and the updated cache. The decode engine
+    (runtime.engine) jits this once for prefill shapes and once for the
+    single-token step.
+    """
+    h = embed(params, input_ids, cache.length)
+    h, cache = apply_blocks(params["blocks"], h, config, cache)
+    return final_logits(params, h, config.layer_norm_epsilon), cache
+
+
+def make_cache(config: GPT2Config, batch: int, max_seq: int,
+               dtype=jnp.float32) -> KVCache:
+    """Allocate a fixed-size KV cache.
+
+    ``max_seq`` is bounded by ``n_positions``: past the learned position
+    table, ``wpe`` gathers and cache writes would silently clamp (XLA
+    out-of-bounds semantics) and corrupt generation instead of erroring.
+    """
+    if max_seq > config.n_positions:
+        raise ValueError(
+            f"max_seq={max_seq} exceeds n_positions={config.n_positions}; "
+            "decode past the position table would silently clamp")
+    return KVCache.create(config.n_layer, batch, config.n_head, max_seq,
+                          config.head_dim, dtype)

@@ -1,0 +1,108 @@
+"""HuggingFace GPT-2 checkpoint -> JAX param-pytree converter.
+
+The reference downloads full HF weights into *every* pod at import time
+(reference server.py:40-42) and never saves anything (SURVEY.md §5
+"Checkpoint / resume"). Here conversion is a one-time, explicit step; the
+result is a plain pytree that pipeline stages can slice so each device holds
+only its own blocks.
+
+Layout notes (the Conv1D trap, SURVEY.md §7 hard part (b)): HF GPT-2 uses
+``Conv1D`` whose ``weight`` is stored ``[in_features, out_features]`` — the
+transpose of ``nn.Linear``. Our kernels use the same ``[in, out]`` layout
+(ops.layers.linear), so attention/MLP weights are copied as-is with no
+transpose; only awareness is required, not surgery. The LM head is tied to
+``wte`` in GPT-2 (HF ``tie_word_embeddings``), so no separate head tensor is
+converted.
+
+torch is imported lazily: it is only needed when actually converting, never
+on the TPU serving path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from .gpt2 import GPT2Config, Params
+
+
+def config_from_hf(hf_config: Any) -> GPT2Config:
+    """Map an HF ``GPT2Config`` to ours (fields used by the compute path).
+
+    Rejects checkpoints whose semantics our forward does not implement —
+    silent wrong logits are worse than a loud error.
+    """
+    if not getattr(hf_config, "tie_word_embeddings", True):
+        raise ValueError(
+            "untied lm_head is not supported: final_logits ties the head to "
+            "wte (GPT-2's actual weight sharing)")
+    act = getattr(hf_config, "activation_function", "gelu_new")
+    if act != "gelu_new":
+        raise ValueError(
+            f"activation_function={act!r} not supported; forward hard-wires "
+            "gelu_new (ops.layers.gelu_new)")
+    return GPT2Config(
+        vocab_size=hf_config.vocab_size,
+        n_positions=hf_config.n_positions,
+        n_embd=hf_config.n_embd,
+        n_layer=hf_config.n_layer,
+        n_head=hf_config.n_head,
+        layer_norm_epsilon=hf_config.layer_norm_epsilon,
+    )
+
+
+def params_from_state_dict(state_dict: Dict[str, Any], config: GPT2Config,
+                           dtype=jnp.float32) -> Params:
+    """Convert a torch ``GPT2LMHeadModel.state_dict()`` into our pytree.
+
+    Blocks are stacked on a leading layer axis (models.gpt2 docstring).
+    Buffers like ``attn.bias`` (HF's causal-mask triangle) are ignored — the
+    mask is computed, not stored, on our side.
+    """
+
+    def get(name: str) -> np.ndarray:
+        t = state_dict[name]
+        # torch tensors expose .detach().cpu().numpy(); accept ndarrays too
+        # so tests can feed pre-extracted dicts.
+        if hasattr(t, "detach"):
+            t = t.detach().cpu().numpy()
+        return np.asarray(t)
+
+    def stack(fmt: str) -> jnp.ndarray:
+        return jnp.asarray(
+            np.stack([get(fmt.format(i)) for i in range(config.n_layer)]),
+            dtype=dtype)
+
+    params: Params = {
+        "wte": jnp.asarray(get("transformer.wte.weight"), dtype=dtype),
+        "wpe": jnp.asarray(get("transformer.wpe.weight"), dtype=dtype),
+        "blocks": {
+            "ln_1": {"scale": stack("transformer.h.{}.ln_1.weight"),
+                     "bias": stack("transformer.h.{}.ln_1.bias")},
+            "attn": {
+                "c_attn": {"kernel": stack("transformer.h.{}.attn.c_attn.weight"),
+                           "bias": stack("transformer.h.{}.attn.c_attn.bias")},
+                "c_proj": {"kernel": stack("transformer.h.{}.attn.c_proj.weight"),
+                           "bias": stack("transformer.h.{}.attn.c_proj.bias")},
+            },
+            "ln_2": {"scale": stack("transformer.h.{}.ln_2.weight"),
+                     "bias": stack("transformer.h.{}.ln_2.bias")},
+            "mlp": {
+                "c_fc": {"kernel": stack("transformer.h.{}.mlp.c_fc.weight"),
+                         "bias": stack("transformer.h.{}.mlp.c_fc.bias")},
+                "c_proj": {"kernel": stack("transformer.h.{}.mlp.c_proj.weight"),
+                           "bias": stack("transformer.h.{}.mlp.c_proj.bias")},
+            },
+        },
+        "ln_f": {"scale": jnp.asarray(get("transformer.ln_f.weight"), dtype=dtype),
+                 "bias": jnp.asarray(get("transformer.ln_f.bias"), dtype=dtype)},
+    }
+    return params
+
+
+def params_from_hf_model(model: Any, dtype=jnp.float32):
+    """Convenience: torch ``GPT2LMHeadModel`` instance -> (config, params)."""
+    config = config_from_hf(model.config)
+    return config, params_from_state_dict(model.state_dict(), config, dtype=dtype)

@@ -1,0 +1,111 @@
+"""Multi-head causal self-attention with an optional KV cache.
+
+TPU-native replacement for the attention inside the reference's torch
+``block`` calls (reference server.py:84-85, 99-100 — the reference reuses HF
+``GPT2Block`` wholesale and re-forwards the full sequence every token,
+server.py:169-181). Here attention is a pure function shaped for the MXU:
+
+- batched ``einsum`` contractions (no per-head Python loops);
+- static shapes: the KV cache is a fixed ``[B, H, max_seq, hd]`` buffer
+  updated in place with ``lax.dynamic_update_slice`` so the incremental
+  decode step compiles once and is reused for every token;
+- masking via additive ``-inf`` biases computed from absolute positions, so
+  the same kernel serves full-sequence (prefill / parity) and single-token
+  (decode) calls.
+
+Softmax runs in float32 even under bfloat16 activations, mirroring what HF
+does with ``attn_weights`` and keeping the logit-parity oracle tight.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9  # large-negative additive mask; finite so 0*inf NaNs can't leak
+
+
+class KVCache(NamedTuple):
+    """Per-layer-group KV cache.
+
+    ``k``/``v`` have shape ``[n_layer, batch, n_head, max_seq, head_dim]``
+    (the leading layer axis lets a ``lax.scan`` over stacked block params
+    carry its cache slice). ``length`` is the number of valid positions
+    already written, shared across layers.
+    """
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    length: jnp.ndarray  # scalar int32
+
+    @staticmethod
+    def create(n_layer: int, batch: int, n_head: int, max_seq: int,
+               head_dim: int, dtype=jnp.float32) -> "KVCache":
+        shape = (n_layer, batch, n_head, max_seq, head_dim)
+        return KVCache(
+            k=jnp.zeros(shape, dtype=dtype),
+            v=jnp.zeros(shape, dtype=dtype),
+            length=jnp.zeros((), dtype=jnp.int32),
+        )
+
+
+def split_heads(x: jnp.ndarray, n_head: int) -> jnp.ndarray:
+    """[B, S, D] -> [B, H, S, hd]."""
+    b, s, d = x.shape
+    return x.reshape(b, s, n_head, d // n_head).transpose(0, 2, 1, 3)
+
+
+def merge_heads(x: jnp.ndarray) -> jnp.ndarray:
+    """[B, H, S, hd] -> [B, S, D]."""
+    b, h, s, hd = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
+
+
+def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                     q_offset: jnp.ndarray | int = 0,
+                     kv_length: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Scaled dot-product attention with causal masking by absolute position.
+
+    q: [B, H, Sq, hd]; k, v: [B, H, Skv, hd].
+    Query i attends to key j iff ``j <= q_offset + i`` and ``j < kv_length``
+    (``kv_length`` defaults to Skv). This one predicate covers both the
+    prefill triangle and the decode row against a fixed-size cache.
+    """
+    b, h, sq, hd = q.shape
+    skv = k.shape[2]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, dtype=jnp.float32))
+    # [B, H, Sq, Skv] score matrix in float32 for a stable softmax.
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    q_pos = q_offset + jnp.arange(sq)[:, None]          # [Sq, 1]
+    k_pos = jnp.arange(skv)[None, :]                    # [1, Skv]
+    allowed = k_pos <= q_pos                            # causal
+    if kv_length is not None:
+        allowed = allowed & (k_pos < kv_length)
+    scores = jnp.where(allowed[None, None, :, :], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
+    return out
+
+
+def cached_attention(q: jnp.ndarray, k_new: jnp.ndarray, v_new: jnp.ndarray,
+                     cache_k: jnp.ndarray, cache_v: jnp.ndarray,
+                     offset: jnp.ndarray,
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Write new K/V at ``offset`` into the fixed-size cache, then attend.
+
+    q, k_new, v_new: [B, H, S, hd]; cache_k/v: [B, H, max_seq, hd].
+    Returns (attn_out, updated_cache_k, updated_cache_v). The write is a
+    ``lax.dynamic_update_slice`` so shapes stay static under jit — this is
+    the KV-cache mechanism BASELINE.json config 5 requires, absent from the
+    reference (it re-forwards the whole sequence per token, server.py:169).
+    """
+    s = k_new.shape[2]
+    start = (0, 0, offset, 0)
+    cache_k = jax.lax.dynamic_update_slice(cache_k, k_new.astype(cache_k.dtype), start)
+    cache_v = jax.lax.dynamic_update_slice(cache_v, v_new.astype(cache_v.dtype), start)
+    out = causal_attention(q, cache_k, cache_v, q_offset=offset,
+                           kv_length=offset + s)
+    return out, cache_k, cache_v

@@ -1,0 +1,32 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh.
+
+The TPU-native analog of "multi-node tests without real nodes" (SURVEY.md §4
+item 4): tests exercise 2- and 4-stage pipelines and dp/tp meshes on forced
+host devices; the identical code runs unmodified on a real TPU slice.
+
+Ordering matters: the container's sitecustomize registers the axon TPU
+backend at interpreter start, so we cannot rely on JAX_PLATFORMS env alone —
+XLA_FLAGS must be set before the first backend use and the platform switched
+via jax.config.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=8 " + os.environ.get("XLA_FLAGS", "")
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# Parity oracles compare fp32 logits against torch; on CPU this is the
+# default, and on any accelerator 'highest' keeps matmuls out of bf16.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_sessionstart(session):
+    n = len(jax.devices())
+    assert n == 8, f"expected 8 forced host devices, got {n}"
