@@ -43,6 +43,15 @@ def config_from_hf(hf_config: Any) -> GPT2Config:
         raise ValueError(
             f"activation_function={act!r} not supported; forward hard-wires "
             "gelu_new (ops.layers.gelu_new)")
+    # Attention-math variants our kernel does not implement: it always
+    # scales by 1/sqrt(head_dim) and never rescales by layer index.
+    if not getattr(hf_config, "scale_attn_weights", True):
+        raise ValueError("scale_attn_weights=False not supported: "
+                         "causal_attention always scales by 1/sqrt(head_dim)")
+    if getattr(hf_config, "scale_attn_by_inverse_layer_idx", False):
+        raise ValueError("scale_attn_by_inverse_layer_idx=True not supported")
+    if getattr(hf_config, "reorder_and_upcast_attn", False):
+        raise ValueError("reorder_and_upcast_attn=True not supported")
     return GPT2Config(
         vocab_size=hf_config.vocab_size,
         n_positions=hf_config.n_positions,

@@ -1,0 +1,205 @@
+"""N-stage pipeline partitioner over the GPT-2 parameter pytree.
+
+This is the reference's ``split_gpt2_model`` capability (reference
+server.py:51-105: ShardA = wte+wpe+blocks[:k], ShardB = blocks[k:]+ln_f+
+lm_head) generalized to N contiguous stages, with the validation the
+reference lacks: its shipped k8s config runs block 1 on *both* shards
+(SPLIT_AT=2 on shard A, SPLIT_AT=1 on shard B — SURVEY.md §2.3.1). Here the
+partition is computed once from a single source of truth and checked to be
+disjoint and exhaustive before any stage exists.
+
+TPU-native design notes:
+
+- Stage parameters are *slices of the stacked-block pytree* (blocks carry a
+  leading layer axis, models.gpt2), so a stage's blocks still run as one
+  ``lax.scan`` and extraction is pure array slicing — no module surgery.
+- The LM head is tied to ``wte``, so the last stage carries ``wte`` too
+  (shared with stage 0 only when n_stages == 1). This is the memory-honest
+  version of the reference, where every role holds the *full* model
+  (server.py:108-110).
+- ``stage_apply`` is a pure function of (stage params, hidden|ids) suitable
+  for jit per device or for shard_map over a pipeline mesh axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.gpt2 import (GPT2Config, Params, apply_blocks, embed,
+                           final_logits)
+from ..ops.attention import KVCache
+
+
+@dataclasses.dataclass(frozen=True)
+class StageSpec:
+    """One pipeline stage: blocks ``[start, end)`` of ``n_layer`` total."""
+
+    index: int
+    n_stages: int
+    start: int
+    end: int
+
+    @property
+    def is_first(self) -> bool:
+        return self.index == 0
+
+    @property
+    def is_last(self) -> bool:
+        return self.index == self.n_stages - 1
+
+    @property
+    def n_blocks(self) -> int:
+        return self.end - self.start
+
+
+def balanced_boundaries(n_layer: int, n_stages: int) -> List[int]:
+    """Split points giving each stage ``n_layer // n_stages`` (±1) blocks.
+
+    Returns the interior boundaries, e.g. 12 layers / 4 stages -> [3, 6, 9].
+    Earlier stages get the remainder blocks (they also carry the embedding).
+    """
+    if not 1 <= n_stages <= n_layer:
+        raise ValueError(f"n_stages={n_stages} must be in [1, n_layer={n_layer}]")
+    base, rem = divmod(n_layer, n_stages)
+    sizes = [base + (1 if i < rem else 0) for i in range(n_stages)]
+    bounds, acc = [], 0
+    for s in sizes[:-1]:
+        acc += s
+        bounds.append(acc)
+    return bounds
+
+
+def make_stage_specs(n_layer: int, boundaries: Sequence[int],
+                     ) -> List[StageSpec]:
+    """Interior boundaries -> validated StageSpecs.
+
+    Raises if the partition is not strictly increasing, in range, or leaves
+    any stage empty — i.e. it enforces disjoint + exhaustive block coverage,
+    the guard SURVEY.md §4 item 2 calls for against the reference's shipped
+    SPLIT_AT mismatch.
+    """
+    bounds = list(boundaries)
+    cuts = [0] + bounds + [n_layer]
+    for a, b in zip(cuts, cuts[1:]):
+        if not a < b:
+            raise ValueError(
+                f"invalid partition {bounds!r} of {n_layer} layers: stage "
+                f"[{a},{b}) is empty or out of order (partition must be "
+                "disjoint and exhaustive)")
+    n_stages = len(cuts) - 1
+    return [StageSpec(index=i, n_stages=n_stages, start=cuts[i], end=cuts[i + 1])
+            for i in range(n_stages)]
+
+
+def validate_specs(specs: Sequence[StageSpec], n_layer: int) -> None:
+    """Re-check an externally supplied stage list, in composition order.
+
+    Enforces everything ``stage_apply`` relies on: stages tile
+    ``[0, n_layer)`` *in list order* (no sorting — order is execution
+    order), and ``index``/``n_stages`` are consistent so exactly the first
+    stage embeds and exactly the last applies the LM head.
+    """
+    pos = 0
+    for i, s in enumerate(specs):
+        if s.index != i or s.n_stages != len(specs):
+            raise ValueError(
+                f"spec at position {i} has index={s.index}, "
+                f"n_stages={s.n_stages}; expected index={i}, "
+                f"n_stages={len(specs)} (is_first/is_last would misfire)")
+        if s.start != pos or s.end <= s.start:
+            raise ValueError(
+                f"stages {[(t.start, t.end) for t in specs]} do not tile "
+                f"[0,{n_layer}) in order: gap/overlap at block {pos}")
+        pos = s.end
+    if pos != n_layer:
+        raise ValueError(f"stages cover [0,{pos}) but model has {n_layer} layers")
+
+
+def _slice_blocks(blocks: Params, start: int, end: int) -> Params:
+    return jax.tree_util.tree_map(lambda x: x[start:end], blocks)
+
+
+def extract_stage_params(params: Params, spec: StageSpec) -> Params:
+    """The parameter subset one stage actually needs (and nothing more).
+
+    First stage: embeddings + its blocks. Last stage: its blocks + ln_f +
+    the tied head (``wte``). Middle stages: blocks only. Contrast with the
+    reference, where every pod loads and keeps the full model
+    (server.py:40-42, 108-110).
+    """
+    out: Params = {"blocks": _slice_blocks(params["blocks"], spec.start, spec.end)}
+    if spec.is_first:
+        out["wte"] = params["wte"]
+        out["wpe"] = params["wpe"]
+    if spec.is_last:
+        out["ln_f"] = params["ln_f"]
+        out["wte_out"] = params["wte"]  # tied LM head
+    return out
+
+
+def partition_params(params: Params, specs: Sequence[StageSpec]) -> List[Params]:
+    """All stages' parameter subsets: ``[extract_stage_params(p, s) for s]``."""
+    return [extract_stage_params(params, s) for s in specs]
+
+
+def stage_apply(stage_params: Params, spec: StageSpec, config: GPT2Config,
+                x: jnp.ndarray, cache: Optional[KVCache] = None,
+                ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
+    """Run one stage. First stage takes ``[B,S]`` ids, others ``[B,S,D]``
+    hidden states; last stage returns ``[B,S,vocab]`` logits.
+
+    This is the per-stage public contract the reference exposes as
+    ``/forward`` (ids -> hidden, server.py:132-140) and ``/forward_b``
+    (hidden -> logits, server.py:143-151), as a pure jittable function.
+    ``cache`` holds only this stage's layers (leading axis ``spec.n_blocks``).
+
+    The position offset is *derived*, never passed: ``cache.length`` when a
+    cache is present, else 0. A caller-supplied offset could desynchronize
+    the wpe gather from the attention mask / cache-write position, which
+    both always come from the cache — so the knob deliberately doesn't
+    exist.
+    """
+    position_offset = cache.length if cache is not None else 0
+    h = embed(stage_params, x, position_offset) if spec.is_first else x
+    h, cache = apply_blocks(stage_params["blocks"], h, config, cache)
+    if spec.is_last:
+        head_params = {"ln_f": stage_params["ln_f"], "wte": stage_params["wte_out"]}
+        h = final_logits(head_params, h, config.layer_norm_epsilon)
+    return h, cache
+
+
+def make_stage_cache(spec: StageSpec, config: GPT2Config, batch: int,
+                     max_seq: int, dtype=jnp.float32) -> KVCache:
+    """A KV cache sized for one stage's block count."""
+    if max_seq > config.n_positions:
+        raise ValueError(
+            f"max_seq={max_seq} exceeds n_positions={config.n_positions}")
+    return KVCache.create(spec.n_blocks, batch, config.n_head, max_seq,
+                          config.head_dim, dtype)
+
+
+def stack_stage_params(params: Params, specs: Sequence[StageSpec]) -> Params:
+    """Stage-major re-layout for single-jit pipelining over a mesh axis.
+
+    Requires equal-size stages. Returns the block pytree reshaped from
+    ``[n_layer, ...]`` to ``[n_stages, blocks_per_stage, ...]`` so a
+    ``shard_map`` over the pipeline mesh axis gives each device its own
+    ``[blocks_per_stage, ...]`` slice — the single-program SPMD form of the
+    reference's multi-process topology.
+    """
+    sizes = {s.n_blocks for s in specs}
+    if len(sizes) != 1:
+        raise ValueError(
+            f"stage-major stacking needs equal stage sizes, got "
+            f"{[s.n_blocks for s in specs]}")
+    per = sizes.pop()
+    n_stages = len(specs)
+
+    def reshape(x):
+        return x.reshape((n_stages, per) + x.shape[1:])
+
+    return jax.tree_util.tree_map(reshape, params["blocks"])

@@ -1,0 +1,123 @@
+"""Partition validation + stage-composition parity (SURVEY.md §4 items 1-2).
+
+The reference ships a broken partition (block 1 runs on both shards,
+SURVEY.md §2.3.1) because nothing validates coverage. These tests pin the
+guard and the core correctness claim: composing N stages equals the unsplit
+forward, for any valid split.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_sharding_demo_tpu.models import gpt2
+from llm_sharding_demo_tpu.parallel import partition as P
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    config = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_embd=32,
+                             n_layer=6, n_head=4)
+    params = gpt2.init_params(config, __import__("jax").random.PRNGKey(0))
+    return config, params
+
+
+def test_balanced_boundaries():
+    assert P.balanced_boundaries(12, 2) == [6]
+    assert P.balanced_boundaries(12, 4) == [3, 6, 9]
+    assert P.balanced_boundaries(7, 2) == [4]  # earlier stage gets remainder
+    assert P.balanced_boundaries(6, 1) == []
+    with pytest.raises(ValueError):
+        P.balanced_boundaries(4, 5)
+
+
+def test_specs_reject_bad_partitions():
+    # the reference's shipped bug: overlap / gap partitions must be loud
+    with pytest.raises(ValueError):
+        P.make_stage_specs(6, [3, 3])        # empty middle stage
+    with pytest.raises(ValueError):
+        P.make_stage_specs(6, [4, 2])        # out of order
+    with pytest.raises(ValueError):
+        P.make_stage_specs(6, [0])           # empty first stage
+    with pytest.raises(ValueError):
+        P.make_stage_specs(6, [6])           # empty last stage
+    specs = P.make_stage_specs(6, [2, 4])
+    assert [(s.start, s.end) for s in specs] == [(0, 2), (2, 4), (4, 6)]
+    P.validate_specs(specs, 6)
+    with pytest.raises(ValueError):
+        P.validate_specs(specs, 7)
+    # list order IS execution order: reversing must fail, not be sorted away
+    with pytest.raises(ValueError):
+        P.validate_specs(list(reversed(specs)), 6)
+    # index/n_stages consistency: two "single-stage" specs that tile [0,6)
+    # would make stage 0 apply the LM head mid-pipeline
+    bogus = [P.StageSpec(index=0, n_stages=1, start=0, end=3),
+             P.StageSpec(index=1, n_stages=1, start=3, end=6)]
+    with pytest.raises(ValueError):
+        P.validate_specs(bogus, 6)
+
+
+def test_stage_param_subsets(small_model):
+    config, params = small_model
+    specs = P.make_stage_specs(config.n_layer, [2, 4])
+    stages = P.partition_params(params, specs)
+    assert set(stages[0]) == {"blocks", "wte", "wpe"}
+    assert set(stages[1]) == {"blocks"}
+    assert set(stages[2]) == {"blocks", "ln_f", "wte_out"}
+    assert stages[0]["blocks"]["ln_1"]["scale"].shape[0] == 2
+    assert stages[1]["blocks"]["ln_1"]["scale"].shape[0] == 2
+    assert stages[2]["blocks"]["ln_1"]["scale"].shape[0] == 2
+
+
+@pytest.mark.parametrize("boundaries", [[], [1], [3], [5], [2, 4], [1, 2, 3]])
+def test_stage_composition_equals_full_forward(small_model, boundaries):
+    """∘(stages) ≡ unsplit forward — the claim the reference breaks."""
+    config, params = small_model
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, config.vocab_size, size=(2, 11)))
+    full = gpt2.forward(params, ids, config)
+
+    specs = P.make_stage_specs(config.n_layer, boundaries)
+    stages = P.partition_params(params, specs)
+    x = ids
+    for sp, spec in zip(stages, specs):
+        x, _ = P.stage_apply(sp, spec, config, x)
+    np.testing.assert_allclose(np.asarray(x), np.asarray(full),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_staged_cached_decode_matches_full(small_model):
+    """Per-stage KV caches: prefill + token steps ≡ full forward."""
+    config, params = small_model
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, config.vocab_size, size=(1, 10)))
+    full = gpt2.forward(params, ids, config)
+
+    specs = P.make_stage_specs(config.n_layer, [3])
+    stages = P.partition_params(params, specs)
+    caches = [P.make_stage_cache(s, config, batch=1, max_seq=16) for s in specs]
+
+    # prefill on first 6 tokens
+    x = ids[:, :6]
+    for i, (sp, spec) in enumerate(zip(stages, specs)):
+        x, caches[i] = P.stage_apply(sp, spec, config, x, caches[i])
+    np.testing.assert_allclose(np.asarray(x), np.asarray(full[:, :6]),
+                               atol=1e-5, rtol=1e-5)
+
+    # then one token at a time
+    for t in range(6, 10):
+        x = ids[:, t:t + 1]
+        for i, (sp, spec) in enumerate(zip(stages, specs)):
+            x, caches[i] = P.stage_apply(sp, spec, config, x, caches[i])
+        np.testing.assert_allclose(np.asarray(x[:, 0]), np.asarray(full[:, t]),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_stack_stage_params(small_model):
+    config, params = small_model
+    specs = P.make_stage_specs(config.n_layer, [3])
+    stacked = P.stack_stage_params(params, specs)
+    assert stacked["ln_1"]["scale"].shape[:2] == (2, 3)
+    uneven = P.make_stage_specs(config.n_layer, [2])  # 2 + 4 blocks
+    with pytest.raises(ValueError):
+        P.stack_stage_params(params, uneven)
