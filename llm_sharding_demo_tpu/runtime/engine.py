@@ -1,0 +1,220 @@
+"""Decode engine: jitted prefill + scanned token loop, on-device sampling.
+
+TPU-native replacement for the reference's coordinator decode loop
+(reference server.py:154-210), which per token: re-POSTs the *entire*
+sequence over HTTP to shard A, relays hidden states to shard B, pulls fp32
+logits back to the host as JSON, and samples in numpy/torch
+(server.py:169-206). Here the whole generation is two compiled programs:
+
+- ``prefill``: one forward over the prompt, filling the KV cache;
+- ``decode``: a single ``lax.scan`` over ``max_new_tokens`` whose body is
+  the cached single-token step + on-device token selection. No
+  host↔device traffic inside the loop, no re-forwarding (the KV cache is
+  the fix for the reference's O(n²) loop — BASELINE.json config 5).
+
+Token selection modes mirror the reference:
+
+- ``greedy``: argmax — BASELINE.json's parity mode.
+- ``sample``: temperature + top-k multinomial, the reference's hard-coded
+  temperature=0.6 / top_k=40 sampler (server.py:187-206) — but with an
+  explicit PRNG key instead of torch's unseeded global state (SURVEY.md
+  §2.3.4: cross-framework RNG parity is impossible; we mirror the
+  distribution math).
+
+Batching is a leading batch dim; prompts in a batch share one length
+(per-sequence lengths + padding masks are a planned extension; the
+reference hardcodes batch=1, server.py:137).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import gpt2
+from ..models.gpt2 import GPT2Config, Params
+from ..ops.attention import KVCache
+
+# Reference sampler constants (server.py:188, 191).
+REF_TEMPERATURE = 0.6
+REF_TOP_K = 40
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    """Token-selection policy for one generate call."""
+
+    mode: str = "greedy"  # "greedy" | "sample"
+    temperature: float = REF_TEMPERATURE
+    top_k: int = REF_TOP_K
+
+    def __post_init__(self):
+        if self.mode not in ("greedy", "sample"):
+            raise ValueError(f"unknown mode {self.mode!r}")
+        if self.mode == "sample":
+            if self.temperature <= 0:
+                raise ValueError("temperature must be > 0 for sampling")
+            if self.top_k < 1:
+                raise ValueError("top_k must be >= 1")
+
+
+def select_token(logits: jnp.ndarray, sampling: SamplingConfig,
+                 key: Optional[jax.Array]) -> jnp.ndarray:
+    """[B, vocab] last-position logits -> [B] int32 next tokens, on device.
+
+    Greedy is plain argmax. Sample mode reproduces the reference's math
+    (scale by 1/temperature, keep top-k, softmax over the k survivors,
+    multinomial — server.py:187-205) as one fused device computation:
+    ``lax.top_k`` + categorical over the k logits, mapped back through the
+    top-k indices.
+    """
+    if sampling.mode == "greedy":
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits.astype(jnp.float32) / sampling.temperature
+    top_vals, top_idx = jax.lax.top_k(scaled, sampling.top_k)  # [B, k] each
+    # categorical over the k survivors == softmax + multinomial(1)
+    choice = jax.random.categorical(key, top_vals, axis=-1)     # [B]
+    return jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+
+@dataclasses.dataclass
+class GenerateResult:
+    """Tokens plus the timing the bench harness reports (BASELINE.md metric).
+
+    ``decode_seconds`` times exactly ``decode_steps`` cached single-token
+    forwards (= ``new_tokens - 1``: the first new token comes from the
+    prefill logits, so its selection is inside the prefill window). The
+    throughput/latency properties divide by ``decode_steps``, not
+    ``new_tokens`` — dividing by ``new_tokens`` would overstate throughput
+    by N/(N-1) and explode at N=1.
+    """
+
+    tokens: np.ndarray           # [B, prompt_len + new_tokens]
+    prompt_len: int
+    prefill_seconds: float
+    decode_seconds: float
+    new_tokens: int
+    decode_steps: int
+
+    @property
+    def tokens_per_second(self) -> float:
+        """Steady-state decode throughput (tokens/s across the batch)."""
+        if self.decode_steps == 0:
+            return float("nan")  # a 1-token generate has no decode window
+        batch = self.tokens.shape[0]
+        return self.decode_steps * batch / self.decode_seconds
+
+    @property
+    def per_token_latency(self) -> float:
+        if self.decode_steps == 0:
+            return float("nan")
+        return self.decode_seconds / self.decode_steps
+
+
+class DecodeEngine:
+    """Single-model decode engine (pipeline-parallel variant in
+    ``parallel.pipeline``): owns jitted prefill/decode programs keyed by
+    static shapes, so repeated ``generate`` calls reuse compilations.
+    """
+
+    def __init__(self, params: Params, config: GPT2Config, max_seq: int,
+                 dtype=jnp.float32):
+        if max_seq > config.n_positions:
+            raise ValueError(
+                f"max_seq={max_seq} exceeds n_positions={config.n_positions}")
+        self.params = params
+        self.config = config
+        self.max_seq = max_seq
+        self.dtype = dtype
+        self._prefill = jax.jit(self._prefill_impl)
+        # static args: number of decode steps and the sampling policy (both
+        # change the traced program).
+        self._decode = jax.jit(self._decode_impl,
+                               static_argnames=("steps", "sampling"))
+
+    # -- compiled programs ---------------------------------------------------
+
+    def _prefill_impl(self, params: Params, ids: jnp.ndarray, cache: KVCache
+                      ) -> Tuple[jnp.ndarray, KVCache]:
+        logits, cache = gpt2.forward_with_cache(params, ids, self.config, cache)
+        return logits[:, -1], cache
+
+    def _decode_impl(self, params: Params, first_token: jnp.ndarray,
+                     cache: KVCache, key: jax.Array, *, steps: int,
+                     sampling: SamplingConfig) -> jnp.ndarray:
+        """lax.scan over ``steps - 1`` cached single-token forwards.
+
+        ``first_token`` [B] is the token selected from the prefill logits;
+        the scan forwards each selected token once and emits the next —
+        no trailing wasted forward.
+        """
+        if steps == 1:
+            return first_token[:, None]
+
+        def body(carry, step_key):
+            token, cache = carry
+            logits, cache = gpt2.forward_with_cache(
+                params, token[:, None], self.config, cache)
+            nxt = select_token(logits[:, -1], sampling, step_key)
+            return (nxt, cache), nxt
+
+        keys = jax.random.split(key, steps - 1)
+        (_, _), rest = jax.lax.scan(body, (first_token, cache), keys)
+        tokens = jnp.concatenate([first_token[None, :], rest], axis=0)
+        return tokens.T  # [steps, B] -> [B, steps]
+
+    # -- public API ----------------------------------------------------------
+
+    def generate(self, prompt_ids, max_new_tokens: int,
+                 sampling: SamplingConfig = SamplingConfig(),
+                 key: Optional[jax.Array] = None) -> GenerateResult:
+        """[B, S] (or [S]) prompt ids -> GenerateResult with [B, S+N] tokens.
+
+        Statically guards ``prompt_len + max_new_tokens <= max_seq`` — past
+        that the fixed-size cache write would silently clamp
+        (dynamic_update_slice semantics; see ops.attention.cached_attention),
+        which is exactly the corruption this check exists to prevent.
+        """
+        ids = np.asarray(prompt_ids)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        batch, prompt_len = ids.shape
+        if prompt_len < 1:
+            raise ValueError("prompt must be non-empty")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = prompt_len + max_new_tokens
+        if total > self.max_seq:
+            raise ValueError(
+                f"prompt_len={prompt_len} + max_new_tokens={max_new_tokens} "
+                f"= {total} exceeds max_seq={self.max_seq}; cache writes "
+                "would silently clamp")
+        if sampling.mode == "sample" and key is None:
+            raise ValueError("sample mode requires an explicit PRNG key")
+        if key is None:
+            key = jax.random.PRNGKey(0)  # unused by greedy; fixed for shape
+
+        ids_j = jnp.asarray(ids, dtype=jnp.int32)
+        cache = gpt2.make_cache(self.config, batch, self.max_seq, self.dtype)
+
+        t0 = time.perf_counter()
+        prefill_key, decode_key = jax.random.split(key)
+        last_logits, cache = self._prefill(self.params, ids_j, cache)
+        first = select_token(last_logits, sampling, prefill_key)
+        first.block_until_ready()
+        t1 = time.perf_counter()
+        new = self._decode(self.params, first, cache, decode_key,
+                           steps=max_new_tokens, sampling=sampling)
+        new = np.asarray(jax.block_until_ready(new))
+        t2 = time.perf_counter()
+
+        tokens = np.concatenate([ids, new], axis=1)
+        return GenerateResult(tokens=tokens, prompt_len=prompt_len,
+                              prefill_seconds=t1 - t0, decode_seconds=t2 - t1,
+                              new_tokens=max_new_tokens,
+                              decode_steps=max_new_tokens - 1)
