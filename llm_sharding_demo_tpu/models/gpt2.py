@@ -155,7 +155,7 @@ def _block(block_params: Params, h: jnp.ndarray, n_head: int, eps: float,
 
 
 def apply_blocks(blocks: Params, h: jnp.ndarray, config: GPT2Config,
-                 cache: Optional[KVCache] = None,
+                 cache: Optional[KVCache] = None, remat: bool = False,
                  ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Run a stack of blocks (leading layer axis) via ``lax.scan``.
 
@@ -163,6 +163,10 @@ def apply_blocks(blocks: Params, h: jnp.ndarray, config: GPT2Config,
     ``[L, B, H, max_seq, hd]`` buffers. One compiled body serves every layer —
     the TPU-shaped replacement for the reference's per-module Python loop
     (server.py:84-85, 99-100).
+
+    ``remat=True`` checkpoints each block under reverse-mode AD: the
+    backward pass recomputes block activations instead of storing all
+    ``L`` of them — the standard HBM-for-FLOPs trade for training.
     """
     eps = config.layer_norm_epsilon
     n_head = config.n_head
@@ -172,6 +176,8 @@ def apply_blocks(blocks: Params, h: jnp.ndarray, config: GPT2Config,
             out, _, _ = _block(layer_params, carry, n_head, eps, None, None, 0)
             return out, None
 
+        if remat:
+            body = jax.checkpoint(body)
         h, _ = jax.lax.scan(body, h, blocks)
         return h, None
 
@@ -199,14 +205,15 @@ def final_logits(params: Params, h: jnp.ndarray, eps: float) -> jnp.ndarray:
 
 
 def forward(params: Params, input_ids: jnp.ndarray,
-            config: GPT2Config) -> jnp.ndarray:
+            config: GPT2Config, remat: bool = False) -> jnp.ndarray:
     """Full no-cache forward: [B, S] -> [B, S, vocab] logits.
 
     The parity oracle against HF GPT-2 (SURVEY.md §4 item 1) and the compat
     ``/forward`` + ``/forward_b`` composition both go through here.
+    ``remat`` is for the training path (see ``apply_blocks``).
     """
     h = embed(params, input_ids, 0)
-    h, _ = apply_blocks(params["blocks"], h, config)
+    h, _ = apply_blocks(params["blocks"], h, config, remat=remat)
     return final_logits(params, h, config.layer_norm_epsilon)
 
 

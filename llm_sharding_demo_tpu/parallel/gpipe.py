@@ -1,0 +1,144 @@
+"""GPipe-style pipeline-parallel block execution (shard_map + ppermute).
+
+This is the *training-capable*, single-program form of pipeline
+parallelism. Where ``parallel.pipeline.PipelineRunner`` mirrors the
+reference's topology for serving (stage per device, host-driven handoff —
+the TPU rebuild of reference server.py:169-181), this module runs all
+stages inside ONE jitted SPMD program:
+
+- transformer blocks are stacked stage-major ``[n_stages, per_stage, ...]``
+  and sharded over the mesh's ``pp`` axis, so each device owns exactly its
+  stage's weights;
+- the classic GPipe schedule: the batch is split into M microbatches; at
+  schedule tick t, stage i runs microbatch ``t - i``; activations hop to
+  the next stage via ``lax.ppermute`` over the ICI ring. The pipeline
+  "bubble" is the usual ``(S-1)/(M+S-1)`` fraction;
+- reverse-mode AD differentiates straight through the schedule (the
+  transpose of ``ppermute`` is the reverse ``ppermute``, of ``psum`` a
+  broadcast), giving pipeline-parallel *training* for free — no hand-rolled
+  backward schedule;
+- the ``pp`` axis is the only *manual* axis: dp / tp / sp stay automatic
+  (GSPMD), so the same step composes data, tensor, sequence, and pipeline
+  parallelism on one mesh (see ``axis_names={pp_axis}`` on the shard_map).
+
+The embedding and LM head run outside the shard_map under plain GSPMD:
+with the tied head this keeps ``wte`` out of the manual program entirely
+and lets XLA lay out the vocab matmul freely.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.gpt2 import GPT2Config, Params, apply_blocks
+
+
+def microbatch(h: jnp.ndarray, n_microbatches: int) -> jnp.ndarray:
+    """[B, ...] -> [M, B/M, ...]; validates divisibility."""
+    b = h.shape[0]
+    if b % n_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible by n_microbatches={n_microbatches}")
+    return h.reshape((n_microbatches, b // n_microbatches) + h.shape[1:])
+
+
+def unmicrobatch(h: jnp.ndarray) -> jnp.ndarray:
+    """[M, mb, ...] -> [M*mb, ...]."""
+    return h.reshape((h.shape[0] * h.shape[1],) + h.shape[2:])
+
+
+def gpipe_apply_blocks(stacked_blocks: Params, h_micro: jnp.ndarray,
+                       config: GPT2Config, mesh: Mesh,
+                       pp_axis: str = "pp", remat: bool = False,
+                       ) -> jnp.ndarray:
+    """Run stage-major stacked blocks over microbatched hidden states.
+
+    ``stacked_blocks`` leaves: ``[n_stages, per_stage, ...]`` sharded
+    ``P(pp_axis, ...)``; ``h_micro``: ``[M, mb, seq, D]`` replicated over
+    ``pp`` (dp/sp sharding on mb/seq rides along as automatic axes).
+    Returns ``[M, mb, seq, D]``.
+
+    Schedule: T = M + S - 1 ticks via ``lax.scan``. Stage 0 feeds
+    microbatch t (clamped; overrun ticks recompute a stale microbatch whose
+    output lands in an already-finalized slot — masked writes keep later
+    real values authoritative). The last stage's finished microbatch
+    ``t - (S-1)`` accumulates into the output buffer; a masked ``psum``
+    replicates the final buffer across the pp axis so the caller's head/
+    loss math is pp-invariant.
+    """
+    if pp_axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no {pp_axis!r} axis: {mesh.axis_names}")
+    n_stages = mesh.shape[pp_axis]
+    n_micro = h_micro.shape[0]
+    n_ticks = n_micro + n_stages - 1
+
+    def per_stage(blocks_local: Params, h_all: jnp.ndarray) -> jnp.ndarray:
+        # local view: [1, per_stage, ...] -> [per_stage, ...]
+        blocks_local = jax.tree_util.tree_map(lambda x: x[0], blocks_local)
+        stage = jax.lax.axis_index(pp_axis)
+        zeros_state = jnp.zeros(h_all.shape[1:], h_all.dtype)
+        # mark the scan carry as pp-varying up front (it becomes varying
+        # via ppermute/masked writes; the carry signature must agree)
+        init = (jax.lax.pcast(zeros_state, pp_axis, to="varying"),
+                jax.lax.pcast(jnp.zeros_like(h_all), pp_axis, to="varying"))
+
+        def tick(carry, t):
+            state, outputs = carry
+            feed = jax.lax.dynamic_index_in_dim(
+                h_all, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False)
+            x = jnp.where(stage == 0, feed, state)
+            y, _ = apply_blocks(blocks_local, x, config, remat=remat)
+            # hop to the next stage over the ICI ring; stage 0 receives
+            # zeros (it is fed from h_all, never from a predecessor)
+            incoming = jax.lax.ppermute(
+                y, pp_axis, [(j, j + 1) for j in range(n_stages - 1)])
+            done = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            written = jax.lax.dynamic_update_index_in_dim(
+                outputs, y, done, axis=0)
+            outputs = jnp.where(stage == n_stages - 1, written, outputs)
+            return (incoming, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(tick, init, jnp.arange(n_ticks))
+        # only the last stage holds real outputs; masked psum replicates
+        outputs = jnp.where(stage == n_stages - 1, outputs, 0.0)
+        return jax.lax.psum(outputs, pp_axis)
+
+    return jax.shard_map(
+        per_stage, mesh=mesh,
+        in_specs=(P(pp_axis), P()), out_specs=P(),
+        axis_names={pp_axis})(stacked_blocks, h_micro)
+
+
+def stacked_block_pspecs(mesh: Mesh, pp_axis: str = "pp") -> Params:
+    """PartitionSpecs for stage-major stacked blocks: stage axis on ``pp``,
+    plus the Megatron tp layout (shifted one axis right of
+    ``spmd.param_pspecs`` because of the extra leading stage axis)."""
+    tp = "tp" if "tp" in mesh.axis_names else None
+
+    def s(*tail):
+        return P(pp_axis, None, *tail)
+
+    return {
+        "ln_1": {"scale": s(None), "bias": s(None)},
+        "attn": {
+            "c_attn": {"kernel": s(None, tp), "bias": s(tp)},
+            "c_proj": {"kernel": s(tp, None), "bias": s(None)},
+        },
+        "ln_2": {"scale": s(None), "bias": s(None)},
+        "mlp": {
+            "c_fc": {"kernel": s(None, tp), "bias": s(tp)},
+            "c_proj": {"kernel": s(tp, None), "bias": s(None)},
+        },
+    }
+
+
+def shard_stacked_blocks(stacked: Params, mesh: Mesh,
+                         pp_axis: str = "pp") -> Params:
+    specs = stacked_block_pspecs(mesh, pp_axis)
+    return jax.tree_util.tree_map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        stacked, specs)

@@ -203,3 +203,11 @@ def stack_stage_params(params: Params, specs: Sequence[StageSpec]) -> Params:
         return x.reshape((n_stages, per) + x.shape[1:])
 
     return jax.tree_util.tree_map(reshape, params["blocks"])
+
+
+def unstack_stage_params(stacked_blocks: Params) -> Params:
+    """Inverse of ``stack_stage_params``: ``[S, per, ...]`` -> ``[L, ...]``."""
+    def reshape(x):
+        return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+
+    return jax.tree_util.tree_map(reshape, stacked_blocks)

@@ -34,7 +34,8 @@ import numpy as np
 
 from ..models.gpt2 import GPT2Config, Params
 from ..ops.attention import KVCache
-from ..runtime.engine import GenerateResult, SamplingConfig, select_token
+from ..runtime.engine import (GenerateResult, SamplingConfig,
+                              prepare_generate, select_token)
 from . import partition as P
 
 
@@ -130,25 +131,12 @@ class PipelineRunner:
         The token loop is host-driven (each token must traverse all stages
         sequentially — inherent to inference pipelining), but every step
         moves only a [B,1,D] hidden slice between devices and a [B] token
-        to the host. Static overflow guard as in runtime.engine.
+        to the host. Validation (including the static cache-overflow
+        guard) is shared with the single-device engine via
+        ``runtime.engine.prepare_generate``.
         """
-        ids = np.asarray(prompt_ids)
-        if ids.ndim == 1:
-            ids = ids[None, :]
-        batch, prompt_len = ids.shape
-        total = prompt_len + max_new_tokens
-        if prompt_len < 1:
-            raise ValueError("prompt must be non-empty")
-        if max_new_tokens < 1:
-            raise ValueError("max_new_tokens must be >= 1")
-        if total > self.max_seq:
-            raise ValueError(
-                f"prompt_len={prompt_len} + max_new_tokens={max_new_tokens} "
-                f"= {total} exceeds max_seq={self.max_seq}")
-        if sampling.mode == "sample" and key is None:
-            raise ValueError("sample mode requires an explicit PRNG key")
-        if key is None:
-            key = jax.random.PRNGKey(0)
+        ids, batch, prompt_len, key = prepare_generate(
+            prompt_ids, max_new_tokens, self.max_seq, sampling, key)
 
         caches = self.init_caches(batch)
         ids_j = jnp.asarray(ids, dtype=jnp.int32)

@@ -1,0 +1,119 @@
+"""Mesh construction and GSPMD sharding rules (dp / tp / sp axes).
+
+The reference has no notion of a device mesh — its only "distribution" is
+three CPU pods talking JSON over HTTP (reference server.py:172-181;
+SURVEY.md §2.2). This module is the TPU-native foundation the rest of the
+framework shards over, following the standard XLA recipe: pick a mesh,
+annotate shardings with ``NamedSharding``/``PartitionSpec``, let the XLA
+SPMD partitioner insert the collectives (all-reduce/all-gather/
+reduce-scatter ride ICI), profile, iterate.
+
+Axes:
+
+- ``dp``   — data parallel: batch dim of activations; gradients all-reduce
+  over this axis (inserted by XLA from the sharding annotations).
+- ``tp``   — tensor parallel, Megatron-style: attention QKV/out projections
+  and MLP up/down projections column-/row-sharded so each chip holds
+  ``1/tp`` of every block matmul; XLA inserts the two per-block
+  all-reduces.
+- ``sp``   — sequence parallel for activations: the sequence dim of hidden
+  states outside attention; attention itself needs the full sequence, so
+  XLA all-gathers at the block boundary (ring-attention kernels that avoid
+  the gather live in ``ops.ring_attention``).
+- ``pp``   — pipeline axis, used by the GPipe runtime (``parallel.gpipe``),
+  not by the rules here.
+
+Everything here is *annotation only* — no communication is hand-written.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.gpt2 import Params
+
+
+def make_mesh(shape: Dict[str, int],
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a named mesh, e.g. ``make_mesh({"dp": 2, "tp": 4})``.
+
+    Validates the axis product against the device count instead of letting
+    ``reshape`` fail cryptically.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    n = int(np.prod(list(shape.values())))
+    if n != len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices).reshape(tuple(shape.values()))
+    return Mesh(arr, tuple(shape.keys()))
+
+
+def param_pspecs(mesh: Mesh) -> Params:
+    """PartitionSpec pytree matching ``models.gpt2`` params.
+
+    Megatron layout over ``tp`` (axes not in the mesh are dropped, so the
+    same rules serve a pure-dp mesh or a tp-only mesh):
+
+    - ``c_attn``/``c_fc`` kernels: output dim sharded (column parallel);
+    - ``c_proj`` kernels (attn and mlp): input dim sharded (row parallel);
+    - matching biases sharded on their only dim for column-parallel layers,
+      replicated for row-parallel (bias adds after the all-reduce);
+    - embeddings, layer norms, ln_f: replicated (small, and keeping wte
+      replicated keeps the tied head's logits matmul unconstrained).
+
+    Note the c_attn kernel's fused ``3d`` output dim: sharding it over tp
+    splits the q/k/v concatenation into ``tp`` contiguous chunks, which is
+    numerically fine under GSPMD (it re-tiles at the ``jnp.split`` /
+    head-reshape). The pipeline path (``parallel.gpipe``) reuses this same
+    fused layout safely because tp remains an *automatic* axis inside its
+    shard_map (only ``pp`` is manual) — a fully manual tp split would
+    instead need a per-head re-layout so chunk boundaries don't cross
+    q/k/v.
+    """
+    tp = "tp" if "tp" in mesh.axis_names else None
+
+    def blk(spec_tail: Tuple[Any, ...]) -> P:
+        # blocks carry a leading layer axis, never sharded
+        return P(None, *spec_tail)
+
+    return {
+        "wte": P(),
+        "wpe": P(),
+        "blocks": {
+            "ln_1": {"scale": blk((None,)), "bias": blk((None,))},
+            "attn": {
+                "c_attn": {"kernel": blk((None, tp)), "bias": blk((tp,))},
+                "c_proj": {"kernel": blk((tp, None)), "bias": blk((None,))},
+            },
+            "ln_2": {"scale": blk((None,)), "bias": blk((None,))},
+            "mlp": {
+                "c_fc": {"kernel": blk((None, tp)), "bias": blk((tp,))},
+                "c_proj": {"kernel": blk((tp, None)), "bias": blk((None,))},
+            },
+        },
+        "ln_f": {"scale": P(), "bias": P()},
+    }
+
+
+def batch_pspec(mesh: Mesh) -> P:
+    """[B, S] token batches: batch over dp, sequence over sp (if present)."""
+    dp = "dp" if "dp" in mesh.axis_names else None
+    sp = "sp" if "sp" in mesh.axis_names else None
+    return P(dp, sp)
+
+
+def shard_params(params: Params, mesh: Mesh) -> Params:
+    """device_put the param pytree with the ``param_pspecs`` layout."""
+    specs = param_pspecs(mesh)
+    return jax.tree_util.tree_map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        params, specs)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

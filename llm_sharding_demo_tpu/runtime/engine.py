@@ -82,6 +82,38 @@ def select_token(logits: jnp.ndarray, sampling: SamplingConfig,
     return jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
 
 
+def prepare_generate(prompt_ids, max_new_tokens: int, max_seq: int,
+                     sampling: SamplingConfig, key: Optional[jax.Array],
+                     ) -> Tuple[np.ndarray, int, int, jax.Array]:
+    """Shared validation/normalization for every ``generate`` front end
+    (single-device engine and pipeline runner).
+
+    Returns ``(ids [B,S], batch, prompt_len, key)``. The overflow check is
+    the static guard against silent KV-cache clamping: past ``max_seq``,
+    ``dynamic_update_slice`` would clamp the write offset and corrupt
+    generation without an error (see ops.attention.cached_attention).
+    """
+    ids = np.asarray(prompt_ids)
+    if ids.ndim == 1:
+        ids = ids[None, :]
+    batch, prompt_len = ids.shape
+    if prompt_len < 1:
+        raise ValueError("prompt must be non-empty")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    total = prompt_len + max_new_tokens
+    if total > max_seq:
+        raise ValueError(
+            f"prompt_len={prompt_len} + max_new_tokens={max_new_tokens} "
+            f"= {total} exceeds max_seq={max_seq}; cache writes would "
+            "silently clamp")
+    if sampling.mode == "sample" and key is None:
+        raise ValueError("sample mode requires an explicit PRNG key")
+    if key is None:
+        key = jax.random.PRNGKey(0)  # unused by greedy; fixed for shape
+    return ids, batch, prompt_len, key
+
+
 @dataclasses.dataclass
 class GenerateResult:
     """Tokens plus the timing the bench harness reports (BASELINE.md metric).
@@ -131,10 +163,13 @@ class DecodeEngine:
         self.config = config
         self.max_seq = max_seq
         self.dtype = dtype
-        self._prefill = jax.jit(self._prefill_impl)
+        # The cache argument is donated in both programs: generate never
+        # reuses an input cache, and donation lets XLA update the two
+        # [L, B, H, max_seq, hd] buffers in place instead of doubling them.
+        self._prefill = jax.jit(self._prefill_impl, donate_argnums=(2,))
         # static args: number of decode steps and the sampling policy (both
         # change the traced program).
-        self._decode = jax.jit(self._decode_impl,
+        self._decode = jax.jit(self._decode_impl, donate_argnums=(2,),
                                static_argnames=("steps", "sampling"))
 
     # -- compiled programs ---------------------------------------------------
@@ -175,29 +210,11 @@ class DecodeEngine:
                  key: Optional[jax.Array] = None) -> GenerateResult:
         """[B, S] (or [S]) prompt ids -> GenerateResult with [B, S+N] tokens.
 
-        Statically guards ``prompt_len + max_new_tokens <= max_seq`` — past
-        that the fixed-size cache write would silently clamp
-        (dynamic_update_slice semantics; see ops.attention.cached_attention),
-        which is exactly the corruption this check exists to prevent.
+        Validation (including the static cache-overflow guard) is shared
+        with the pipeline runner via ``prepare_generate``.
         """
-        ids = np.asarray(prompt_ids)
-        if ids.ndim == 1:
-            ids = ids[None, :]
-        batch, prompt_len = ids.shape
-        if prompt_len < 1:
-            raise ValueError("prompt must be non-empty")
-        if max_new_tokens < 1:
-            raise ValueError("max_new_tokens must be >= 1")
-        total = prompt_len + max_new_tokens
-        if total > self.max_seq:
-            raise ValueError(
-                f"prompt_len={prompt_len} + max_new_tokens={max_new_tokens} "
-                f"= {total} exceeds max_seq={self.max_seq}; cache writes "
-                "would silently clamp")
-        if sampling.mode == "sample" and key is None:
-            raise ValueError("sample mode requires an explicit PRNG key")
-        if key is None:
-            key = jax.random.PRNGKey(0)  # unused by greedy; fixed for shape
+        ids, batch, prompt_len, key = prepare_generate(
+            prompt_ids, max_new_tokens, self.max_seq, sampling, key)
 
         ids_j = jnp.asarray(ids, dtype=jnp.int32)
         cache = gpt2.make_cache(self.config, batch, self.max_seq, self.dtype)

@@ -1,0 +1,219 @@
+"""Training subsystem: next-token LM loss + mesh-sharded optax train step.
+
+The reference is inference-only (SURVEY.md §2.2: no gradient logic exists
+anywhere in its ~500 LoC), so this subsystem has no counterpart to mirror —
+it is designed TPU-first from scratch:
+
+- the train step is ONE jitted program: forward (optionally rematerialized,
+  ``jax.checkpoint`` per block), backward, optimizer update;
+- distribution is pure GSPMD: parameters carry the Megatron tp layout and
+  batches the dp/sp layout from ``parallel.spmd``; XLA derives every
+  collective (gradient all-reduce over dp, activation collectives over
+  tp/sp) from the annotations — no hand-written communication;
+- optimizer state inherits each parameter's sharding, so Adam moments are
+  sharded exactly like their weights (no replicated-optimizer memory bloat).
+
+The manual pipeline-parallel training step (pp axis, explicit microbatch
+schedule + ppermute) lives in ``parallel.gpipe``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import gpt2
+from ..models.gpt2 import GPT2Config, Params
+from ..parallel import spmd
+
+
+def lm_loss(params: Params, ids: jnp.ndarray, config: GPT2Config,
+            remat: bool = False) -> jnp.ndarray:
+    """Mean next-token cross-entropy over ``ids`` [B, S] (S >= 2).
+
+    Logits for positions ``0..S-2`` predict tokens ``1..S-1``. The softmax
+    cross-entropy runs in float32 regardless of activation dtype.
+    """
+    logits = gpt2.forward(params, ids[:, :-1], config, remat=remat)
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), ids[:, 1:])
+    return jnp.mean(losses)
+
+
+@dataclasses.dataclass
+class TrainStep:
+    """A compiled train step bound to (config, optimizer, mesh).
+
+    ``init(params)`` shards params + builds matching-sharded optimizer
+    state; ``__call__(params, opt_state, ids)`` returns updated
+    ``(params, opt_state, loss)`` — one XLA program end to end.
+    """
+
+    config: GPT2Config
+    optimizer: optax.GradientTransformation
+    mesh: Optional[Mesh] = None
+    remat: bool = False
+
+    def __post_init__(self):
+        def step(params, opt_state, ids):
+            loss, grads = jax.value_and_grad(lm_loss)(
+                params, ids, self.config, self.remat)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        if self.mesh is None:
+            self._step = jax.jit(step)
+        else:
+            # Sharding in = sharding out for params/opt state: the update is
+            # elementwise, so XLA keeps everything resident; only the loss
+            # (and dp/tp grad all-reduces internally) crosses chips.
+            self._step = jax.jit(
+                step, in_shardings=None,
+                out_shardings=(None, None, spmd.replicated(self.mesh)))
+
+    def init(self, params: Params) -> Tuple[Params, Any]:
+        """Shard params per the mesh rules; init optimizer state likewise.
+
+        ``optimizer.init`` runs eagerly on purpose: eager ``zeros_like`` on
+        a sharded param yields identically sharded optimizer moments,
+        whereas under an unannotated ``jit`` the output sharding is not
+        guaranteed to follow.
+        """
+        if self.mesh is not None:
+            params = spmd.shard_params(params, self.mesh)
+        opt_state = self.optimizer.init(params)
+        return params, opt_state
+
+    def shard_batch(self, ids) -> jnp.ndarray:
+        ids = jnp.asarray(ids, dtype=jnp.int32)
+        if self.mesh is None:
+            return ids
+        return jax.device_put(
+            ids, NamedSharding(self.mesh, spmd.batch_pspec(self.mesh)))
+
+    def __call__(self, params, opt_state, ids):
+        return self._step(params, opt_state, ids)
+
+
+def gpipe_lm_loss(params: Params, ids: jnp.ndarray, config: GPT2Config,
+                  mesh: Mesh, n_microbatches: int,
+                  remat: bool = False) -> jnp.ndarray:
+    """LM loss with the blocks run pipeline-parallel (``parallel.gpipe``).
+
+    ``params`` uses the gpipe layout: ``wte``/``wpe``/``ln_f`` as usual
+    plus ``stacked_blocks`` (stage-major, sharded over ``pp``). Embed and
+    head run under plain GSPMD outside the manual pipeline program.
+    """
+    from ..parallel import gpipe  # local import: avoids a cycle at package init
+
+    h = gpt2.embed(params, ids[:, :-1], 0)
+    hm = gpipe.microbatch(h, n_microbatches)
+    hm = gpipe.gpipe_apply_blocks(params["stacked_blocks"], hm, config, mesh,
+                                  remat=remat)
+    h = gpipe.unmicrobatch(hm)
+    logits = gpt2.final_logits(params, h, config.layer_norm_epsilon)
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), ids[:, 1:])
+    return jnp.mean(losses)
+
+
+@dataclasses.dataclass
+class GPipeTrainStep:
+    """Pipeline-parallel train step: pp manual (GPipe schedule), dp/tp/sp
+    automatic — the full composition on one mesh, one jitted program.
+
+    ``init(params)`` converts a standard param pytree into the gpipe layout
+    (stage-major stacked blocks, equal stage sizes required) and shards it;
+    the optimizer state follows each leaf's sharding (eager init, see
+    ``TrainStep.init``).
+    """
+
+    config: GPT2Config
+    optimizer: optax.GradientTransformation
+    mesh: Mesh
+    n_microbatches: int = 4
+    remat: bool = False
+
+    def __post_init__(self):
+        if "pp" not in self.mesh.axis_names:
+            raise ValueError(f"mesh {self.mesh.axis_names} has no 'pp' axis")
+        if self.config.n_layer % self.mesh.shape["pp"]:
+            raise ValueError(
+                f"n_layer={self.config.n_layer} not divisible by "
+                f"pp={self.mesh.shape['pp']} (equal stages required)")
+
+        def step(params, opt_state, ids):
+            loss, grads = jax.value_and_grad(gpipe_lm_loss)(
+                params, ids, self.config, self.mesh, self.n_microbatches,
+                self.remat)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._step = jax.jit(
+            step, out_shardings=(None, None, spmd.replicated(self.mesh)))
+
+    def init(self, params: Params):
+        from ..parallel import gpipe, partition as P_
+
+        pp = self.mesh.shape["pp"]
+        specs = P_.make_stage_specs(
+            self.config.n_layer,
+            P_.balanced_boundaries(self.config.n_layer, pp))
+        stacked = P_.stack_stage_params(params, specs)
+        gp_params: Params = {
+            "wte": jax.device_put(params["wte"], spmd.replicated(self.mesh)),
+            "wpe": jax.device_put(params["wpe"], spmd.replicated(self.mesh)),
+            "ln_f": jax.device_put(params["ln_f"], spmd.replicated(self.mesh)),
+            "stacked_blocks": gpipe.shard_stacked_blocks(stacked, self.mesh),
+        }
+        opt_state = self.optimizer.init(gp_params)
+        return gp_params, opt_state
+
+    def shard_batch(self, ids) -> jnp.ndarray:
+        ids = jnp.asarray(ids, dtype=jnp.int32)
+        dp = "dp" if "dp" in self.mesh.axis_names else None
+        sp = "sp" if "sp" in self.mesh.axis_names else None
+        return jax.device_put(ids, NamedSharding(self.mesh, P(dp, sp)))
+
+    def __call__(self, params, opt_state, ids):
+        return self._step(params, opt_state, ids)
+
+
+def decay_mask(params: Params) -> Params:
+    """True for leaves that take weight decay: matmul kernels and the
+    embedding tables — never biases or LayerNorm scales (GPT-2 recipe).
+
+    Path-based, not ndim-based: stacked block biases are 2-D (``[L, d]``),
+    so shape alone cannot distinguish them from kernels.
+    """
+    def is_decay(path, _leaf) -> bool:
+        last = path[-1].key if hasattr(path[-1], "key") else path[-1]
+        return last in ("kernel", "wte", "wpe")
+
+    return jax.tree_util.tree_map_with_path(is_decay, params)
+
+
+def adamw(learning_rate: float = 1e-3, weight_decay: float = 0.01,
+          warmup_steps: int = 0, total_steps: Optional[int] = None,
+          grad_clip: float = 1.0) -> optax.GradientTransformation:
+    """The stock GPT training recipe: AdamW (decay masked off biases and
+    LayerNorms, see ``decay_mask``) + global-norm clip, optional linear
+    warmup and cosine decay."""
+    if total_steps is not None:
+        schedule: Any = optax.warmup_cosine_decay_schedule(
+            0.0, learning_rate, warmup_steps, total_steps)
+    elif warmup_steps:
+        schedule = optax.linear_schedule(0.0, learning_rate, warmup_steps)
+    else:
+        schedule = learning_rate
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(schedule, weight_decay=weight_decay, mask=decay_mask),
+    )
