@@ -163,10 +163,12 @@ class DecodeEngine:
         self.config = config
         self.max_seq = max_seq
         self.dtype = dtype
-        # The cache argument is donated in both programs: generate never
-        # reuses an input cache, and donation lets XLA update the two
-        # [L, B, H, max_seq, hd] buffers in place instead of doubling them.
-        self._prefill = jax.jit(self._prefill_impl, donate_argnums=(2,))
+        # Prefill allocates its cache *inside* the program (zeros are free
+        # under XLA and the layout matches the decode program exactly);
+        # decode donates the prefill-produced cache so the two
+        # [L, B, H, max_seq, hd] buffers update in place instead of
+        # doubling.
+        self._prefill = jax.jit(self._prefill_impl)
         # static args: number of decode steps and the sampling policy (both
         # change the traced program).
         self._decode = jax.jit(self._decode_impl, donate_argnums=(2,),
@@ -174,8 +176,10 @@ class DecodeEngine:
 
     # -- compiled programs ---------------------------------------------------
 
-    def _prefill_impl(self, params: Params, ids: jnp.ndarray, cache: KVCache
+    def _prefill_impl(self, params: Params, ids: jnp.ndarray
                       ) -> Tuple[jnp.ndarray, KVCache]:
+        cache = gpt2.make_cache(self.config, ids.shape[0], self.max_seq,
+                                self.dtype)
         logits, cache = gpt2.forward_with_cache(params, ids, self.config, cache)
         return logits[:, -1], cache
 
@@ -217,11 +221,10 @@ class DecodeEngine:
             prompt_ids, max_new_tokens, self.max_seq, sampling, key)
 
         ids_j = jnp.asarray(ids, dtype=jnp.int32)
-        cache = gpt2.make_cache(self.config, batch, self.max_seq, self.dtype)
 
         t0 = time.perf_counter()
         prefill_key, decode_key = jax.random.split(key)
-        last_logits, cache = self._prefill(self.params, ids_j, cache)
+        last_logits, cache = self._prefill(self.params, ids_j)
         first = select_token(last_logits, sampling, prefill_key)
         first.block_until_ready()
         t1 = time.perf_counter()

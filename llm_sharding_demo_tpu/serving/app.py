@@ -1,0 +1,218 @@
+"""HTTP serving surface — wire-compatible with the reference.
+
+Routes, schemas, role guards, and response shapes mirror reference
+server.py:116-210 exactly:
+
+- ``POST /forward``    {"input_ids": [int]}        -> {"hidden_states": [[[f]]]}
+- ``POST /forward_b``  {"hidden_states": [[[f]]]}  -> {"logits": [[[f]]]}
+- ``POST /generate``   {"prompt", "max_new_tokens"} -> {"generated": str}
+- role guards return HTTP 200 with ``{"error": "This instance is not
+  ..."}`` — preserved verbatim for wire parity even though it's a
+  reference quirk (SURVEY.md §2.3.5: its coordinator's raise_for_status
+  never fires on misrouting);
+
+plus what the reference lacks:
+
+- ``GET /healthz`` readiness/liveness (SURVEY.md §5 "Failure detection":
+  the reference ships no probes, so k8s cannot tell a wedged pod from a
+  healthy one);
+- N-stage local dispatch: the common-case pod owns its TPU devices and
+  runs the whole pipeline on-device (``parallel.pipeline``); ``DISPATCH=
+  remote`` reproduces the reference's three-pod HTTP topology for
+  drop-in k8s compatibility (coordinator POSTs to shard services per
+  token, reference server.py:169-181);
+- request-level decode controls: the reference hard-codes
+  temperature=0.6/top_k=40 sampling (server.py:187-205); here that is the
+  default, with optional ``mode="greedy"`` (BASELINE.json's parity mode)
+  and an explicit ``seed`` for reproducibility.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from pydantic import BaseModel
+
+from ..models import gpt2
+from ..parallel import partition as P_
+from ..parallel.pipeline import PipelineRunner
+from ..runtime.engine import REF_TEMPERATURE, REF_TOP_K, SamplingConfig
+from ..utils.config import ServingConfig, from_env
+from . import loader
+from .http import JSONApp
+from .tokenizer import get_tokenizer
+
+log = logging.getLogger(__name__)
+
+
+class InputIDs(BaseModel):
+    input_ids: List[int]
+
+
+class HiddenStates(BaseModel):
+    hidden_states: list  # nested [batch, seq, hidden]
+
+
+class GenerateReq(BaseModel):
+    prompt: str
+    max_new_tokens: int = 20
+    # extensions beyond the reference schema (defaults reproduce its
+    # behavior: temperature-0.6/top-k-40 sampling)
+    mode: str = "sample"
+    temperature: float = REF_TEMPERATURE
+    top_k: int = REF_TOP_K
+    seed: Optional[int] = None
+
+
+def create_app(cfg: Optional[ServingConfig] = None,
+               model=None, tokenizer=None) -> JSONApp:
+    """Build the app. ``model=(config, params)`` / ``tokenizer`` injectable
+    for tests; by default resolved via ``serving.loader`` / HF-or-byte
+    tokenizer."""
+    cfg = cfg or from_env()
+    config, params = model if model is not None else loader.resolve_model(cfg)
+    tokenizer = tokenizer or get_tokenizer(cfg.model_id)
+
+    n_layer = config.n_layer
+    for b in cfg.boundaries:
+        if not 1 <= b <= n_layer - 1:
+            raise ValueError(
+                f"boundary {b} out of range for n_layer={n_layer}")
+
+    # Build only what this role serves (the reference loads the full model
+    # into every pod regardless of role, server.py:108-110 — the exact
+    # memory waste this gate avoids):
+    # - coordinator + local dispatch: the N-stage pipeline for /generate;
+    # - roles a/b: their half of the two-stage compat view for /forward +
+    #   /forward_b — the reference's ShardA/ShardB contract
+    #   (server.py:51-105) regardless of how many stages /generate uses;
+    # - coordinator + remote dispatch: nothing (shards hold the weights).
+    runner = None
+    if cfg.shard_role == "coordinator" and cfg.dispatch == "local":
+        runner = PipelineRunner(params, config, list(cfg.boundaries),
+                                max_seq=cfg.max_seq)
+    compat_specs = P_.make_stage_specs(n_layer, [cfg.split_at])
+    compat_params = {
+        role: (P_.extract_stage_params(params, compat_specs[i])
+               if cfg.shard_role == role else None)
+        for i, role in enumerate(("a", "b"))
+    }
+
+    app = JSONApp(title="llm-sharding-demo-tpu", version="0.1.0")
+
+    @app.get("/healthz")
+    def healthz():
+        return {
+            "status": "ok",
+            "role": cfg.shard_role,
+            "model": cfg.model_id,
+            "n_stages": len(cfg.boundaries) + 1,
+            "dispatch": cfg.dispatch,
+            "devices": [str(d) for d in jax.devices()],
+        }
+
+    @app.post("/forward")
+    def forward_a(req: InputIDs):
+        if cfg.shard_role != "a":
+            return {"error": "This instance is not shard A."}
+        ids = jnp.asarray([req.input_ids], dtype=jnp.int32)
+        hidden, _ = P_.stage_apply(compat_params["a"], compat_specs[0],
+                                   config, ids)
+        return {"hidden_states": np.asarray(hidden).tolist()}
+
+    @app.post("/forward_b")
+    def forward_b(req: HiddenStates):
+        if cfg.shard_role != "b":
+            return {"error": "This instance is not shard B."}
+        hidden = jnp.asarray(np.asarray(req.hidden_states, dtype=np.float32))
+        logits, _ = P_.stage_apply(compat_params["b"], compat_specs[1],
+                                   config, hidden)
+        return {"logits": np.asarray(logits).tolist()}
+
+    def _generate_local(req: GenerateReq, prompt_ids: List[int]) -> List[int]:
+        sampling = (SamplingConfig(mode="greedy") if req.mode == "greedy"
+                    else SamplingConfig(mode="sample",
+                                        temperature=req.temperature,
+                                        top_k=req.top_k))
+        seed = req.seed if req.seed is not None else int(
+            np.random.default_rng().integers(2 ** 31))
+        result = runner.generate(np.asarray(prompt_ids),
+                                 max_new_tokens=req.max_new_tokens,
+                                 sampling=sampling,
+                                 key=jax.random.PRNGKey(seed))
+        return [int(t) for t in result.tokens[0]]
+
+    def _generate_remote(req: GenerateReq, prompt_ids: List[int]) -> List[int]:
+        """Reference-topology decode: per token, POST the full sequence to
+        shard A, relay hidden states to shard B, sample host-side
+        (reference server.py:169-206). O(n²) and JSON-lossy by design —
+        it exists for wire-level drop-in compatibility, not speed."""
+        import requests
+
+        ids = list(prompt_ids)
+        rng = np.random.default_rng(req.seed)
+        for _ in range(req.max_new_tokens):
+            resp = requests.post(f"{cfg.shard_a_url}/forward",
+                                 json={"input_ids": ids}, timeout=30)
+            resp.raise_for_status()
+            hidden = resp.json()["hidden_states"]
+            resp2 = requests.post(f"{cfg.shard_b_url}/forward_b",
+                                  json={"hidden_states": hidden}, timeout=30)
+            resp2.raise_for_status()
+            logits = np.asarray(resp2.json()["logits"])[0, -1]
+            if req.mode == "greedy":
+                ids.append(int(np.argmax(logits)))
+            else:
+                scaled = logits / req.temperature
+                top_idx = np.argpartition(scaled, -req.top_k)[-req.top_k:]
+                probs = np.exp(scaled[top_idx] - scaled[top_idx].max())
+                probs /= probs.sum()
+                ids.append(int(rng.choice(top_idx, p=probs)))
+        return ids
+
+    @app.post("/generate")
+    def generate(req: GenerateReq):
+        if cfg.shard_role != "coordinator":
+            return {"error": "This instance is not coordinator."}
+        if req.max_new_tokens < 1:
+            return {"error": "max_new_tokens must be >= 1"}
+        prompt_ids = tokenizer.encode(req.prompt)
+        if not prompt_ids:
+            return {"error": "prompt tokenized to zero tokens"}
+        if len(prompt_ids) + req.max_new_tokens > cfg.max_seq:
+            return {"error": f"prompt ({len(prompt_ids)} tokens) + "
+                             f"max_new_tokens ({req.max_new_tokens}) exceeds "
+                             f"max_seq ({cfg.max_seq})"}
+        if req.mode not in ("sample", "greedy"):
+            return {"error": f"unknown mode {req.mode!r}"}
+        if req.mode == "sample":
+            if req.temperature <= 0:
+                return {"error": "temperature must be > 0"}
+            if not 1 <= req.top_k <= config.vocab_size:
+                return {"error": f"top_k must be in [1, {config.vocab_size}]"}
+        if cfg.dispatch == "remote":
+            ids = _generate_remote(req, prompt_ids)
+        else:
+            ids = _generate_local(req, prompt_ids)
+        try:
+            text = tokenizer.decode(ids, skip_special_tokens=True)
+        except TypeError:  # ByteTokenizer takes no HF kwargs
+            text = tokenizer.decode(ids)
+        return {"generated": text}
+
+    return app
+
+
+# Lazy module attribute so `from ...serving.app import app` builds the
+# env-configured app on first access (the reference builds its app at
+# import, server.py:129), while importing create_app for tests stays free.
+# Cached: repeated access must not re-load the model.
+def __getattr__(name: str):
+    if name == "app":
+        globals()["app"] = create_app()
+        return globals()["app"]
+    raise AttributeError(name)

@@ -1,0 +1,154 @@
+"""Minimal JSON-over-HTTP framework on the Python stdlib.
+
+The reference serves FastAPI/uvicorn (reference Dockerfile:19). This
+image bakes neither, so the serving stack is self-contained: a route
+table with pydantic request validation (pydantic IS available), a
+threaded ``http.server`` runner for real serving, and an in-process
+``TestClient`` with a requests-like API so wire-compat tests exercise
+exactly the dispatch path production uses — no sockets needed.
+
+Semantics intentionally mirror the slice of FastAPI the reference relies
+on: POST handlers take one validated body model, handlers return a dict
+serialized as JSON, unvalidatable bodies get HTTP 422, unknown routes
+404. Role guards returning 200 + ``{"error": ...}`` therefore behave
+byte-identically to the reference (server.py:135,147,157).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional, Tuple, get_type_hints
+
+import pydantic
+
+
+class JSONApp:
+    """Route table: (method, path) -> handler.
+
+    POST handlers may annotate a single parameter with a pydantic
+    BaseModel subclass; the body is validated into it (422 on failure).
+    GET handlers take no arguments. Handlers return a JSON-serializable
+    dict, or ``(status_code, dict)`` to override the 200 default.
+    """
+
+    def __init__(self, title: str = "", version: str = ""):
+        self.title = title
+        self.version = version
+        self._routes: Dict[Tuple[str, str], Callable] = {}
+
+    def get(self, path: str):
+        return self._register("GET", path)
+
+    def post(self, path: str):
+        return self._register("POST", path)
+
+    def _register(self, method: str, path: str):
+        def deco(fn):
+            self._routes[(method, path)] = fn
+            return fn
+        return deco
+
+    def handle(self, method: str, path: str,
+               body: Optional[bytes]) -> Tuple[int, Dict[str, Any]]:
+        fn = self._routes.get((method, path))
+        if fn is None:
+            if any(p == path for (_, p) in self._routes):
+                return 405, {"detail": "Method Not Allowed"}
+            return 404, {"detail": "Not Found"}
+
+        args = []
+        hints = {k: v for k, v in get_type_hints(fn).items() if k != "return"}
+        if hints:
+            model = next(iter(hints.values()))
+            if isinstance(model, type) and issubclass(model, pydantic.BaseModel):
+                try:
+                    payload = json.loads(body or b"null")
+                except json.JSONDecodeError:
+                    return 422, {"detail": "invalid JSON body"}
+                try:
+                    args.append(model.model_validate(payload))
+                except pydantic.ValidationError as e:
+                    return 422, {"detail": json.loads(e.json())}
+        try:
+            result = fn(*args)
+        except Exception as e:  # uncaught handler error -> 500, like uvicorn
+            return 500, {"detail": f"{type(e).__name__}: {e}"}
+        if (isinstance(result, tuple) and len(result) == 2
+                and isinstance(result[0], int)):
+            return result
+        return 200, result
+
+
+class Response:
+    """requests-compatible view of a handled call."""
+
+    def __init__(self, status_code: int, payload: Dict[str, Any]):
+        self.status_code = status_code
+        self._payload = payload
+        self.text = json.dumps(payload)
+
+    def json(self) -> Dict[str, Any]:
+        return self._payload
+
+    def raise_for_status(self) -> None:
+        if self.status_code >= 400:
+            raise RuntimeError(f"HTTP {self.status_code}: {self.text}")
+
+
+class TestClient:
+    """In-process client running the exact server dispatch path."""
+
+    __test__ = False  # not a pytest collection target
+
+    def __init__(self, app: JSONApp):
+        self.app = app
+
+    def get(self, path: str) -> Response:
+        return Response(*self.app.handle("GET", path, None))
+
+    def post(self, path: str, json: Any = None) -> Response:  # noqa: A002
+        import json as _json
+        return Response(*self.app.handle(
+            "POST", path, _json.dumps(json).encode()))
+
+
+def serve(app: JSONApp, host: str = "0.0.0.0", port: int = 5000,
+          block: bool = True) -> ThreadingHTTPServer:
+    """Serve over real sockets (threaded, one request per thread).
+
+    With ``block=False`` the server runs on a daemon thread and is
+    returned so callers (tests, embedders) can ``.shutdown()`` it.
+    """
+
+    class Handler(BaseHTTPRequestHandler):
+        def _dispatch(self, method: str):
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else None
+            status, payload = app.handle(method, self.path, body)
+            data = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802 (stdlib naming)
+            self._dispatch("GET")
+
+        def do_POST(self):  # noqa: N802
+            self._dispatch("POST")
+
+        def log_message(self, fmt, *args):  # route through logging, quieter
+            import logging
+            logging.getLogger("llm_sharding_demo_tpu.serving").info(
+                "%s %s", self.address_string(), fmt % args)
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    if block:
+        server.serve_forever()
+        return server
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server

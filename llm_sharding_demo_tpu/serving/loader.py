@@ -1,0 +1,90 @@
+"""Model resolution for serving: checkpoint -> HF cache/hub -> random init.
+
+Replaces the reference's import-time ``AutoModelForCausalLM.from_pretrained``
+in every pod (reference server.py:40-42) with an explicit resolution order:
+
+1. ``CHECKPOINT_DIR`` set → Orbax restore (no hub, no torch, the
+   production path);
+2. the HF model is loadable (cached or hub reachable) → convert through
+   ``models.hf_convert`` (torch imported only here, never on the TPU
+   serving path);
+3. otherwise → random init from the named architecture (keeps the service
+   and its wire contract alive in air-gapped test environments; logged
+   loudly since generations are untrained noise — which is also true of
+   the reference's default tiny-gpt2, README.md:135).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Tuple
+
+import jax
+
+from ..models import gpt2
+from ..models.gpt2 import GPT2Config, Params
+from ..utils import checkpoint as ckpt
+from ..utils.config import ServingConfig
+
+log = logging.getLogger(__name__)
+
+
+def hub_reachable(timeout: float = 1.0) -> bool:
+    """Fast offline detection: can we even resolve the HF hub host?
+
+    Without this, air-gapped startups sit through huggingface_hub's
+    5-retry backoff (~30 s) before falling back. An unresolvable host is
+    a definitive "offline"; resolvable-but-down still goes the slow path.
+    """
+    import os
+    import socket
+    prior = socket.getdefaulttimeout()
+    try:
+        socket.setdefaulttimeout(timeout)
+        socket.getaddrinfo("huggingface.co", 443)
+        return True
+    except OSError:
+        # Belt and braces: transformers' adapter(PEFT) probe ignores
+        # local_files_only in some versions, so force hub-offline mode
+        # process-wide once we know the hub is unreachable.
+        os.environ["HF_HUB_OFFLINE"] = "1"
+        return False
+    finally:
+        socket.setdefaulttimeout(prior)
+
+# HF model ids -> architecture configs for the random-init fallback.
+_FALLBACK_CONFIGS = {
+    "sshleifer/tiny-gpt2": gpt2.CONFIGS["tiny-gpt2"],
+    "gpt2": gpt2.CONFIGS["gpt2"],
+    "gpt2-medium": gpt2.CONFIGS["gpt2-medium"],
+}
+
+
+def resolve_model(cfg: ServingConfig) -> Tuple[GPT2Config, Params]:
+    if cfg.checkpoint_dir:
+        log.info("loading checkpoint from %s", cfg.checkpoint_dir)
+        return ckpt.load(cfg.checkpoint_dir)
+
+    try:
+        # reachability check FIRST: it sets HF_HUB_OFFLINE before
+        # huggingface_hub snapshots the env at import time
+        offline = not hub_reachable()
+        from transformers import AutoModelForCausalLM
+
+        from ..models.hf_convert import params_from_hf_model
+        model = AutoModelForCausalLM.from_pretrained(
+            cfg.model_id, local_files_only=offline)
+        model.eval()
+        log.info("converted HF model %s", cfg.model_id)
+        return params_from_hf_model(model)
+    except Exception as e:  # hub unreachable / not cached / not a GPT-2
+        if cfg.model_id not in _FALLBACK_CONFIGS:
+            raise RuntimeError(
+                f"cannot load {cfg.model_id!r}: no checkpoint dir, HF load "
+                f"failed ({e}), and no fallback architecture is registered"
+            ) from e
+        config = _FALLBACK_CONFIGS[cfg.model_id]
+        log.warning(
+            "HF load of %s failed (%s); using RANDOM-INIT %s weights — "
+            "output will be untrained noise", cfg.model_id, e, config)
+        return config, gpt2.init_params(config, jax.random.PRNGKey(0))

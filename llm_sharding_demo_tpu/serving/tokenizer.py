@@ -1,0 +1,53 @@
+"""Tokenizer resolution with an offline fallback.
+
+The reference requires HF hub access in every pod for
+``AutoTokenizer.from_pretrained`` at import (reference server.py:40). Here
+the hub is optional: if the named tokenizer can't be loaded (air-gapped
+TPU pod, no cache), a deterministic byte-level fallback keeps the
+/generate surface functional — ids 0-255 are raw bytes. Model quality
+through the fallback is meaningless for a GPT-2 checkpoint (different
+vocab), but wire behavior, shapes, and tests don't depend on the hub.
+"""
+
+from __future__ import annotations
+
+from typing import List, Protocol
+
+
+class Tokenizer(Protocol):
+    def encode(self, text: str) -> List[int]: ...
+    def decode(self, ids: List[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 bytes <-> ids 0..255; unknown (>=256) ids decode as U+FFFD."""
+
+    vocab_size = 256
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: List[int]) -> str:
+        out = []
+        run: List[int] = []  # decode contiguous byte runs together (UTF-8)
+        for i in ids:
+            if 0 <= i < 256:
+                run.append(i)
+            else:
+                out.append(bytes(run).decode("utf-8", errors="replace"))
+                out.append("�")  # visible marker for out-of-range ids
+                run = []
+        out.append(bytes(run).decode("utf-8", errors="replace"))
+        return "".join(out)
+
+
+def get_tokenizer(model_id: str) -> Tokenizer:
+    """HF tokenizer when loadable (cache/hub), else ``ByteTokenizer``."""
+    try:
+        from .loader import hub_reachable
+        offline = not hub_reachable()  # before transformers import: sets
+        from transformers import AutoTokenizer  # HF_HUB_OFFLINE in time
+        return AutoTokenizer.from_pretrained(
+            model_id, local_files_only=offline)
+    except Exception:
+        return ByteTokenizer()

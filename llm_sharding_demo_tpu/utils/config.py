@@ -1,0 +1,116 @@
+"""Typed runtime configuration with env-var overrides.
+
+The reference's whole config system is six env vars read at import time
+with no validation (reference server.py:20-25) — which is how the shipped
+SPLIT_AT mismatch (shard A splitting at 2, shard B at 1 — SURVEY.md
+§2.3.1) made it to "production". This module keeps the same env names so
+the reference's k8s manifests (k8s/*-deployment.yaml env blocks) drive the
+rebuild unchanged, but parses them into one validated dataclass:
+
+- ``SPLIT_AT`` / ``BOUNDARIES`` produce a single partition used by every
+  role — per-role disagreement is impossible by construction;
+- unknown roles, bad boundaries, and out-of-range values fail at startup,
+  not mid-request.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional
+
+VALID_ROLES = ("coordinator", "a", "b")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Everything the serving process needs, resolved once at startup."""
+
+    model_id: str = "sshleifer/tiny-gpt2"
+    shard_role: str = "coordinator"
+    boundaries: tuple = (1,)
+    shard_a_service: str = "llm-shard-a"
+    shard_b_service: str = "llm-shard-b"
+    shard_port: int = 5000
+    checkpoint_dir: Optional[str] = None
+    max_seq: int = 512
+    # "local": the common case — this process owns the devices and runs the
+    # whole pipeline. "remote": reference-topology compat — the coordinator
+    # POSTs to shard-a/shard-b services over HTTP (reference
+    # server.py:172-181).
+    dispatch: str = "local"
+
+    def __post_init__(self):
+        if self.shard_role not in VALID_ROLES:
+            raise ValueError(
+                f"SHARD_ROLE={self.shard_role!r} not in {VALID_ROLES}")
+        if self.dispatch not in ("local", "remote"):
+            raise ValueError(f"DISPATCH={self.dispatch!r} not local|remote")
+        if self.shard_port < 1 or self.shard_port > 65535:
+            raise ValueError(f"SHARD_PORT={self.shard_port} out of range")
+        if not self.boundaries or list(self.boundaries) != sorted(
+                set(self.boundaries)):
+            raise ValueError(
+                f"boundaries {self.boundaries!r} must be non-empty, "
+                "strictly increasing (single source of truth for ALL roles)")
+        if self.max_seq < 2:
+            raise ValueError(f"max_seq={self.max_seq} too small")
+
+    @property
+    def split_at(self) -> int:
+        """Two-stage compat view (the reference's SPLIT_AT)."""
+        return self.boundaries[0]
+
+    def _service_url(self, service: str) -> str:
+        # a service name already carrying a port ("127.0.0.1:5001") wins
+        # over SHARD_PORT — lets tests and non-k8s deploys point anywhere
+        host_port = service if ":" in service else f"{service}:{self.shard_port}"
+        return f"http://{host_port}"
+
+    @property
+    def shard_a_url(self) -> str:
+        return self._service_url(self.shard_a_service)
+
+    @property
+    def shard_b_url(self) -> str:
+        return self._service_url(self.shard_b_service)
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError as e:
+        raise ValueError(f"{name}={raw!r} is not an integer") from e
+
+
+def from_env() -> ServingConfig:
+    """Read the reference's env contract (+ extensions) into a config.
+
+    ``BOUNDARIES`` (comma-separated block indices, e.g. ``"3,6,9"``)
+    generalizes ``SPLIT_AT`` to N stages; if unset, ``SPLIT_AT`` (default 1,
+    as in reference server.py:22) defines the single two-stage split used
+    by every role.
+    """
+    raw_bounds = os.environ.get("BOUNDARIES", "").strip()
+    if raw_bounds:
+        try:
+            boundaries = tuple(int(x) for x in raw_bounds.split(","))
+        except ValueError as e:
+            raise ValueError(f"BOUNDARIES={raw_bounds!r} must be "
+                             "comma-separated integers") from e
+    else:
+        boundaries = (_env_int("SPLIT_AT", 1),)
+    return ServingConfig(
+        model_id=os.environ.get("MODEL_ID", "sshleifer/tiny-gpt2"),
+        shard_role=os.environ.get("SHARD_ROLE", "coordinator"),
+        boundaries=boundaries,
+        shard_a_service=os.environ.get("SHARD_A_SERVICE", "llm-shard-a"),
+        shard_b_service=os.environ.get("SHARD_B_SERVICE", "llm-shard-b"),
+        shard_port=_env_int("SHARD_PORT", 5000),
+        checkpoint_dir=os.environ.get("CHECKPOINT_DIR") or None,
+        max_seq=_env_int("MAX_SEQ", 512),
+        dispatch=os.environ.get("DISPATCH", "local"),
+    )

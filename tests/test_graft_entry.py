@@ -1,0 +1,30 @@
+"""The driver contract: entry() compiles; dryrun_multichip runs on a
+forced-host mesh for several device counts (2, 4, 8)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import __graft_entry__ as ge
+
+
+def test_entry_jits():
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (1,)
+    assert out.dtype == jnp.int32
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_dryrun_multichip(n):
+    ge.dryrun_multichip(n)
+
+
+def test_mesh_shape_covers_devices():
+    for n in (1, 2, 4, 8, 16, 32):
+        shape = ge._mesh_shape(n)
+        total = 1
+        for v in shape.values():
+            total *= v
+        assert total == n, (n, shape)
+    assert ge._mesh_shape(16)["sp"] == 2

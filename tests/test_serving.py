@@ -1,0 +1,132 @@
+"""Wire-compat tests of the serving surface (SURVEY.md §4 item 3).
+
+Schemas, role-guard behavior, and response shapes are pinned against the
+reference contract (reference server.py:116-210): /forward returns
+[1, seq, hidden]; /forward_b returns [1, seq, vocab]; guards answer HTTP
+200 with {"error": ...}; /generate returns {"generated": str}.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from llm_sharding_demo_tpu.models import gpt2
+from llm_sharding_demo_tpu.serving.app import create_app
+from llm_sharding_demo_tpu.serving.http import TestClient
+from llm_sharding_demo_tpu.serving.tokenizer import ByteTokenizer
+from llm_sharding_demo_tpu.utils.config import ServingConfig
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = gpt2.GPT2Config(vocab_size=256, n_positions=64, n_embd=32,
+                             n_layer=4, n_head=4)
+    params = gpt2.init_params(config, jax.random.PRNGKey(0))
+    return config, params
+
+
+def make_client(model, role, **kw):
+    cfg = ServingConfig(model_id="test", shard_role=role, max_seq=64,
+                        boundaries=kw.pop("boundaries", (2,)), **kw)
+    app = create_app(cfg, model=model, tokenizer=ByteTokenizer())
+    return TestClient(app)
+
+
+def test_healthz(model):
+    client = make_client(model, "coordinator")
+    r = client.get("/healthz")
+    assert r.status_code == 200
+    body = r.json()
+    assert body["status"] == "ok"
+    assert body["role"] == "coordinator"
+    assert body["n_stages"] == 2
+
+
+def test_role_guards_match_reference(model):
+    """Guards answer 200 + {"error": ...} (reference server.py:135,147,157)."""
+    coord = make_client(model, "coordinator")
+    r = coord.post("/forward", json={"input_ids": [1, 2, 3]})
+    assert r.status_code == 200
+    assert r.json() == {"error": "This instance is not shard A."}
+    r = coord.post("/forward_b", json={"hidden_states": [[[0.0]]]})
+    assert r.json() == {"error": "This instance is not shard B."}
+    shard_a = make_client(model, "a")
+    r = shard_a.post("/generate", json={"prompt": "hi"})
+    assert r.json() == {"error": "This instance is not coordinator."}
+
+
+def test_forward_shapes_and_composition(model):
+    """/forward ∘ /forward_b ≡ unsplit forward (the parity the reference's
+    shipped config breaks, SURVEY.md §2.3.1)."""
+    config, params = model
+    ids = [5, 17, 33, 2]
+    a = make_client(model, "a")
+    r = a.post("/forward", json={"input_ids": ids})
+    hidden = r.json()["hidden_states"]
+    assert np.asarray(hidden).shape == (1, 4, config.n_embd)
+
+    b = make_client(model, "b")
+    r2 = b.post("/forward_b", json={"hidden_states": hidden})
+    logits = np.asarray(r2.json()["logits"])
+    assert logits.shape == (1, 4, config.vocab_size)
+
+    full = gpt2.forward(params, np.asarray([ids]), config)
+    # fp32 JSON round trip: decimal text loses a few ulps
+    np.testing.assert_allclose(logits, np.asarray(full), atol=1e-4, rtol=1e-3)
+
+
+def test_generate_greedy_deterministic(model):
+    client = make_client(model, "coordinator")
+    r1 = client.post("/generate", json={"prompt": "Hi, ",
+                                        "max_new_tokens": 6,
+                                        "mode": "greedy"})
+    r2 = client.post("/generate", json={"prompt": "Hi, ",
+                                        "max_new_tokens": 6,
+                                        "mode": "greedy"})
+    assert r1.status_code == 200
+    assert r1.json() == r2.json()
+    assert isinstance(r1.json()["generated"], str)
+    assert r1.json()["generated"].startswith("Hi, ")
+
+
+def test_generate_sample_seeded(model):
+    client = make_client(model, "coordinator")
+    body = {"prompt": "abc", "max_new_tokens": 5, "seed": 7}
+    assert (client.post("/generate", json=body).json()
+            == client.post("/generate", json=body).json())
+
+
+def test_generate_validation_errors(model):
+    client = make_client(model, "coordinator")
+    r = client.post("/generate", json={"prompt": "x", "max_new_tokens": 999})
+    assert "exceeds max_seq" in r.json()["error"]
+    r = client.post("/generate", json={"prompt": "", "max_new_tokens": 2})
+    assert "zero tokens" in r.json()["error"]
+    r = client.post("/generate", json={"prompt": "x", "mode": "banana"})
+    assert "unknown mode" in r.json()["error"]
+
+
+def test_four_stage_generate(model):
+    client = make_client(model, "coordinator", boundaries=(1, 2, 3))
+    r = client.post("/generate", json={"prompt": "hey", "max_new_tokens": 4,
+                                       "mode": "greedy"})
+    assert r.status_code == 200
+    # 4-stage pipeline must agree with the 2-stage one (greedy)
+    two = make_client(model, "coordinator")
+    r2 = two.post("/generate", json={"prompt": "hey", "max_new_tokens": 4,
+                                     "mode": "greedy"})
+    assert r.json() == r2.json()
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="SHARD_ROLE"):
+        ServingConfig(shard_role="chef")
+    with pytest.raises(ValueError, match="strictly increasing"):
+        ServingConfig(boundaries=(3, 3))
+    with pytest.raises(ValueError, match="boundary 99 out of range"):
+        make_client((gpt2.GPT2Config(vocab_size=16, n_positions=8,
+                                     n_embd=4, n_layer=2, n_head=2),
+                     gpt2.init_params(gpt2.GPT2Config(
+                         vocab_size=16, n_positions=8, n_embd=4,
+                         n_layer=2, n_head=2), jax.random.PRNGKey(0))),
+                    "coordinator", boundaries=(99,))
