@@ -1,0 +1,22 @@
+# TPU-native rebuild of the reference's image (reference Dockerfile:1-19):
+# same one-image/three-roles pattern, role selected by SHARD_ROLE env
+# (reference server.py:21), but serving runs our stdlib HTTP stack via
+# `python -m llm_sharding_demo_tpu.serving` instead of uvicorn, and the
+# base image carries the JAX TPU stack instead of CPU torch.
+FROM python:3.12-slim
+
+WORKDIR /app
+
+# TPU wheels: jax[tpu] pulls libtpu; transformers/torch only needed for
+# one-time HF checkpoint conversion (tools/convert_hf.py) — serving pods
+# restore Orbax checkpoints and never import torch.
+COPY requirements.txt .
+RUN pip install --no-cache-dir -r requirements.txt
+
+COPY llm_sharding_demo_tpu ./llm_sharding_demo_tpu
+COPY tools ./tools
+
+ENV SHARD_PORT=5000
+EXPOSE 5000
+
+CMD ["python", "-m", "llm_sharding_demo_tpu.serving"]

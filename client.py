@@ -1,0 +1,50 @@
+"""Client for the /generate endpoint — the notebook client, as a module.
+
+Equivalent of the reference notebook's ``generate_text`` cell
+(notebook.ipynb cell a03cb3af: POST to the port-forwarded coordinator,
+return the JSON on 200). Differences: errors raise instead of returning a
+string that callers could mistake for model output (the reference's
+mixed-return quirk, SURVEY.md §3.5), and the decode controls our server
+adds (mode/seed) are exposed.
+
+Usage:
+    from client import generate_text
+    generate_text("Hi, ", max_new_tokens=20)
+    generate_text("Hi, ", mode="greedy", base_url="http://host:30007")
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import requests
+
+
+def generate_text(prompt: str, max_new_tokens: int = 20,
+                  base_url: str = "http://127.0.0.1:5000",
+                  mode: str = "sample", seed: Optional[int] = None,
+                  timeout: float = 120.0) -> str:
+    body = {"prompt": prompt, "max_new_tokens": max_new_tokens, "mode": mode}
+    if seed is not None:
+        body["seed"] = seed
+    resp = requests.post(f"{base_url}/generate", json=body, timeout=timeout)
+    resp.raise_for_status()
+    payload = resp.json()
+    if "error" in payload:
+        raise RuntimeError(f"server rejected request: {payload['error']}")
+    return payload["generated"]
+
+
+if __name__ == "__main__":
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("prompt")
+    parser.add_argument("--max-new-tokens", type=int, default=20)
+    parser.add_argument("--url", default="http://127.0.0.1:5000")
+    parser.add_argument("--mode", default="sample",
+                        choices=("sample", "greedy"))
+    parser.add_argument("--seed", type=int, default=None)
+    args = parser.parse_args()
+    print(generate_text(args.prompt, args.max_new_tokens, args.url,
+                        args.mode, args.seed))

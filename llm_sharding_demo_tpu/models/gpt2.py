@@ -46,6 +46,10 @@ class GPT2Config:
     n_layer: int = 12
     n_head: int = 12
     layer_norm_epsilon: float = 1e-5
+    # "xla": fused einsum attention (default; the only impl for cached
+    # decode). "pallas": Mosaic kernel (ops.flash_attention) on the
+    # no-cache forward path — training forwards and compat endpoints.
+    attention_impl: str = "xla"
 
     @property
     def head_dim(self) -> int:
@@ -55,6 +59,9 @@ class GPT2Config:
         if self.n_embd % self.n_head != 0:
             raise ValueError(
                 f"n_embd={self.n_embd} not divisible by n_head={self.n_head}")
+        if self.attention_impl not in ("xla", "pallas"):
+            raise ValueError(
+                f"attention_impl={self.attention_impl!r} not xla|pallas")
 
 
 # Named configs for the BASELINE.json measurement matrix. "tiny-gpt2" matches
@@ -130,7 +137,8 @@ def embed(params: Params, input_ids: jnp.ndarray,
 
 def _block(block_params: Params, h: jnp.ndarray, n_head: int, eps: float,
            cache_k: Optional[jnp.ndarray], cache_v: Optional[jnp.ndarray],
-           offset) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
+           offset, attn_impl: str = "xla",
+           ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
     """One pre-LN transformer block; optionally reads/writes a KV cache slice."""
     a = layer_norm(h, block_params["ln_1"]["scale"], block_params["ln_1"]["bias"], eps)
     qkv = linear(a, block_params["attn"]["c_attn"]["kernel"],
@@ -138,7 +146,12 @@ def _block(block_params: Params, h: jnp.ndarray, n_head: int, eps: float,
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q, k, v = (split_heads(x, n_head) for x in (q, k, v))
     if cache_k is None:
-        attn_out = causal_attention(q, k, v, q_offset=offset)
+        if attn_impl == "pallas":
+            from ..ops.flash_attention import flash_attention  # lazy import
+            attn_out = flash_attention(
+                q, k, v, interpret=jax.default_backend() != "tpu")
+        else:
+            attn_out = causal_attention(q, k, v, q_offset=offset)
         new_ck = new_cv = None
     else:
         attn_out, new_ck, new_cv = cached_attention(q, k, v, cache_k, cache_v, offset)
@@ -173,7 +186,8 @@ def apply_blocks(blocks: Params, h: jnp.ndarray, config: GPT2Config,
 
     if cache is None:
         def body(carry, layer_params):
-            out, _, _ = _block(layer_params, carry, n_head, eps, None, None, 0)
+            out, _, _ = _block(layer_params, carry, n_head, eps, None, None,
+                               0, config.attention_impl)
             return out, None
 
         if remat:

@@ -1,0 +1,117 @@
+"""Pallas TPU kernel for causal attention (no-cache path).
+
+The MXU-shaped hot op behind training forwards, the /forward compat
+endpoint, and parity forwards. One kernel instance handles one
+(batch·head, q-block) grid cell: it streams its Q block against the full
+K/V rows resident in VMEM — for GPT-2's 1024-position ceiling, K/V of
+[1024, 64] fp32 is 256 KB/head, far under the ~16 MB VMEM budget, so the
+full-row softmax needs no online rescaling (ring/blockwise softmax exists
+separately in ``ops.ring_attention`` for sequence-sharded long context).
+
+Scores and softmax run in float32 regardless of input dtype; the P·V
+contraction returns the input dtype. Numerics match ``ops.attention.
+causal_attention`` to fp32 tolerance, which the tests pin (interpret mode
+on CPU; the same kernel lowers to Mosaic on a real TPU).
+
+Used when ``GPT2Config.attention_impl == "pallas"``; the XLA einsum path
+stays the default and the only implementation for cached decode (a
+single-token query is VPU work, not a kernel-worthy matmul).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e9
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, scale: float):
+    qb = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)          # [block_q, hd]
+    k = k_ref[0].astype(jnp.float32)          # [S, hd]
+    s = k.shape[0]
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale   # [block_q, S]
+    q_pos = qb * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, s), 0)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (block_q, s), 1)
+    scores = jnp.where(k_pos <= q_pos, scores, NEG_INF)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.exp(scores - m)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    o_ref[0] = jax.lax.dot_general(
+        p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    block_q: int = 256, interpret: bool = False
+                    ) -> jnp.ndarray:
+    """Causal attention, [B, H, S, hd] -> [B, H, S, hd]. Differentiable.
+
+    ``interpret=True`` runs the kernel in Pallas interpret mode (CPU CI);
+    on TPU it lowers to a Mosaic kernel. Falls back to a smaller q block
+    when S < block_q. The backward pass recomputes through the XLA einsum
+    attention (``_xla_reference``) — same math, so gradients are exact;
+    a Pallas backward kernel is a later optimization.
+    """
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
+    return _flash_attention_vjp(block_q, interpret, q, k, v)
+
+
+def _xla_reference(q, k, v):
+    """The einsum formulation used for the VJP (ops.attention semantics)."""
+    from .attention import causal_attention
+    return causal_attention(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _flash_attention_vjp(block_q, interpret, q, k, v):
+    return _forward_kernel(q, k, v, block_q, interpret)
+
+
+def _flash_fwd(block_q, interpret, q, k, v):
+    return _forward_kernel(q, k, v, block_q, interpret), (q, k, v)
+
+
+def _flash_bwd(block_q, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(_xla_reference, q, k, v)
+    return vjp(g)
+
+
+_flash_attention_vjp.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _forward_kernel(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    block_q: int, interpret: bool) -> jnp.ndarray:
+    b, h, s, hd = q.shape
+    block_q = min(block_q, s)
+    if s % block_q:
+        block_q = s  # ragged seq: one block per row set (rows fit VMEM)
+    scale = 1.0 / float(hd) ** 0.5
+
+    qf = q.reshape(b * h, s, hd)
+    kf = k.reshape(b * h, s, hd)
+    vf = v.reshape(b * h, s, hd)
+
+    out = pl.pallas_call(
+        functools.partial(_attn_kernel, block_q=block_q, scale=scale),
+        grid=(b * h, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, hd), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((1, s, hd), lambda bh, qb: (bh, 0, 0)),
+            pl.BlockSpec((1, s, hd), lambda bh, qb: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, hd), lambda bh, qb: (bh, qb, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, hd), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, hd)

@@ -42,6 +42,8 @@ from ..parallel import partition as P_
 from ..parallel.pipeline import PipelineRunner
 from ..runtime.engine import REF_TEMPERATURE, REF_TOP_K, SamplingConfig
 from ..utils.config import ServingConfig, from_env
+from ..utils.metrics import REGISTRY
+from ..utils.tracing import timed
 from . import loader
 from .http import JSONApp
 from .tokenizer import get_tokenizer
@@ -103,6 +105,13 @@ def create_app(cfg: Optional[ServingConfig] = None,
     }
 
     app = JSONApp(title="llm-sharding-demo-tpu", version="0.1.0")
+
+    @app.get("/metrics")
+    def metrics():
+        # Prometheus text exposition (the reference has no metrics at all,
+        # SURVEY.md §5): request counters + latency histograms from
+        # utils.metrics.REGISTRY.
+        return REGISTRY.prometheus()
 
     @app.get("/healthz")
     def healthz():
@@ -194,10 +203,17 @@ def create_app(cfg: Optional[ServingConfig] = None,
                 return {"error": "temperature must be > 0"}
             if not 1 <= req.top_k <= config.vocab_size:
                 return {"error": f"top_k must be in [1, {config.vocab_size}]"}
-        if cfg.dispatch == "remote":
-            ids = _generate_remote(req, prompt_ids)
-        else:
-            ids = _generate_local(req, prompt_ids)
+        with timed("generate_request_seconds", mode=req.mode,
+                   dispatch=cfg.dispatch):
+            if cfg.dispatch == "remote":
+                ids = _generate_remote(req, prompt_ids)
+            else:
+                ids = _generate_local(req, prompt_ids)
+        REGISTRY.inc("generate_requests_total", mode=req.mode)
+        REGISTRY.inc("generated_tokens_total", value=req.max_new_tokens)
+        log.info('{"event": "generate", "mode": "%s", "prompt_tokens": %d, '
+                 '"new_tokens": %d}', req.mode, len(prompt_ids),
+                 req.max_new_tokens)
         try:
             text = tokenizer.decode(ids, skip_special_tokens=True)
         except TypeError:  # ByteTokenizer takes no HF kwargs

@@ -78,18 +78,20 @@ class JSONApp:
         if (isinstance(result, tuple) and len(result) == 2
                 and isinstance(result[0], int)):
             return result
-        return 200, result
+        return 200, result  # payload: dict (JSON) or str (text/plain)
 
 
 class Response:
     """requests-compatible view of a handled call."""
 
-    def __init__(self, status_code: int, payload: Dict[str, Any]):
+    def __init__(self, status_code: int, payload: Any):
         self.status_code = status_code
         self._payload = payload
-        self.text = json.dumps(payload)
+        self.text = payload if isinstance(payload, str) else json.dumps(payload)
 
     def json(self) -> Dict[str, Any]:
+        if isinstance(self._payload, str):
+            raise ValueError("response is text, not JSON")
         return self._payload
 
     def raise_for_status(self) -> None:
@@ -127,9 +129,14 @@ def serve(app: JSONApp, host: str = "0.0.0.0", port: int = 5000,
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else None
             status, payload = app.handle(method, self.path, body)
-            data = json.dumps(payload).encode()
+            if isinstance(payload, str):
+                data = payload.encode()
+                ctype = "text/plain; version=0.0.4"  # Prometheus exposition
+            else:
+                data = json.dumps(payload).encode()
+                ctype = "application/json"
             self.send_response(status)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)

@@ -1,0 +1,105 @@
+"""In-process metrics: counters + latency histograms, Prometheus-exposable.
+
+The reference's observability is one startup print and uvicorn access
+logs (reference server.py:27, Dockerfile:19; SURVEY.md §5 "Metrics":
+ABSENT — the optional k8s metrics-server only sees pod CPU/mem). This
+registry backs the serving layer's /metrics endpoint and the decode
+engine's per-request timings.
+
+Thread-safe (the stdlib HTTP server is one-thread-per-request). Export
+format is Prometheus text exposition, so a scrape config pointed at the
+pod Just Works; ``snapshot()`` returns the same data as a dict for tests
+and /healthz embedding.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Tuple
+
+# latency buckets (seconds): 1ms .. 60s, roughly log-spaced
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+        self._histograms: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                               List] = {}
+
+    @staticmethod
+    def _key(name: str, labels: Dict[str, str]):
+        return name, tuple(sorted(labels.items()))
+
+    def inc(self, name: str, value: float = 1.0, **labels) -> None:
+        key = self._key(name, labels)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def observe(self, name: str, seconds: float, **labels) -> None:
+        key = self._key(name, labels)
+        with self._lock:
+            if key not in self._histograms:
+                self._histograms[key] = [
+                    [0] * (len(DEFAULT_BUCKETS) + 1), 0.0, 0]
+            counts, _, _ = self._histograms[key]
+            counts[bisect.bisect_left(DEFAULT_BUCKETS, seconds)] += 1
+            self._histograms[key][1] += seconds
+            self._histograms[key][2] += 1
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            out: Dict[str, object] = {}
+            for (name, labels), v in self._counters.items():
+                out[_fmt_name(name, labels)] = v
+            for (name, labels), (counts, total, n) in self._histograms.items():
+                base = _fmt_name(name, labels)
+                out[base + "_count"] = n
+                out[base + "_sum"] = round(total, 6)
+                if n:
+                    out[base + "_avg"] = round(total / n, 6)
+            return out
+
+    def prometheus(self) -> str:
+        """Prometheus text exposition format."""
+        lines: List[str] = []
+        with self._lock:
+            for (name, labels), v in sorted(self._counters.items()):
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name}{_prom_labels(labels)} {v}")
+            for (name, labels), (counts, total, n) in sorted(
+                    self._histograms.items()):
+                lines.append(f"# TYPE {name} histogram")
+                acc = 0
+                for bound, c in zip(DEFAULT_BUCKETS, counts):
+                    acc += c
+                    lines.append(
+                        f'{name}_bucket{_prom_labels(labels, le=bound)} {acc}')
+                acc += counts[-1]
+                lines.append(
+                    f'{name}_bucket{_prom_labels(labels, le="+Inf")} {acc}')
+                lines.append(f"{name}_sum{_prom_labels(labels)} {total}")
+                lines.append(f"{name}_count{_prom_labels(labels)} {n}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt_name(name: str, labels) -> str:
+    if not labels:
+        return name
+    return name + "{" + ",".join(f"{k}={v}" for k, v in labels) + "}"
+
+
+def _prom_labels(labels, le=None) -> str:
+    items = list(labels)
+    if le is not None:
+        items = items + [("le", le)]
+    if not items:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in items) + "}"
+
+
+# process-wide default registry (what serving.app uses)
+REGISTRY = MetricsRegistry()

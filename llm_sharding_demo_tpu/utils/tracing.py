@@ -1,0 +1,49 @@
+"""Profiling/tracing helpers around ``jax.profiler``.
+
+SURVEY.md §5 "Tracing / profiling": the reference imports ``time`` and
+never uses it (reference server.py:3). Here:
+
+- ``trace(dir)``: context manager capturing an XLA/TPU profile viewable
+  in TensorBoard/Perfetto (device timelines, HLO cost, HBM traffic);
+- ``annotate(name)``: named span that shows up inside those traces
+  (``jax.profiler.TraceAnnotation``);
+- ``timed(name)``: lightweight host-side wall-clock span recording into
+  ``utils.metrics.REGISTRY`` — the per-request numbers /metrics exposes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+import jax
+
+from .metrics import REGISTRY
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False) -> Iterator[None]:
+    """Capture a device-level profiler trace into ``log_dir``."""
+    jax.profiler.start_trace(log_dir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span visible in profiler traces (device + host timelines)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def timed(name: str, registry=None, **labels) -> Iterator[None]:
+    """Wall-clock span recorded as a histogram observation."""
+    reg = registry if registry is not None else REGISTRY
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        reg.observe(name, time.perf_counter() - t0, **labels)

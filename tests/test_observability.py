@@ -1,0 +1,53 @@
+"""Metrics registry, /metrics endpoint, and timed spans."""
+
+import jax
+import pytest
+
+from llm_sharding_demo_tpu.models import gpt2
+from llm_sharding_demo_tpu.serving.app import create_app
+from llm_sharding_demo_tpu.serving.http import TestClient
+from llm_sharding_demo_tpu.serving.tokenizer import ByteTokenizer
+from llm_sharding_demo_tpu.utils.config import ServingConfig
+from llm_sharding_demo_tpu.utils.metrics import MetricsRegistry
+from llm_sharding_demo_tpu.utils.tracing import timed
+
+
+def test_registry_counters_and_histograms():
+    reg = MetricsRegistry()
+    reg.inc("requests_total", route="/generate")
+    reg.inc("requests_total", route="/generate")
+    reg.observe("latency_seconds", 0.002)
+    reg.observe("latency_seconds", 0.2)
+    snap = reg.snapshot()
+    assert snap["requests_total{route=/generate}"] == 2
+    assert snap["latency_seconds_count"] == 2
+    assert 0.2 < snap["latency_seconds_sum"] < 0.21
+    prom = reg.prometheus()
+    assert '# TYPE requests_total counter' in prom
+    assert 'latency_seconds_bucket{le="0.0025"} 1' in prom
+    assert 'latency_seconds_bucket{le="+Inf"} 2' in prom
+
+
+def test_timed_records():
+    reg = MetricsRegistry()
+    with timed("span_seconds", registry=reg, phase="x"):
+        pass
+    assert reg.snapshot()["span_seconds{phase=x}_count"] == 1
+
+
+def test_metrics_endpoint():
+    config = gpt2.GPT2Config(vocab_size=256, n_positions=32, n_embd=8,
+                             n_layer=2, n_head=2)
+    params = gpt2.init_params(config, jax.random.PRNGKey(0))
+    cfg = ServingConfig(model_id="test", shard_role="coordinator",
+                        boundaries=(1,), max_seq=32)
+    client = TestClient(create_app(cfg, model=(config, params),
+                                   tokenizer=ByteTokenizer()))
+    client.post("/generate", json={"prompt": "yo", "max_new_tokens": 2,
+                                   "mode": "greedy"})
+    r = client.get("/metrics")
+    assert r.status_code == 200
+    assert "generate_requests_total" in r.text
+    assert "generate_request_seconds_bucket" in r.text
+    with pytest.raises(ValueError):
+        r.json()  # text, not JSON

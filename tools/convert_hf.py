@@ -1,0 +1,47 @@
+"""CLI: HuggingFace GPT-2 checkpoint -> Orbax checkpoint directory.
+
+One-time conversion so serving/training pods never need the HF hub or
+torch (the reference instead downloads full HF weights into every pod at
+import time, reference server.py:40-42). Run wherever the HF model is
+reachable (hub or local cache/path):
+
+    python tools/convert_hf.py gpt2 /ckpt/gpt2
+    python tools/convert_hf.py /path/to/local/hf/dir /ckpt/my-model
+
+then point serving at it:  CHECKPOINT_DIR=/ckpt/gpt2
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("model_id", help="HF model id or local HF dir")
+    parser.add_argument("out_dir", help="Orbax checkpoint directory to write")
+    parser.add_argument("--dtype", default="float32",
+                        choices=("float32", "bfloat16"))
+    args = parser.parse_args()
+
+    import jax.numpy as jnp
+    from transformers import AutoModelForCausalLM
+
+    from llm_sharding_demo_tpu.models.hf_convert import params_from_hf_model
+    from llm_sharding_demo_tpu.utils import checkpoint as ckpt
+
+    dtype = jnp.float32 if args.dtype == "float32" else jnp.bfloat16
+    print(f"loading HF model {args.model_id} ...", flush=True)
+    model = AutoModelForCausalLM.from_pretrained(args.model_id)
+    model.eval()
+    config, params = params_from_hf_model(model, dtype=dtype)
+    print(f"converted: {config}", flush=True)
+    ckpt.save(args.out_dir, params, config)
+    print(f"wrote Orbax checkpoint to {args.out_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, ".")
+    sys.exit(main())
