@@ -7,9 +7,11 @@ FROM python:3.12-slim
 
 WORKDIR /app
 
-# TPU wheels: jax[tpu] pulls libtpu; transformers/torch only needed for
-# one-time HF checkpoint conversion (tools/convert_hf.py) — serving pods
-# restore Orbax checkpoints and never import torch.
+# TPU wheels: jax[tpu] pulls libtpu. Serving pods restore Orbax
+# checkpoints and never import torch — conversion deps
+# (requirements-convert.txt) are deliberately NOT installed here; run
+# tools/convert_hf.py outside the pod (or in a one-off job layering
+# `pip install -r requirements-convert.txt` on this image).
 COPY requirements.txt .
 RUN pip install --no-cache-dir -r requirements.txt
 

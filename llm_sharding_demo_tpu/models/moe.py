@@ -1,0 +1,183 @@
+"""GPT-2-MoE: the dense MLP swapped for a top-k mixture of experts.
+
+Second model family, and the carrier of *expert parallelism* (the one
+mesh axis dense GPT-2 cannot exercise; the reference is dense-only —
+SURVEY.md §2.2 "EP: Not applicable"). TPU-first design:
+
+- experts are stacked on their own axis — kernels are
+  ``[L, E, d, 4d]`` / ``[L, E, 4d, d]`` — so expert parallelism is a pure
+  GSPMD annotation: shard the ``E`` axis over the ``ep`` mesh axis
+  (``parallel.spmd.moe_param_pspecs``) and XLA turns the dispatch/combine
+  einsums into all-to-alls over ICI;
+- routing is the capacity-factor formulation (Shazeer et al. / Switch):
+  every shape is static under jit. Per (batch row, expert) each token
+  gets a slot index by masked cumsum; tokens past capacity are dropped
+  (their combine weight is zero, they ride the residual connection);
+- dispatch and combine are one-hot einsums — batched MXU contractions,
+  no gather/scatter;
+- the router's load-balancing auxiliary loss (mean gate fraction × mean
+  assignment fraction × E) is returned alongside logits for the trainer
+  to weight.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.layers import gelu_new, layer_norm, linear
+from ..ops.attention import causal_attention, merge_heads, split_heads
+from .gpt2 import GPT2Config, Params, embed, final_logits
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(GPT2Config):
+    """GPT2Config + router/expert hyperparameters."""
+
+    n_experts: int = 8
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+
+    def __post_init__(self):
+        super().__post_init__()
+        if not 1 <= self.expert_top_k <= self.n_experts:
+            raise ValueError(
+                f"expert_top_k={self.expert_top_k} not in "
+                f"[1, n_experts={self.n_experts}]")
+        if self.attention_impl != "xla":
+            # moe.forward hard-codes the XLA attention path; accepting
+            # "pallas" here would silently run the wrong kernel
+            raise ValueError(
+                "MoE blocks support attention_impl='xla' only (the pallas "
+                "kernel is wired into the dense model path)")
+
+
+def expert_capacity(config: MoEConfig, seq_len: int) -> int:
+    """Static per-expert slot count for one batch row."""
+    cap = int(config.capacity_factor * config.expert_top_k * seq_len
+              / config.n_experts)
+    return max(cap, 1)
+
+
+def init_params(config: MoEConfig, key: jax.Array, dtype=jnp.float32) -> Params:
+    """Like gpt2.init_params but with router + stacked experts per block."""
+    k_wte, k_wpe, k_attn, k_proj, k_router, k_fc, k_out = jax.random.split(key, 7)
+    d, l, e = config.n_embd, config.n_layer, config.n_experts
+    std = 0.02
+
+    def normal(k, shape):
+        return (jax.random.normal(k, shape) * std).astype(dtype)
+
+    return {
+        "wte": normal(k_wte, (config.vocab_size, d)),
+        "wpe": normal(k_wpe, (config.n_positions, d)),
+        "blocks": {
+            "ln_1": {"scale": jnp.ones((l, d), dtype), "bias": jnp.zeros((l, d), dtype)},
+            "attn": {
+                "c_attn": {"kernel": normal(k_attn, (l, d, 3 * d)),
+                           "bias": jnp.zeros((l, 3 * d), dtype)},
+                "c_proj": {"kernel": normal(k_proj, (l, d, d)),
+                           "bias": jnp.zeros((l, d), dtype)},
+            },
+            "ln_2": {"scale": jnp.ones((l, d), dtype), "bias": jnp.zeros((l, d), dtype)},
+            "moe": {
+                "router": {"kernel": normal(k_router, (l, d, e))},
+                "experts": {
+                    "c_fc": {"kernel": normal(k_fc, (l, e, d, 4 * d)),
+                             "bias": jnp.zeros((l, e, 4 * d), dtype)},
+                    "c_proj": {"kernel": normal(k_out, (l, e, 4 * d, d)),
+                               "bias": jnp.zeros((l, e, d), dtype)},
+                },
+            },
+        },
+        "ln_f": {"scale": jnp.ones((d,), dtype), "bias": jnp.zeros((d,), dtype)},
+    }
+
+
+def moe_mlp(moe_params: Params, h: jnp.ndarray, config: MoEConfig,
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k routed expert MLP. [B, S, d] -> ([B, S, d], aux_loss scalar)."""
+    b, s, d = h.shape
+    e, k = config.n_experts, config.expert_top_k
+    cap = expert_capacity(config, s)
+
+    gate_logits = h @ moe_params["router"]["kernel"]            # [B,S,E]
+    gates = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+
+    # top-k selection: iteratively take the argmax, zero it, repeat —
+    # yields per-slot one-hots [k, B, S, E]
+    sel_gates = gates
+    onehots, weights = [], []
+    for _ in range(k):
+        idx = jnp.argmax(sel_gates, axis=-1)                    # [B,S]
+        oh = jax.nn.one_hot(idx, e, dtype=gates.dtype)          # [B,S,E]
+        onehots.append(oh)
+        weights.append(jnp.sum(sel_gates * oh, axis=-1))        # [B,S]
+        sel_gates = sel_gates * (1.0 - oh)
+    sel = jnp.stack(onehots)                                    # [k,B,S,E]
+    w = jnp.stack(weights)                                      # [k,B,S]
+    # renormalize the kept gates so combine weights sum to 1 per token
+    w = w / jnp.maximum(jnp.sum(w, axis=0, keepdims=True), 1e-9)
+
+    # slot assignment: serialize the k choices along the sequence so the
+    # cumsum hands out distinct slots; position = (# prior assignments to
+    # that expert) per batch row
+    sel_flat = sel.transpose(1, 0, 2, 3).reshape(b, k * s, e)   # [B,k*S,E]
+    pos = jnp.cumsum(sel_flat, axis=1) - 1.0                    # [B,k*S,E]
+    keep = (pos < cap) & (sel_flat > 0)
+    slot = jnp.where(keep, pos, 0).astype(jnp.int32)
+    slot_oh = jax.nn.one_hot(slot, cap, dtype=gates.dtype) * keep[..., None]
+    # dispatch tensor [B, k*S, E, C] -> fold k back out and sum the k
+    # one-hots per token (a token never picks the same expert twice).
+    # The merged axis is k-MAJOR (sel_flat came from [B, k, S, E]), so it
+    # un-flattens as (k, s) — (s, k) would scramble token identities.
+    dispatch = slot_oh.reshape(b, k, s, e, cap).transpose(1, 0, 2, 3, 4)
+    combine = jnp.einsum("kbs,kbsec->bsec", w, dispatch)        # [B,S,E,C]
+    dispatch = jnp.sum(dispatch, axis=0)                        # [B,S,E,C]
+
+    # expert compute: everything below is batched over E (the ep axis)
+    xin = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(h.dtype), h)
+    h1 = jnp.einsum("ebcd,edf->ebcf", xin,
+                    moe_params["experts"]["c_fc"]["kernel"])
+    h1 = gelu_new(h1 + moe_params["experts"]["c_fc"]["bias"][:, None, None, :])
+    h2 = jnp.einsum("ebcf,efd->ebcd", h1,
+                    moe_params["experts"]["c_proj"]["kernel"])
+    h2 = h2 + moe_params["experts"]["c_proj"]["bias"][:, None, None, :]
+    out = jnp.einsum("bsec,ebcd->bsd", combine.astype(h.dtype), h2)
+
+    # Switch-style load-balance loss over the top-1 assignment
+    frac_tokens = jnp.mean(sel[0], axis=(0, 1))                 # [E]
+    frac_gates = jnp.mean(gates, axis=(0, 1))                   # [E]
+    aux = jnp.sum(frac_tokens * frac_gates) * e
+    return out, aux
+
+
+def forward(params: Params, input_ids: jnp.ndarray, config: MoEConfig,
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[B, S] -> ([B, S, vocab] logits, summed router aux loss)."""
+    h = embed(params, input_ids, 0)
+    eps = config.layer_norm_epsilon
+
+    def body(carry, layer_params):
+        h, aux = carry
+        a = layer_norm(h, layer_params["ln_1"]["scale"],
+                       layer_params["ln_1"]["bias"], eps)
+        qkv = linear(a, layer_params["attn"]["c_attn"]["kernel"],
+                     layer_params["attn"]["c_attn"]["bias"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (split_heads(x, config.n_head) for x in (q, k, v))
+        attn = linear(merge_heads(causal_attention(q, k, v)),
+                      layer_params["attn"]["c_proj"]["kernel"],
+                      layer_params["attn"]["c_proj"]["bias"])
+        h = h + attn
+        m = layer_norm(h, layer_params["ln_2"]["scale"],
+                       layer_params["ln_2"]["bias"], eps)
+        mlp_out, layer_aux = moe_mlp(layer_params["moe"], m, config)
+        return (h + mlp_out, aux + layer_aux), None
+
+    (h, aux), _ = jax.lax.scan(body, (h, jnp.zeros((), jnp.float32)),
+                               params["blocks"])
+    return final_logits(params, h, eps), aux

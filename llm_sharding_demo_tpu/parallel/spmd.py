@@ -100,6 +100,48 @@ def param_pspecs(mesh: Mesh) -> Params:
     }
 
 
+def moe_param_pspecs(mesh: Mesh) -> Params:
+    """PartitionSpecs for ``models.moe`` params: experts over ``ep``.
+
+    Expert kernels are ``[L, E, d_in, d_out]``: the E axis shards over
+    ``ep`` (each chip owns E/ep experts end to end; XLA turns the
+    dispatch/combine einsums into all-to-alls), and the expert FFN hidden
+    dim additionally shards over ``tp`` when present — Megatron layout
+    *within* each expert. The router stays replicated: every token needs
+    every expert's logit.
+    """
+    ep = "ep" if "ep" in mesh.axis_names else None
+    tp = "tp" if "tp" in mesh.axis_names else None
+
+    def blk(*tail) -> P:
+        return P(None, *tail)
+
+    return {
+        "wte": P(),
+        "wpe": P(),
+        "blocks": {
+            "ln_1": {"scale": blk(None), "bias": blk(None)},
+            "attn": {
+                "c_attn": {"kernel": blk(None, tp), "bias": blk(tp)},
+                "c_proj": {"kernel": blk(tp, None), "bias": blk(None)},
+            },
+            "ln_2": {"scale": blk(None), "bias": blk(None)},
+            "moe": {
+                "router": {"kernel": blk(None, None)},
+                "experts": {
+                    "c_fc": {"kernel": blk(ep, None, tp), "bias": blk(ep, tp)},
+                    "c_proj": {"kernel": blk(ep, tp, None), "bias": blk(ep, None)},
+                },
+            },
+        },
+        "ln_f": {"scale": P(), "bias": P()},
+    }
+
+
+def shard_moe_params(params: Params, mesh: Mesh) -> Params:
+    return shard_params(params, mesh, moe_param_pspecs(mesh))
+
+
 def batch_pspec(mesh: Mesh) -> P:
     """[B, S] token batches: batch over dp, sequence over sp (if present)."""
     dp = "dp" if "dp" in mesh.axis_names else None
@@ -107,9 +149,12 @@ def batch_pspec(mesh: Mesh) -> P:
     return P(dp, sp)
 
 
-def shard_params(params: Params, mesh: Mesh) -> Params:
-    """device_put the param pytree with the ``param_pspecs`` layout."""
-    specs = param_pspecs(mesh)
+def shard_params(params: Params, mesh: Mesh, specs: Optional[Params] = None
+                 ) -> Params:
+    """device_put a param pytree with a PartitionSpec tree (default: the
+    dense-GPT-2 ``param_pspecs`` layout)."""
+    if specs is None:
+        specs = param_pspecs(mesh)
     return jax.tree_util.tree_map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         params, specs)

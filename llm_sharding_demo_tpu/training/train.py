@@ -52,17 +52,25 @@ class TrainStep:
     ``init(params)`` shards params + builds matching-sharded optimizer
     state; ``__call__(params, opt_state, ids)`` returns updated
     ``(params, opt_state, loss)`` — one XLA program end to end.
+
+    ``loss_fn`` (``(params, ids) -> scalar``) and ``pspec_fn``
+    (``mesh -> PartitionSpec tree``) default to the dense GPT-2 LM loss
+    and Megatron layout; ``MoETrainStep`` rebinds them for the MoE family.
     """
 
-    config: GPT2Config
+    config: Any
     optimizer: optax.GradientTransformation
     mesh: Optional[Mesh] = None
     remat: bool = False
+    loss_fn: Optional[Callable] = None
+    pspec_fn: Callable = spmd.param_pspecs
 
     def __post_init__(self):
+        loss_fn = self.loss_fn or (
+            lambda p, ids: lm_loss(p, ids, self.config, self.remat))
+
         def step(params, opt_state, ids):
-            loss, grads = jax.value_and_grad(lm_loss)(
-                params, ids, self.config, self.remat)
+            loss, grads = jax.value_and_grad(loss_fn)(params, ids)
             updates, opt_state = self.optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss
@@ -86,7 +94,8 @@ class TrainStep:
         guaranteed to follow.
         """
         if self.mesh is not None:
-            params = spmd.shard_params(params, self.mesh)
+            params = spmd.shard_params(params, self.mesh,
+                                       self.pspec_fn(self.mesh))
         opt_state = self.optimizer.init(params)
         return params, opt_state
 
@@ -99,6 +108,30 @@ class TrainStep:
 
     def __call__(self, params, opt_state, ids):
         return self._step(params, opt_state, ids)
+
+
+def moe_lm_loss(params: Params, ids: jnp.ndarray, config,
+                aux_weight: float = 0.01) -> jnp.ndarray:
+    """Next-token CE + router load-balance auxiliary loss (models.moe)."""
+    from ..models import moe
+
+    logits, aux = moe.forward(params, ids[:, :-1], config)
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), ids[:, 1:])
+    return jnp.mean(ce) + aux_weight * aux
+
+
+def MoETrainStep(config, optimizer: optax.GradientTransformation,
+                 mesh: Optional[Mesh] = None,
+                 aux_weight: float = 0.01) -> TrainStep:
+    """Expert-parallel train step: experts sharded over ``ep`` (plus dp/tp
+    as available), all collectives derived by GSPMD from the annotations
+    in ``spmd.moe_param_pspecs``. A ``TrainStep`` with the MoE loss and
+    pspec table bound."""
+    return TrainStep(
+        config, optimizer, mesh=mesh,
+        loss_fn=lambda p, ids: moe_lm_loss(p, ids, config, aux_weight),
+        pspec_fn=spmd.moe_param_pspecs)
 
 
 def gpipe_lm_loss(params: Params, ids: jnp.ndarray, config: GPT2Config,

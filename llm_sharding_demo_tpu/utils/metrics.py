@@ -64,15 +64,25 @@ class MetricsRegistry:
             return out
 
     def prometheus(self) -> str:
-        """Prometheus text exposition format."""
+        """Prometheus text exposition format.
+
+        One ``# TYPE`` line per metric *name* with all label sets grouped
+        under it — duplicate TYPE lines for a name make the scraper drop
+        the whole page.
+        """
         lines: List[str] = []
         with self._lock:
+            seen_type: set = set()
             for (name, labels), v in sorted(self._counters.items()):
-                lines.append(f"# TYPE {name} counter")
+                if name not in seen_type:
+                    seen_type.add(name)
+                    lines.append(f"# TYPE {name} counter")
                 lines.append(f"{name}{_prom_labels(labels)} {v}")
             for (name, labels), (counts, total, n) in sorted(
                     self._histograms.items()):
-                lines.append(f"# TYPE {name} histogram")
+                if name not in seen_type:
+                    seen_type.add(name)
+                    lines.append(f"# TYPE {name} histogram")
                 acc = 0
                 for bound, c in zip(DEFAULT_BUCKETS, counts):
                     acc += c
