@@ -20,6 +20,33 @@ def test_dryrun_multichip(n):
     ge.dryrun_multichip(n)
 
 
+def test_dryrun_multichip_bare_driver_contract():
+    """The driver invokes dryrun_multichip(8) in a fresh process with ONE
+    visible device and no conftest bootstrap (round-1 failure mode,
+    MULTICHIP_r01.json rc=1).  Simulate it: clean subprocess, host platform
+    forced to a single device, no pytest in sight."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop(ge._BOOTSTRAP_SENTINEL, None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "assert len(jax.devices()) == 1, jax.devices(); "
+        "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
+    )
+    result = subprocess.run(
+        [sys.executable, "-c", code], env=env, cwd=repo,
+        capture_output=True, text=True, timeout=560,
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "dense ok" in result.stdout and "moe ok" in result.stdout
+
+
 def test_mesh_shape_covers_devices():
     for n in (1, 2, 4, 8, 16, 32):
         shape = ge._mesh_shape(n)
