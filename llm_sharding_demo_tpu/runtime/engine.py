@@ -185,15 +185,21 @@ class DecodeEngine:
 
     def _decode_impl(self, params: Params, first_token: jnp.ndarray,
                      cache: KVCache, key: jax.Array, *, steps: int,
-                     sampling: SamplingConfig) -> jnp.ndarray:
+                     sampling: SamplingConfig) -> Tuple[jnp.ndarray, KVCache]:
         """lax.scan over ``steps - 1`` cached single-token forwards.
 
         ``first_token`` [B] is the token selected from the prefill logits;
         the scan forwards each selected token once and emits the next —
         no trailing wasted forward.
+
+        Returns ``(tokens [B, steps], final cache)``. The cache is returned
+        so the donated input cache has a same-shaped output to alias —
+        without it XLA cannot honor ``donate_argnums`` (round-1 emitted
+        "Some donated buffers were not usable" and kept both copies live).
+        Callers that don't continue generation just drop it.
         """
         if steps == 1:
-            return first_token[:, None]
+            return first_token[:, None], cache
 
         def body(carry, step_key):
             token, cache = carry
@@ -203,9 +209,9 @@ class DecodeEngine:
             return (nxt, cache), nxt
 
         keys = jax.random.split(key, steps - 1)
-        (_, _), rest = jax.lax.scan(body, (first_token, cache), keys)
+        (_, cache), rest = jax.lax.scan(body, (first_token, cache), keys)
         tokens = jnp.concatenate([first_token[None, :], rest], axis=0)
-        return tokens.T  # [steps, B] -> [B, steps]
+        return tokens.T, cache  # [steps, B] -> [B, steps]
 
     # -- public API ----------------------------------------------------------
 
@@ -228,8 +234,9 @@ class DecodeEngine:
         first = select_token(last_logits, sampling, prefill_key)
         first.block_until_ready()
         t1 = time.perf_counter()
-        new = self._decode(self.params, first, cache, decode_key,
-                           steps=max_new_tokens, sampling=sampling)
+        new, final_cache = self._decode(self.params, first, cache, decode_key,
+                                        steps=max_new_tokens, sampling=sampling)
+        del final_cache  # aliases the donated prefill cache; nothing to keep
         new = np.asarray(jax.block_until_ready(new))
         t2 = time.perf_counter()
 
