@@ -1,17 +1,31 @@
-"""Benchmark harness: TPU decode throughput vs the reference's CPU loop.
+"""Benchmark harness: the full BASELINE.json measurement matrix.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "configs"}.
+The top-level metric is the headline number (GPT-2 124M single-stream greedy
+decode, bf16, on the visible TPU chip); ``configs`` carries every
+BASELINE.md row so the matrix has measured values instead of TBDs:
 
-Primary metric (BASELINE.json): greedy decode tokens/sec on GPT-2 124M on
-the visible TPU chip. The baseline denominator is the reference's decode
-algorithm measured in-process on CPU: a torch GPT-2 that re-forwards the
-FULL growing sequence per token (reference server.py:169-181 — it has no
-KV cache), greedy-decoded with the same prompt/token counts. Running it
-in-process (no HTTP/JSON hops, which cost the reference extra) makes the
-baseline conservative — the real reference is slower than this number.
+  cfg1  tiny-gpt2, 2-shard pipeline, 20 new tokens (the notebook workload)
+  cfg2  GPT-2 124M, 2-shard (6+6) + single-chip engine, single prompt
+  cfg3  GPT-2 124M, batch=8 (the reference can only run bs=1 sequentially,
+        server.py:137 — its baseline is 8x one stream)
+  cfg4  GPT-2 medium, 4-shard pipeline (round-robin on this 1 chip: the
+        bench environment exposes a single TPU; stage handoffs still run,
+        labeled honestly in the row)
+  cfg5  KV-cache incremental decode vs O(n^2) full re-forward per token —
+        both measured on THIS framework on-chip, plus the reference's own
+        O(n^2) torch CPU loop for scale
 
-Both sides use random-init weights of the same architecture (this image
-has no HF hub access; throughput is weight-independent).
+Baseline denominators re-measure the reference's decode algorithm
+in-process on CPU: a torch GPT-2 re-forwarding the FULL growing sequence
+per token with no KV cache (reference server.py:169-181), greedy. No
+HTTP/JSON hops are charged to it, so every vs_baseline here is
+conservative — the deployed reference is slower than its denominator.
+
+Both sides use random-init weights of the same architecture (no HF hub in
+this image; throughput is weight-independent). fp32 engine rows exist
+because fp32 is the BASELINE.json greedy-parity mode; bf16 rows are the
+TPU-native fast path (fp32 LN/softmax/logits, bf16 weights + KV).
 """
 
 from __future__ import annotations
@@ -22,9 +36,19 @@ import time
 
 import numpy as np
 
+PROMPT_LEN = 16
+# Two-point decode windows: the bench chip sits behind a network tunnel
+# where each host<->device transfer costs ~10-15 ms (measured and reported
+# as transfer_rtt_ms) and a generate() call makes several. Timing one
+# window charges that fixed cost to the tokens; the marginal cost between
+# two windows cancels it, giving the steady-state per-token cost the
+# hardware actually delivers.
+STEPS_A = 64
+STEPS_B = 256
+
 
 def measure_reference_cpu(config, prompt_len: int, new_tokens: int) -> float:
-    """tokens/sec of the reference's O(n²) CPU decode loop (torch)."""
+    """tokens/sec of the reference's O(n^2) CPU decode loop (torch)."""
     import torch
     from transformers import GPT2Config as HFConfig, GPT2LMHeadModel
 
@@ -47,63 +71,265 @@ def measure_reference_cpu(config, prompt_len: int, new_tokens: int) -> float:
     return new_tokens / dt
 
 
-def measure_tpu(config, prompt_len: int, new_tokens: int,
-                batch: int) -> dict:
-    """Our engine: jitted prefill + scanned KV-cache decode on one chip.
+def measure_dispatch_rtt() -> float:
+    """Fixed per-call overhead, ms: one small host->device transfer.
 
-    The bench environment exposes a single TPU chip, so this measures the
-    single-device engine; the multi-stage pipeline path is validated (not
-    timed) by tests on a forced-host mesh."""
+    On the tunneled bench chip, program dispatch is sub-0.1 ms but each
+    host<->device copy costs ~10-15 ms; a generate() call makes several
+    (prompt up, tokens down, keys), which is the fixed cost the two-point
+    marginal timing cancels."""
+    import jax.numpy as jnp
+
+    jnp.asarray(np.zeros((1, 256), np.int32)).block_until_ready()  # warmup
+    t0 = time.perf_counter()
+    n = 10
+    for _ in range(n):
+        jnp.asarray(np.zeros((1, 256), np.int32)).block_until_ready()
+    return (time.perf_counter() - t0) / n * 1e3
+
+
+def _two_point(runner, prompt, s_a: int = STEPS_A, s_b: int = STEPS_B) -> dict:
+    """Steady-state decode cost via marginal timing between two windows."""
+    runner.generate(prompt, s_a)                   # compile window A
+    runner.generate(prompt, s_b)                   # compile window B
+    ra = runner.generate(prompt, s_a)
+    rb = runner.generate(prompt, s_b)
+    marginal = ((rb.decode_seconds - ra.decode_seconds)
+                / (rb.decode_steps - ra.decode_steps))
+    batch = prompt.shape[0]
+    return {
+        "tokens_per_sec": batch / marginal,
+        "p50_token_latency_ms": marginal * 1e3,
+        "e2e_tokens_per_sec": rb.tokens_per_second,
+        "prefill_ms": rb.prefill_seconds * 1e3,
+    }
+
+
+def measure_engine(config, prompt_len: int, batch: int,
+                   dtype_name: str = "float32") -> dict:
+    """Single-device engine: jitted prefill + scanned KV-cache decode."""
     import jax
+    import jax.numpy as jnp
 
     from llm_sharding_demo_tpu.models import gpt2
     from llm_sharding_demo_tpu.runtime.engine import DecodeEngine
 
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype_name]
     params = gpt2.init_params(config, jax.random.PRNGKey(0))
-    max_seq = prompt_len + new_tokens
-    engine = DecodeEngine(params, config, max_seq=max_seq)
+    engine = DecodeEngine(params, config, max_seq=prompt_len + STEPS_B,
+                          dtype=dtype)
     prompt = np.random.default_rng(0).integers(
         0, config.vocab_size, size=(batch, prompt_len))
-    engine.generate(prompt, new_tokens)            # warmup: compile both programs
-    result = engine.generate(prompt, new_tokens)   # measured, compile-free
-    return {
-        "tokens_per_sec": result.tokens_per_second,
-        "p50_token_latency_ms": result.per_token_latency * 1e3,
-        "prefill_ms": result.prefill_seconds * 1e3,
-    }
+    return _two_point(engine, prompt)
+
+
+def measure_pipeline(config, n_stages: int, prompt_len: int,
+                     batch: int = 1, dtype_name: str = "float32",
+                     two_point: bool = True, new_tokens: int = STEPS_A,
+                     ) -> dict:
+    """N-shard pipelined decode as a single compiled program per phase.
+
+    With >= n_stages real devices this is the shard_map + ppermute decoder
+    (one program, stage weights resident per chip, ICI hops). On the 1-chip
+    bench environment it falls back to the staged DecodeEngine: the SAME
+    validated stage partition (parallel.partition), composed in one
+    program on the one chip — labeled in the row. The host-driven
+    PipelineRunner is deliberately not timed here: per-token host
+    dispatches over the axon tunnel measure RTT, not the framework."""
+    import jax
+    import jax.numpy as jnp
+
+    from llm_sharding_demo_tpu.models import gpt2
+    from llm_sharding_demo_tpu.parallel.ppdecode import PipelinedDecoder
+    from llm_sharding_demo_tpu.parallel.spmd import make_mesh
+    from llm_sharding_demo_tpu.runtime.engine import DecodeEngine
+
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype_name]
+    params = gpt2.init_params(config, jax.random.PRNGKey(0))
+    per = config.n_layer // n_stages
+    boundaries = [per * i for i in range(1, n_stages)]
+    max_seq = prompt_len + (STEPS_B if two_point else new_tokens)
+    n_real = len(jax.devices())
+    if n_real >= n_stages:
+        mesh = make_mesh({"pp": n_stages}, jax.devices()[:n_stages])
+        runner = PipelinedDecoder(params, config, mesh, max_seq=max_seq,
+                                  dtype=dtype)
+        placement = f"ppermute over {n_stages} devices"
+    else:
+        runner = DecodeEngine(params, config, max_seq=max_seq, dtype=dtype,
+                              boundaries=boundaries)
+        placement = f"{n_stages} stages fused on {n_real} chip(s)"
+    prompt = np.random.default_rng(0).integers(
+        0, config.vocab_size, size=(batch, prompt_len))
+    if two_point:
+        out = _two_point(runner, prompt)
+    else:  # fixed workload (cfg1's mandated 20 tokens): e2e, RTT included
+        runner.generate(prompt, new_tokens)        # warmup
+        result = runner.generate(prompt, new_tokens)
+        out = {
+            "tokens_per_sec": result.tokens_per_second,
+            "p50_token_latency_ms": result.per_token_latency * 1e3,
+        }
+    out["placement"] = placement
+    return out
+
+
+def measure_uncached_jax(config, prompt_len: int, new_tokens: int,
+                         dtype_name: str = "bfloat16") -> float:
+    """Our model WITHOUT the KV cache: re-forward the full fixed-length
+    sequence per token (one compile; the reference's O(n^2) algorithm at
+    constant shape). Denominator for cfg5's cache-speedup ratio. The
+    per-token host dispatches pipeline asynchronously, so tunnel RTT is
+    naturally hidden here — comparable with the cached steady-state."""
+    import jax
+    import jax.numpy as jnp
+
+    from llm_sharding_demo_tpu.models import gpt2
+
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype_name]
+    params = gpt2.init_params(config, jax.random.PRNGKey(0))
+    params = jax.tree.map(
+        lambda x: x.astype(dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+    total = prompt_len + new_tokens
+
+    @jax.jit
+    def step(params, ids, t):
+        logits = gpt2.forward(params, ids, config)          # [1, total, V]
+        nxt = jnp.argmax(logits[0, t - 1]).astype(jnp.int32)
+        return jax.lax.dynamic_update_slice(ids, nxt[None, None], (0, t))
+
+    ids = np.zeros((1, total), dtype=np.int32)
+    ids[0, :prompt_len] = np.random.default_rng(0).integers(
+        0, config.vocab_size, size=(prompt_len,))
+    ids = jnp.asarray(ids)
+    ids = step(params, ids, prompt_len).block_until_ready()  # warmup/compile
+    t0 = time.perf_counter()
+    for t in range(prompt_len, total):
+        ids = step(params, ids, t)
+    ids.block_until_ready()
+    dt = time.perf_counter() - t0
+    return new_tokens / dt
 
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--prompt-len", type=int, default=16)
-    parser.add_argument("--new-tokens", type=int, default=64)
-    parser.add_argument("--baseline-tokens", type=int, default=20,
-                        help="reference CPU loop is O(n²); 20 tokens "
-                             "matches the notebook's workload")
-    parser.add_argument("--batch", type=int, default=1)
     parser.add_argument("--quick", action="store_true",
-                        help="tiny model for a fast smoke run")
+                        help="cfg1 only (tiny model) for a fast smoke run")
     args = parser.parse_args()
 
     from llm_sharding_demo_tpu.models import gpt2
 
-    config = gpt2.CONFIGS["tiny-gpt2" if args.quick else "gpt2"]
+    tiny, g124, gmed = (gpt2.CONFIGS[k]
+                        for k in ("tiny-gpt2", "gpt2", "gpt2-medium"))
+    configs = []
+    rtt_ms = measure_dispatch_rtt()
 
-    ref_tps = measure_reference_cpu(config, args.prompt_len,
-                                    args.baseline_tokens)
-    ours = measure_tpu(config, args.prompt_len, args.new_tokens,
-                       batch=args.batch)
+    # cfg1: tiny-gpt2, 2-shard, 20 tokens — the notebook workload, timed
+    # e2e as mandated. With ~2 dispatches x rtt_ms of tunnel latency in a
+    # sub-second workload, this row is RTT-bound by construction; the
+    # steady-state row shows what the chip itself does.
+    ref_tiny = measure_reference_cpu(tiny, 4, 20)
+    pipe_tiny = measure_pipeline(tiny, 2, 4, two_point=False, new_tokens=20)
+    tiny_ss = measure_pipeline(tiny, 2, 4, two_point=True)
+    configs.append({
+        "name": "cfg1_tiny_gpt2_2shard_20tok",
+        "tokens_per_sec": round(pipe_tiny["tokens_per_sec"], 2),
+        "steady_state_tokens_per_sec": round(tiny_ss["tokens_per_sec"], 2),
+        "ref_cpu_tokens_per_sec": round(ref_tiny, 2),
+        "vs_baseline": round(pipe_tiny["tokens_per_sec"] / ref_tiny, 2),
+        "steady_state_vs_baseline": round(
+            tiny_ss["tokens_per_sec"] / ref_tiny, 2),
+        "transfer_rtt_ms": round(rtt_ms, 1),
+        "note": "2-stage single-program pipeline, " + pipe_tiny["placement"]
+                + "; e2e 20-token run pays several fixed tunnel transfers",
+    })
+
+    if args.quick:
+        print(json.dumps({
+            "metric": "greedy_decode_throughput_tiny",
+            "value": configs[0]["tokens_per_sec"],
+            "unit": "tokens/sec",
+            "vs_baseline": configs[0]["vs_baseline"],
+            "configs": configs,
+        }))
+        return
+
+    # Shared 124M baseline: the reference O(n^2) loop, 20 tokens.
+    ref_124 = measure_reference_cpu(g124, PROMPT_LEN, 20)
+
+    # cfg2: 124M single stream — 2-shard pipeline AND the fused
+    # single-chip engine (fp32 parity mode + bf16 fast path).
+    pipe_124 = measure_pipeline(g124, 2, PROMPT_LEN, 1, "bfloat16")
+    eng_f32 = measure_engine(g124, PROMPT_LEN, 1, "float32")
+    eng_bf16 = measure_engine(g124, PROMPT_LEN, 1, "bfloat16")
+    configs.append({
+        "name": "cfg2_gpt2_124m_2shard_single_prompt",
+        "tokens_per_sec": round(pipe_124["tokens_per_sec"], 2),
+        "engine_fp32_tokens_per_sec": round(eng_f32["tokens_per_sec"], 2),
+        "engine_bf16_tokens_per_sec": round(eng_bf16["tokens_per_sec"], 2),
+        "p50_token_latency_ms": round(eng_bf16["p50_token_latency_ms"], 3),
+        "e2e_tokens_per_sec": round(eng_bf16["e2e_tokens_per_sec"], 2),
+        "ref_cpu_tokens_per_sec": round(ref_124, 2),
+        "vs_baseline": round(pipe_124["tokens_per_sec"] / ref_124, 2),
+        "engine_bf16_vs_baseline": round(
+            eng_bf16["tokens_per_sec"] / ref_124, 2),
+        "note": "steady-state (marginal) decode rates; 2-stage bf16 "
+                "pipeline, " + pipe_124["placement"]
+                + "; engine rows are the unstaged single-chip path",
+    })
+
+    # cfg3: 124M batch=8. Reference baseline: 8 sequential bs=1 streams ==
+    # the same tokens/sec (server.py:137 hardcodes batch 1).
+    b8_f32 = measure_engine(g124, PROMPT_LEN, 8, "float32")
+    b8_bf16 = measure_engine(g124, PROMPT_LEN, 8, "bfloat16")
+    configs.append({
+        "name": "cfg3_gpt2_124m_bs8",
+        "tokens_per_sec": round(b8_bf16["tokens_per_sec"], 2),
+        "engine_fp32_tokens_per_sec": round(b8_f32["tokens_per_sec"], 2),
+        "ref_cpu_tokens_per_sec": round(ref_124, 2),
+        "vs_baseline": round(b8_bf16["tokens_per_sec"] / ref_124, 2),
+        "note": "aggregate steady-state tokens/sec over 8 rows; reference "
+                "can only run them sequentially at its bs=1 rate",
+    })
+
+    # cfg4: gpt2-medium, 4-shard pipeline.
+    ref_med = measure_reference_cpu(gmed, PROMPT_LEN, 10)
+    pipe_med = measure_pipeline(gmed, 4, PROMPT_LEN, 1, "bfloat16")
+    configs.append({
+        "name": "cfg4_gpt2_medium_4shard",
+        "tokens_per_sec": round(pipe_med["tokens_per_sec"], 2),
+        "ref_cpu_tokens_per_sec": round(ref_med, 2),
+        "vs_baseline": round(pipe_med["tokens_per_sec"] / ref_med, 2),
+        "placement": pipe_med["placement"],
+        "note": "steady-state bf16 4-stage pipeline; baseline is the "
+                "reference algorithm on gpt2-medium",
+    })
+
+    # cfg5: KV cache vs O(n^2) — both on this framework, same chip, plus
+    # the reference CPU loop for scale.
+    uncached = measure_uncached_jax(g124, PROMPT_LEN, STEPS_B)
+    configs.append({
+        "name": "cfg5_kv_cache_vs_on2",
+        "tokens_per_sec": round(eng_bf16["tokens_per_sec"], 2),
+        "uncached_jax_tokens_per_sec": round(uncached, 2),
+        "cache_speedup": round(eng_bf16["tokens_per_sec"] / uncached, 2),
+        "ref_cpu_tokens_per_sec": round(ref_124, 2),
+        "vs_baseline": round(eng_bf16["tokens_per_sec"] / ref_124, 2),
+        "note": "uncached = full fixed-length re-forward per token on-chip "
+                "(the reference's algorithm, server.py:169-181), bf16, "
+                f"{STEPS_B} tokens",
+    })
 
     print(json.dumps({
-        "metric": "greedy_decode_throughput_gpt2_124m"
-                  if not args.quick else "greedy_decode_throughput_tiny",
-        "value": round(ours["tokens_per_sec"], 2),
+        "metric": "greedy_decode_throughput_gpt2_124m",
+        "value": configs[1]["engine_bf16_tokens_per_sec"],
         "unit": "tokens/sec",
-        "vs_baseline": round(ours["tokens_per_sec"] / ref_tps, 2),
-        "baseline_cpu_tokens_per_sec": round(ref_tps, 2),
-        "p50_token_latency_ms": round(ours["p50_token_latency_ms"], 3),
-        "prefill_ms": round(ours["prefill_ms"], 2),
-        "batch": args.batch,
+        "vs_baseline": configs[1]["engine_bf16_vs_baseline"],
+        "dtype": "bfloat16",
+        "fp32_tokens_per_sec": configs[1]["engine_fp32_tokens_per_sec"],
+        "transfer_rtt_ms": round(rtt_ms, 1),
+        "configs": configs,
     }))
 
 

@@ -128,16 +128,20 @@ def embed(params: Params, input_ids: jnp.ndarray,
 
     ``position_offset`` is the absolute position of the first token (nonzero
     during incremental decode). The reference always uses offset 0 because it
-    re-forwards the full sequence (server.py:80).
+    re-forwards the full sequence (server.py:80). A ``[B, 1]`` offset gives
+    per-row positions for left-padded ragged batches (pad columns clip to
+    position 0; their outputs are never read — attention masks them as keys
+    and sampling reads only the final, real column).
     """
     seq_len = input_ids.shape[-1]
-    positions = position_offset + jnp.arange(seq_len)
+    positions = jnp.maximum(position_offset + jnp.arange(seq_len), 0)
     return params["wte"][input_ids] + params["wpe"][positions]
 
 
 def _block(block_params: Params, h: jnp.ndarray, n_head: int, eps: float,
            cache_k: Optional[jnp.ndarray], cache_v: Optional[jnp.ndarray],
            offset, attn_impl: str = "xla",
+           k_valid_from: Optional[jnp.ndarray] = None,
            ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
     """One pre-LN transformer block; optionally reads/writes a KV cache slice."""
     a = layer_norm(h, block_params["ln_1"]["scale"], block_params["ln_1"]["bias"], eps)
@@ -151,10 +155,12 @@ def _block(block_params: Params, h: jnp.ndarray, n_head: int, eps: float,
             attn_out = flash_attention(
                 q, k, v, interpret=jax.default_backend() != "tpu")
         else:
-            attn_out = causal_attention(q, k, v, q_offset=offset)
+            attn_out = causal_attention(q, k, v, q_offset=offset,
+                                        k_valid_from=k_valid_from)
         new_ck = new_cv = None
     else:
-        attn_out, new_ck, new_cv = cached_attention(q, k, v, cache_k, cache_v, offset)
+        attn_out, new_ck, new_cv = cached_attention(q, k, v, cache_k, cache_v,
+                                                    offset, k_valid_from)
     attn_out = linear(merge_heads(attn_out),
                       block_params["attn"]["c_proj"]["kernel"],
                       block_params["attn"]["c_proj"]["bias"])
@@ -169,6 +175,7 @@ def _block(block_params: Params, h: jnp.ndarray, n_head: int, eps: float,
 
 def apply_blocks(blocks: Params, h: jnp.ndarray, config: GPT2Config,
                  cache: Optional[KVCache] = None, remat: bool = False,
+                 k_valid_from: Optional[jnp.ndarray] = None,
                  ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Run a stack of blocks (leading layer axis) via ``lax.scan``.
 
@@ -187,7 +194,7 @@ def apply_blocks(blocks: Params, h: jnp.ndarray, config: GPT2Config,
     if cache is None:
         def body(carry, layer_params):
             out, _, _ = _block(layer_params, carry, n_head, eps, None, None,
-                               0, config.attention_impl)
+                               0, config.attention_impl, k_valid_from)
             return out, None
 
         if remat:
@@ -199,7 +206,8 @@ def apply_blocks(blocks: Params, h: jnp.ndarray, config: GPT2Config,
 
     def body(carry, xs):
         layer_params, ck, cv = xs
-        out, new_ck, new_cv = _block(layer_params, carry, n_head, eps, ck, cv, offset)
+        out, new_ck, new_cv = _block(layer_params, carry, n_head, eps, ck, cv,
+                                     offset, k_valid_from=k_valid_from)
         return out, (new_ck, new_cv)
 
     h, (new_k, new_v) = jax.lax.scan(body, h, (blocks, cache.k, cache.v))
@@ -212,10 +220,14 @@ def final_logits(params: Params, h: jnp.ndarray, eps: float) -> jnp.ndarray:
 
     Equivalent of the reference's ShardB tail (ln_f -> lm_head,
     server.py:101-102); tying to ``wte`` matches GPT-2's actual weight
-    sharing, which HF also applies.
+    sharing, which HF also applies. Logits accumulate in float32 even under
+    bfloat16 weights/activations so argmax/sampling see full-precision
+    scores (bf16 logits would quantize ~3 decimal digits and break greedy
+    tie behavior).
     """
     h = layer_norm(h, params["ln_f"]["scale"], params["ln_f"]["bias"], eps)
-    return h @ params["wte"].T
+    return jnp.einsum("bsd,vd->bsv", h, params["wte"],
+                      preferred_element_type=jnp.float32)
 
 
 def forward(params: Params, input_ids: jnp.ndarray,
@@ -233,15 +245,27 @@ def forward(params: Params, input_ids: jnp.ndarray,
 
 def forward_with_cache(params: Params, input_ids: jnp.ndarray,
                        config: GPT2Config, cache: KVCache,
+                       pad: Optional[jnp.ndarray] = None,
                        ) -> Tuple[jnp.ndarray, KVCache]:
     """Cached forward (prefill when cache.length==0, decode step otherwise).
 
     Returns full-sequence logits and the updated cache. The decode engine
     (runtime.engine) jits this once for prefill shapes and once for the
     single-token step.
+
+    ``pad`` ([B] int32, optional) enables ragged batches of left-padded
+    prompts: row b's first ``pad[b]`` cache slots are pad tokens, so its
+    positions shift down by ``pad[b]`` and those slots are masked as keys.
+    Cache indices stay uniform across rows (the point of left-padding: one
+    ``dynamic_update_slice`` serves the whole batch).
     """
-    h = embed(params, input_ids, cache.length)
-    h, cache = apply_blocks(params["blocks"], h, config, cache)
+    if pad is None:
+        h = embed(params, input_ids, cache.length)
+        h, cache = apply_blocks(params["blocks"], h, config, cache)
+    else:
+        h = embed(params, input_ids, cache.length - pad[:, None])
+        h, cache = apply_blocks(params["blocks"], h, config, cache,
+                                k_valid_from=pad)
     return final_logits(params, h, config.layer_norm_epsilon), cache
 
 

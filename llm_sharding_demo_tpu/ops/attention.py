@@ -65,13 +65,21 @@ def merge_heads(x: jnp.ndarray) -> jnp.ndarray:
 
 def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                      q_offset: jnp.ndarray | int = 0,
-                     kv_length: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                     kv_length: Optional[jnp.ndarray] = None,
+                     k_valid_from: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Scaled dot-product attention with causal masking by absolute position.
 
     q: [B, H, Sq, hd]; k, v: [B, H, Skv, hd].
     Query i attends to key j iff ``j <= q_offset + i`` and ``j < kv_length``
     (``kv_length`` defaults to Skv). This one predicate covers both the
     prefill triangle and the decode row against a fixed-size cache.
+
+    ``k_valid_from`` ([B] int32, optional) is the ragged-batch extension:
+    row b additionally ignores keys at positions ``< k_valid_from[b]``.
+    With left-padded prompts the pad prefix occupies cache slots
+    ``[0, pad_b)``, so passing ``pad`` here makes unequal-length prompts in
+    one batch attend only to their own real tokens (the reference hardcodes
+    batch=1, server.py:137, and has no mask at all).
     """
     b, h, sq, hd = q.shape
     skv = k.shape[2]
@@ -84,7 +92,12 @@ def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     allowed = k_pos <= q_pos                            # causal
     if kv_length is not None:
         allowed = allowed & (k_pos < kv_length)
-    scores = jnp.where(allowed[None, None, :, :], scores, NEG_INF)
+    if k_valid_from is None:
+        allowed = allowed[None, None, :, :]             # [1, 1, Sq, Skv]
+    else:
+        allowed = (allowed[None, :, :]
+                   & (k_pos >= k_valid_from[:, None, None]))[:, None, :, :]
+    scores = jnp.where(allowed, scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
     return out
@@ -93,6 +106,7 @@ def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 def cached_attention(q: jnp.ndarray, k_new: jnp.ndarray, v_new: jnp.ndarray,
                      cache_k: jnp.ndarray, cache_v: jnp.ndarray,
                      offset: jnp.ndarray,
+                     k_valid_from: Optional[jnp.ndarray] = None,
                      ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Write new K/V at ``offset`` into the fixed-size cache, then attend.
 
@@ -101,11 +115,13 @@ def cached_attention(q: jnp.ndarray, k_new: jnp.ndarray, v_new: jnp.ndarray,
     ``lax.dynamic_update_slice`` so shapes stay static under jit — this is
     the KV-cache mechanism BASELINE.json config 5 requires, absent from the
     reference (it re-forwards the whole sequence per token, server.py:169).
+    ``k_valid_from`` masks each row's left-pad prefix (see
+    ``causal_attention``).
     """
     s = k_new.shape[2]
     start = (0, 0, offset, 0)
     cache_k = jax.lax.dynamic_update_slice(cache_k, k_new.astype(cache_k.dtype), start)
     cache_v = jax.lax.dynamic_update_slice(cache_v, v_new.astype(cache_v.dtype), start)
     out = causal_attention(q, cache_k, cache_v, q_offset=offset,
-                           kv_length=offset + s)
+                           kv_length=offset + s, k_valid_from=k_valid_from)
     return out, cache_k, cache_v

@@ -148,6 +148,7 @@ def partition_params(params: Params, specs: Sequence[StageSpec]) -> List[Params]
 
 def stage_apply(stage_params: Params, spec: StageSpec, config: GPT2Config,
                 x: jnp.ndarray, cache: Optional[KVCache] = None,
+                pad: Optional[jnp.ndarray] = None,
                 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Run one stage. First stage takes ``[B,S]`` ids, others ``[B,S,D]``
     hidden states; last stage returns ``[B,S,vocab]`` logits.
@@ -161,11 +162,16 @@ def stage_apply(stage_params: Params, spec: StageSpec, config: GPT2Config,
     cache is present, else 0. A caller-supplied offset could desynchronize
     the wpe gather from the attention mask / cache-write position, which
     both always come from the cache — so the knob deliberately doesn't
-    exist.
+    exist. ``pad`` ([B] int32) is the ragged-batch left-pad vector (see
+    models.gpt2.forward_with_cache): it shifts positions down per row and
+    masks each row's pad prefix as keys.
     """
     position_offset = cache.length if cache is not None else 0
+    if pad is not None:
+        position_offset = position_offset - pad[:, None]
     h = embed(stage_params, x, position_offset) if spec.is_first else x
-    h, cache = apply_blocks(stage_params["blocks"], h, config, cache)
+    h, cache = apply_blocks(stage_params["blocks"], h, config, cache,
+                            k_valid_from=pad)
     if spec.is_last:
         head_params = {"ln_f": stage_params["ln_f"], "wte": stage_params["wte_out"]}
         h = final_logits(head_params, h, config.layer_norm_epsilon)

@@ -135,8 +135,9 @@ class PipelineRunner:
         guard) is shared with the single-device engine via
         ``runtime.engine.prepare_generate``.
         """
-        ids, batch, prompt_len, key = prepare_generate(
-            prompt_ids, max_new_tokens, self.max_seq, sampling, key)
+        ids, batch, prompt_len, key, _ = prepare_generate(
+            prompt_ids, max_new_tokens, self.max_seq, sampling, key,
+            allow_ragged=False)
 
         caches = self.init_caches(batch)
         ids_j = jnp.asarray(ids, dtype=jnp.int32)

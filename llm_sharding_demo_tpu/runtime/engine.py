@@ -82,20 +82,58 @@ def select_token(logits: jnp.ndarray, sampling: SamplingConfig,
     return jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
 
 
+def left_pad(prompts, pad_id: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Ragged prompt list -> (ids [B, S_max] left-padded, pad [B]).
+
+    Left-padding (not right-) is the TPU-shaped choice: every row's last
+    prompt token lands in the same column, so prefill sampling reads one
+    column, decode cache writes use one uniform ``dynamic_update_slice``
+    offset for the whole batch, and no per-row scatter is ever needed. The
+    pad prefix is excluded via per-row position offsets and the
+    ``k_valid_from`` attention mask (ops.attention.causal_attention).
+    """
+    rows = [np.asarray(p, dtype=np.int32).reshape(-1) for p in prompts]
+    if any(len(r) < 1 for r in rows):
+        raise ValueError("every prompt must be non-empty")
+    s_max = max(len(r) for r in rows)
+    ids = np.full((len(rows), s_max), pad_id, dtype=np.int32)
+    pad = np.zeros((len(rows),), dtype=np.int32)
+    for i, r in enumerate(rows):
+        ids[i, s_max - len(r):] = r
+        pad[i] = s_max - len(r)
+    return ids, pad
+
+
 def prepare_generate(prompt_ids, max_new_tokens: int, max_seq: int,
                      sampling: SamplingConfig, key: Optional[jax.Array],
-                     ) -> Tuple[np.ndarray, int, int, jax.Array]:
+                     allow_ragged: bool = True,
+                     ) -> Tuple[np.ndarray, int, int, jax.Array, np.ndarray]:
     """Shared validation/normalization for every ``generate`` front end
     (single-device engine and pipeline runner).
 
-    Returns ``(ids [B,S], batch, prompt_len, key)``. The overflow check is
-    the static guard against silent KV-cache clamping: past ``max_seq``,
-    ``dynamic_update_slice`` would clamp the write offset and corrupt
-    generation without an error (see ops.attention.cached_attention).
+    Returns ``(ids [B,S], batch, prompt_len, key, pad [B])``. Ragged input
+    (a list of unequal-length sequences) is left-padded; ``pad[b]`` is row
+    b's pad-prefix length (all zeros for rectangular input). The overflow
+    check is the static guard against silent KV-cache clamping: past
+    ``max_seq``, ``dynamic_update_slice`` would clamp the write offset and
+    corrupt generation without an error (see ops.attention.cached_attention).
     """
-    ids = np.asarray(prompt_ids)
-    if ids.ndim == 1:
-        ids = ids[None, :]
+    if (isinstance(prompt_ids, (list, tuple)) and prompt_ids
+            and not np.isscalar(prompt_ids[0])
+            and len({len(np.asarray(p).reshape(-1)) for p in prompt_ids}) > 1):
+        if not allow_ragged:
+            # Central guard: a ragged batch reaching a rectangular-only
+            # front end would decode wrong tokens silently (one uniform
+            # cache-write offset per batch), so refuse here, once.
+            raise NotImplementedError(
+                "this generate front end requires equal-length prompts; "
+                "ragged batches go through runtime.engine.DecodeEngine")
+        ids, pad = left_pad(prompt_ids)
+    else:
+        ids = np.asarray(prompt_ids)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        pad = np.zeros((ids.shape[0],), dtype=np.int32)
     batch, prompt_len = ids.shape
     if prompt_len < 1:
         raise ValueError("prompt must be non-empty")
@@ -111,7 +149,7 @@ def prepare_generate(prompt_ids, max_new_tokens: int, max_seq: int,
         raise ValueError("sample mode requires an explicit PRNG key")
     if key is None:
         key = jax.random.PRNGKey(0)  # unused by greedy; fixed for shape
-    return ids, batch, prompt_len, key
+    return ids, batch, prompt_len, key, pad
 
 
 @dataclasses.dataclass
@@ -132,6 +170,12 @@ class GenerateResult:
     decode_seconds: float
     new_tokens: int
     decode_steps: int
+    pad: Optional[np.ndarray] = None  # [B] left-pad prefix lengths (ragged)
+
+    def row_tokens(self, i: int) -> np.ndarray:
+        """Row i's tokens with its left-pad prefix stripped."""
+        start = int(self.pad[i]) if self.pad is not None else 0
+        return self.tokens[i, start:]
 
     @property
     def tokens_per_second(self) -> float:
@@ -152,17 +196,47 @@ class DecodeEngine:
     """Single-model decode engine (pipeline-parallel variant in
     ``parallel.pipeline``): owns jitted prefill/decode programs keyed by
     static shapes, so repeated ``generate`` calls reuse compilations.
+
+    ``boundaries`` switches on *staged* mode: params are partitioned into
+    N validated pipeline stages (parallel.partition) and the compiled
+    programs compose ``stage_apply`` over them — the whole multi-stage
+    decode is still ONE program per phase (a single dispatch for the entire
+    token scan), unlike the host-driven ``PipelineRunner`` which pays
+    n_stages dispatches + transfers per token. On one chip this is the
+    honest "N-shard" configuration (stage partitioning real, placement
+    colocated); the multi-device single-program form lives in
+    ``parallel.ppdecode`` (shard_map + ppermute over a pp mesh axis).
     """
 
     def __init__(self, params: Params, config: GPT2Config, max_seq: int,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, boundaries=None):
+        """``dtype`` is the inference compute dtype: float params are cast
+        once here and the KV cache allocates in it. bfloat16 halves weight
+        and cache HBM traffic (the decode bottleneck — each token streams
+        every weight once); LN statistics, softmax, and the final logits
+        stay float32 (ops.layers.layer_norm, ops.attention, final_logits),
+        so bf16 degrades only the matmul operand precision. float32 remains
+        the greedy-parity mode BASELINE.json specifies."""
         if max_seq > config.n_positions:
             raise ValueError(
                 f"max_seq={max_seq} exceeds n_positions={config.n_positions}")
-        self.params = params
+        self.params = jax.tree.map(
+            lambda x: x.astype(dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
         self.config = config
         self.max_seq = max_seq
         self.dtype = dtype
+        if boundaries is None:
+            self.specs = None
+            self.stage_params = None
+        else:
+            from ..parallel import partition as P
+            self.specs = P.make_stage_specs(config.n_layer, boundaries)
+            self.stage_params = P.partition_params(self.params, self.specs)
+            # The compiled programs only ever see the staged copy; dropping
+            # the monolithic pytree keeps one set of weights resident, not
+            # two (the slices are new buffers).
+            self.params = None
         # Prefill allocates its cache *inside* the program (zeros are free
         # under XLA and the layout matches the decode program exactly);
         # decode donates the prefill-produced cache so the two
@@ -176,15 +250,37 @@ class DecodeEngine:
 
     # -- compiled programs ---------------------------------------------------
 
-    def _prefill_impl(self, params: Params, ids: jnp.ndarray
+    def _fresh_cache(self, batch: int):
+        if self.specs is None:
+            return gpt2.make_cache(self.config, batch, self.max_seq, self.dtype)
+        from ..parallel import partition as P
+        return [P.make_stage_cache(s, self.config, batch, self.max_seq,
+                                   self.dtype) for s in self.specs]
+
+    def _forward_cached(self, params, x, cache, pad):
+        """One cached forward — plain (fused model) or staged composition."""
+        if self.specs is None:
+            return gpt2.forward_with_cache(params, x, self.config, cache, pad)
+        from ..parallel import partition as P
+        new_caches = []
+        for sp, spec, c in zip(params, self.specs, cache):
+            x, c = P.stage_apply(sp, spec, self.config, x, c, pad)
+            new_caches.append(c)
+        return x, new_caches
+
+    def _run_params(self):
+        return self.stage_params if self.specs is not None else self.params
+
+    def _prefill_impl(self, params: Params, ids: jnp.ndarray,
+                      pad: Optional[jnp.ndarray],
                       ) -> Tuple[jnp.ndarray, KVCache]:
-        cache = gpt2.make_cache(self.config, ids.shape[0], self.max_seq,
-                                self.dtype)
-        logits, cache = gpt2.forward_with_cache(params, ids, self.config, cache)
+        cache = self._fresh_cache(ids.shape[0])
+        logits, cache = self._forward_cached(params, ids, cache, pad)
         return logits[:, -1], cache
 
     def _decode_impl(self, params: Params, first_token: jnp.ndarray,
-                     cache: KVCache, key: jax.Array, *, steps: int,
+                     cache: KVCache, pad: Optional[jnp.ndarray],
+                     key: jax.Array, *, steps: int,
                      sampling: SamplingConfig) -> Tuple[jnp.ndarray, KVCache]:
         """lax.scan over ``steps - 1`` cached single-token forwards.
 
@@ -203,8 +299,8 @@ class DecodeEngine:
 
         def body(carry, step_key):
             token, cache = carry
-            logits, cache = gpt2.forward_with_cache(
-                params, token[:, None], self.config, cache)
+            logits, cache = self._forward_cached(
+                params, token[:, None], cache, pad)
             nxt = select_token(logits[:, -1], sampling, step_key)
             return (nxt, cache), nxt
 
@@ -223,18 +319,24 @@ class DecodeEngine:
         Validation (including the static cache-overflow guard) is shared
         with the pipeline runner via ``prepare_generate``.
         """
-        ids, batch, prompt_len, key = prepare_generate(
+        ids, batch, prompt_len, key, pad = prepare_generate(
             prompt_ids, max_new_tokens, self.max_seq, sampling, key)
 
         ids_j = jnp.asarray(ids, dtype=jnp.int32)
+        # Rectangular batches keep pad=None: the compiled programs then skip
+        # the per-row mask entirely (same numerics, no [B,Sq,Skv] mask
+        # materialization) and stay byte-identical to the pre-ragged path.
+        pad_j = jnp.asarray(pad) if pad.any() else None
 
         t0 = time.perf_counter()
         prefill_key, decode_key = jax.random.split(key)
-        last_logits, cache = self._prefill(self.params, ids_j)
+        run_params = self._run_params()
+        last_logits, cache = self._prefill(run_params, ids_j, pad_j)
         first = select_token(last_logits, sampling, prefill_key)
         first.block_until_ready()
         t1 = time.perf_counter()
-        new, final_cache = self._decode(self.params, first, cache, decode_key,
+        new, final_cache = self._decode(run_params, first, cache, pad_j,
+                                        decode_key,
                                         steps=max_new_tokens, sampling=sampling)
         del final_cache  # aliases the donated prefill cache; nothing to keep
         new = np.asarray(jax.block_until_ready(new))
@@ -244,4 +346,5 @@ class DecodeEngine:
         return GenerateResult(tokens=tokens, prompt_len=prompt_len,
                               prefill_seconds=t1 - t0, decode_seconds=t2 - t1,
                               new_tokens=max_new_tokens,
-                              decode_steps=max_new_tokens - 1)
+                              decode_steps=max_new_tokens - 1,
+                              pad=pad if pad.any() else None)

@@ -64,6 +64,42 @@ def test_batched_greedy_matches_single(hf_engine):
         np.testing.assert_array_equal(single[0], batched[b])
 
 
+def test_ragged_batch_matches_single(hf_engine):
+    """Unequal-length prompts in one batch ≡ per-sequence single decodes
+    (VERDICT item 6: BASELINE config 3 honest for ragged input; the
+    reference hardcodes batch=1, server.py:137)."""
+    _, config, engine = hf_engine
+    rng = np.random.default_rng(5)
+    prompts = [list(rng.integers(0, config.vocab_size, size=(n,)))
+               for n in (3, 7, 5, 7)]
+    got = engine.generate(prompts, max_new_tokens=8)
+    assert got.tokens.shape == (4, 15)      # max prompt 7 + 8 new
+    assert got.pad is not None and list(got.pad) == [4, 0, 2, 0]
+    for b, prompt in enumerate(prompts):
+        single = engine.generate(np.asarray(prompt), max_new_tokens=8).tokens
+        np.testing.assert_array_equal(single[0], got.row_tokens(b))
+
+
+def test_bfloat16_engine_decodes(hf_engine):
+    """bf16 inference mode: params+cache actually in bf16, runs end-to-end,
+    and agrees with fp32 greedy over an initial window. fp32 stays the exact
+    parity mode (VERDICT item 3) — bf16 tokens legitimately diverge once a
+    near-tie lands inside bf16 rounding (observed at step ~12 on this seed),
+    so the gate is a prefix, not the full stream."""
+    model, config, engine = hf_engine
+    params_f32 = engine.params
+    bf16 = DecodeEngine(params_f32, config, max_seq=64, dtype=jnp.bfloat16)
+    assert bf16.params["wte"].dtype == jnp.bfloat16
+    assert bf16._prefill(bf16.params, jnp.asarray([[1, 2]]), None)[1].k.dtype \
+        == jnp.bfloat16
+    prompt = np.asarray([9, 2, 77, 31])
+    got32 = engine.generate(prompt, max_new_tokens=10)
+    got16 = bf16.generate(prompt, max_new_tokens=10)
+    assert got16.tokens.shape == got32.tokens.shape
+    np.testing.assert_array_equal(got16.tokens[:, :10], got32.tokens[:, :10])
+    assert np.all(got16.tokens >= 0) and np.all(got16.tokens < config.vocab_size)
+
+
 def test_overflow_guard(hf_engine):
     _, config, engine = hf_engine
     with pytest.raises(ValueError, match="exceeds max_seq"):
