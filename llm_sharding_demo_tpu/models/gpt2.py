@@ -46,9 +46,13 @@ class GPT2Config:
     n_layer: int = 12
     n_head: int = 12
     layer_norm_epsilon: float = 1e-5
-    # "xla": fused einsum attention (default; the only impl for cached
-    # decode). "pallas": Mosaic kernel (ops.flash_attention) on the
-    # no-cache forward path — training forwards and compat endpoints.
+    # "xla": fused einsum attention (default). "pallas": Mosaic flash
+    # kernel (ops.flash_attention). "ring": sequence-parallel ring
+    # attention over the mesh's "sp" axis (ops.ring_attention) — the
+    # long-context path; requires a mesh passed to ``forward``. All three
+    # apply to the no-cache forward (training / compat endpoints); cached
+    # decode always uses the fused XLA path (single-token steps have no
+    # sequence dim to shard or tile).
     attention_impl: str = "xla"
 
     @property
@@ -59,9 +63,9 @@ class GPT2Config:
         if self.n_embd % self.n_head != 0:
             raise ValueError(
                 f"n_embd={self.n_embd} not divisible by n_head={self.n_head}")
-        if self.attention_impl not in ("xla", "pallas"):
+        if self.attention_impl not in ("xla", "pallas", "ring"):
             raise ValueError(
-                f"attention_impl={self.attention_impl!r} not xla|pallas")
+                f"attention_impl={self.attention_impl!r} not xla|pallas|ring")
 
 
 # Named configs for the BASELINE.json measurement matrix. "tiny-gpt2" matches
@@ -141,7 +145,7 @@ def embed(params: Params, input_ids: jnp.ndarray,
 def _block(block_params: Params, h: jnp.ndarray, n_head: int, eps: float,
            cache_k: Optional[jnp.ndarray], cache_v: Optional[jnp.ndarray],
            offset, attn_impl: str = "xla",
-           k_valid_from: Optional[jnp.ndarray] = None,
+           k_valid_from: Optional[jnp.ndarray] = None, mesh=None,
            ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
     """One pre-LN transformer block; optionally reads/writes a KV cache slice."""
     a = layer_norm(h, block_params["ln_1"]["scale"], block_params["ln_1"]["bias"], eps)
@@ -154,6 +158,17 @@ def _block(block_params: Params, h: jnp.ndarray, n_head: int, eps: float,
             from ..ops.flash_attention import flash_attention  # lazy import
             attn_out = flash_attention(
                 q, k, v, interpret=jax.default_backend() != "tpu")
+        elif attn_impl == "ring":
+            from ..ops.ring_attention import ring_attention  # lazy import
+            if mesh is None:
+                raise ValueError(
+                    "attention_impl='ring' needs a mesh with an 'sp' axis: "
+                    "pass forward(..., mesh=mesh) (or TrainStep(mesh=...))")
+            if k_valid_from is not None:
+                raise NotImplementedError(
+                    "ring attention does not support ragged (left-padded) "
+                    "batches")
+            attn_out = ring_attention(q, k, v, mesh, axis="sp")
         else:
             attn_out = causal_attention(q, k, v, q_offset=offset,
                                         k_valid_from=k_valid_from)
@@ -175,7 +190,7 @@ def _block(block_params: Params, h: jnp.ndarray, n_head: int, eps: float,
 
 def apply_blocks(blocks: Params, h: jnp.ndarray, config: GPT2Config,
                  cache: Optional[KVCache] = None, remat: bool = False,
-                 k_valid_from: Optional[jnp.ndarray] = None,
+                 k_valid_from: Optional[jnp.ndarray] = None, mesh=None,
                  ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Run a stack of blocks (leading layer axis) via ``lax.scan``.
 
@@ -194,7 +209,7 @@ def apply_blocks(blocks: Params, h: jnp.ndarray, config: GPT2Config,
     if cache is None:
         def body(carry, layer_params):
             out, _, _ = _block(layer_params, carry, n_head, eps, None, None,
-                               0, config.attention_impl, k_valid_from)
+                               0, config.attention_impl, k_valid_from, mesh)
             return out, None
 
         if remat:
@@ -231,15 +246,17 @@ def final_logits(params: Params, h: jnp.ndarray, eps: float) -> jnp.ndarray:
 
 
 def forward(params: Params, input_ids: jnp.ndarray,
-            config: GPT2Config, remat: bool = False) -> jnp.ndarray:
+            config: GPT2Config, remat: bool = False, mesh=None) -> jnp.ndarray:
     """Full no-cache forward: [B, S] -> [B, S, vocab] logits.
 
     The parity oracle against HF GPT-2 (SURVEY.md §4 item 1) and the compat
     ``/forward`` + ``/forward_b`` composition both go through here.
-    ``remat`` is for the training path (see ``apply_blocks``).
+    ``remat`` is for the training path (see ``apply_blocks``); ``mesh`` is
+    required when ``config.attention_impl == "ring"`` (the sequence-
+    parallel long-context path shards attention over the mesh's sp axis).
     """
     h = embed(params, input_ids, 0)
-    h, _ = apply_blocks(params["blocks"], h, config, remat=remat)
+    h, _ = apply_blocks(params["blocks"], h, config, remat=remat, mesh=mesh)
     return final_logits(params, h, config.layer_norm_epsilon)
 
 

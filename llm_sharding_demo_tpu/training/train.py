@@ -33,13 +33,15 @@ from ..parallel import spmd
 
 
 def lm_loss(params: Params, ids: jnp.ndarray, config: GPT2Config,
-            remat: bool = False) -> jnp.ndarray:
+            remat: bool = False, mesh: Optional[Mesh] = None) -> jnp.ndarray:
     """Mean next-token cross-entropy over ``ids`` [B, S] (S >= 2).
 
     Logits for positions ``0..S-2`` predict tokens ``1..S-1``. The softmax
-    cross-entropy runs in float32 regardless of activation dtype.
+    cross-entropy runs in float32 regardless of activation dtype. ``mesh``
+    reaches the forward for ``attention_impl="ring"`` (sequence-parallel
+    attention over the sp axis).
     """
-    logits = gpt2.forward(params, ids[:, :-1], config, remat=remat)
+    logits = gpt2.forward(params, ids[:, :-1], config, remat=remat, mesh=mesh)
     losses = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), ids[:, 1:])
     return jnp.mean(losses)
@@ -67,7 +69,8 @@ class TrainStep:
 
     def __post_init__(self):
         loss_fn = self.loss_fn or (
-            lambda p, ids: lm_loss(p, ids, self.config, self.remat))
+            lambda p, ids: lm_loss(p, ids, self.config, self.remat,
+                                   mesh=self.mesh))
 
         def step(params, opt_state, ids):
             loss, grads = jax.value_and_grad(loss_fn)(params, ids)

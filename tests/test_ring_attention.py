@@ -55,6 +55,62 @@ def test_ring_is_differentiable():
                                    atol=5e-5, rtol=5e-5)
 
 
+def test_ring_reachable_from_model_config():
+    """VERDICT item 5: attention_impl='ring' is a product path, not an
+    orphan — the full model forward with a sequence-sharded mesh matches
+    the xla forward bit-tolerance-exactly."""
+    from llm_sharding_demo_tpu.models import gpt2
+
+    mesh = spmd.make_mesh({"dp": 2, "sp": 4})
+    cfg_x = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_embd=32,
+                            n_layer=2, n_head=4)
+    cfg_r = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_embd=32,
+                            n_layer=2, n_head=4, attention_impl="ring")
+    params = gpt2.init_params(cfg_x, jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 97, size=(4, 16)),
+                      dtype=jnp.int32)
+    ref = gpt2.forward(params, ids, cfg_x)
+    got = jax.jit(lambda p, i: gpt2.forward(p, i, cfg_r, mesh=mesh))(params, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+    with pytest.raises(ValueError, match="needs a mesh"):
+        gpt2.forward(params, ids, cfg_r)
+
+
+def test_ring_train_step_matches_unsharded():
+    """sp-sharded ring training step ≡ unsharded xla training step: same
+    loss and same updated params after one AdamW step on the 8-device
+    mesh."""
+    from llm_sharding_demo_tpu.models import gpt2
+    from llm_sharding_demo_tpu.training import train
+
+    cfg_x = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_embd=32,
+                            n_layer=2, n_head=4)
+    cfg_r = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_embd=32,
+                            n_layer=2, n_head=4, attention_impl="ring")
+    params = gpt2.init_params(cfg_x, jax.random.PRNGKey(0))
+    ids = np.random.default_rng(1).integers(0, 97, size=(4, 17))
+
+    ref_step = train.TrainStep(cfg_x, train.adamw(1e-3))
+    p0, s0 = ref_step.init(params)
+    p_ref, _, loss_ref = ref_step(p0, s0, ref_step.shard_batch(ids))
+
+    mesh = spmd.make_mesh({"dp": 2, "sp": 4})
+    ring_step = train.TrainStep(cfg_r, train.adamw(1e-3), mesh=mesh)
+    p1, s1 = ring_step.init(params)
+    # ids stay [4, 17] (S-1 = 16 divides by sp inside the forward); the
+    # [B, S] token batch itself can't shard its 17-long seq dim over sp=4,
+    # so hand it over unsharded and let GSPMD place it.
+    p_ring, _, loss_ring = ring_step(p1, s1, jnp.asarray(ids, jnp.int32))
+
+    np.testing.assert_allclose(float(loss_ring), float(loss_ref),
+                               atol=1e-5, rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5),
+        p_ring, p_ref)
+
+
 def test_ring_validation():
     mesh = spmd.make_mesh({"sp": 4, "dp": 2})
     q, k, v = _rand_qkv(1, 2, 10, 4)  # 10 % 4 != 0
