@@ -54,6 +54,7 @@ def unmicrobatch(h: jnp.ndarray) -> jnp.ndarray:
 def gpipe_apply_blocks(stacked_blocks: Params, h_micro: jnp.ndarray,
                        config: GPT2Config, mesh: Mesh,
                        pp_axis: str = "pp", remat: bool = False,
+                       valid: Optional[jnp.ndarray] = None,
                        ) -> jnp.ndarray:
     """Run stage-major stacked blocks over microbatched hidden states.
 
@@ -61,6 +62,11 @@ def gpipe_apply_blocks(stacked_blocks: Params, h_micro: jnp.ndarray,
     ``P(pp_axis, ...)``; ``h_micro``: ``[M, mb, seq, D]`` replicated over
     ``pp`` (dp/sp sharding on mb/seq rides along as automatic axes).
     Returns ``[M, mb, seq, D]``.
+
+    ``valid`` ([n_stages, per_stage] bool) marks real vs padding block
+    rows for unequal stage sizes (``partition.stack_stage_params_padded``);
+    padding rows run but are masked to identity. ``None`` means all rows
+    are real (the equal-stage layout).
 
     Schedule: T = M + S - 1 ticks via ``lax.scan``. Stage 0 feeds
     microbatch t (clamped; overrun ticks recompute a stale microbatch whose
@@ -76,9 +82,11 @@ def gpipe_apply_blocks(stacked_blocks: Params, h_micro: jnp.ndarray,
     n_micro = h_micro.shape[0]
     n_ticks = n_micro + n_stages - 1
 
-    def per_stage(blocks_local: Params, h_all: jnp.ndarray) -> jnp.ndarray:
+    def per_stage(blocks_local: Params, valid_local,
+                  h_all: jnp.ndarray) -> jnp.ndarray:
         # local view: [1, per_stage, ...] -> [per_stage, ...]
         blocks_local = jax.tree_util.tree_map(lambda x: x[0], blocks_local)
+        valid_row = None if valid_local is None else valid_local[0]
         stage = jax.lax.axis_index(pp_axis)
         zeros_state = jnp.zeros(h_all.shape[1:], h_all.dtype)
         # mark the scan carry as pp-varying up front (it becomes varying
@@ -91,7 +99,8 @@ def gpipe_apply_blocks(stacked_blocks: Params, h_micro: jnp.ndarray,
             feed = jax.lax.dynamic_index_in_dim(
                 h_all, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False)
             x = jnp.where(stage == 0, feed, state)
-            y, _ = apply_blocks(blocks_local, x, config, remat=remat)
+            y, _ = apply_blocks(blocks_local, x, config, remat=remat,
+                                valid=valid_row)
             # hop to the next stage over the ICI ring; stage 0 receives
             # zeros (it is fed from h_all, never from a predecessor)
             incoming = jax.lax.ppermute(
@@ -107,10 +116,16 @@ def gpipe_apply_blocks(stacked_blocks: Params, h_micro: jnp.ndarray,
         outputs = jnp.where(stage == n_stages - 1, outputs, 0.0)
         return jax.lax.psum(outputs, pp_axis)
 
+    if valid is None:
+        return jax.shard_map(
+            lambda b, h: per_stage(b, None, h), mesh=mesh,
+            in_specs=(P(pp_axis), P()), out_specs=P(),
+            axis_names={pp_axis})(stacked_blocks, h_micro)
+    valid = jax.device_put(valid, NamedSharding(mesh, P(pp_axis)))
     return jax.shard_map(
         per_stage, mesh=mesh,
-        in_specs=(P(pp_axis), P()), out_specs=P(),
-        axis_names={pp_axis})(stacked_blocks, h_micro)
+        in_specs=(P(pp_axis), P(pp_axis), P()), out_specs=P(),
+        axis_names={pp_axis})(stacked_blocks, valid, h_micro)
 
 
 def stacked_block_pspecs(mesh: Mesh, pp_axis: str = "pp") -> Params:

@@ -217,3 +217,57 @@ def unstack_stage_params(stacked_blocks: Params) -> Params:
         return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
 
     return jax.tree_util.tree_map(reshape, stacked_blocks)
+
+
+def stack_stage_params_padded(params: Params, specs: Sequence[StageSpec],
+                              ) -> Tuple[Params, jnp.ndarray]:
+    """Stage-major re-layout for ARBITRARY stage sizes.
+
+    Stages are zero-padded to the largest stage's block count:
+    ``[n_layer, ...]`` -> ``[n_stages, per_max, ...]`` plus a
+    ``[n_stages, per_max]`` bool validity mask. Padding rows are all-zero
+    parameters and are masked to identity inside the block scan
+    (``models.gpt2.apply_blocks(valid=...)``), so the pipelined program
+    matches the unpadded model exactly and padded params receive zero
+    gradients (they stay zero under training; weight decay of zero is
+    zero). This lifts the equal-stage restriction of
+    ``stack_stage_params`` — e.g. 12 layers over 8 stages, or any uneven
+    user-supplied BOUNDARIES.
+
+    Cost: every stage *executes* ``per_max`` blocks, so a maximally uneven
+    partition wastes ticks; balanced-but-uneven partitions (base+1 vs
+    base) waste at most one block per stage.
+    """
+    per_max = max(s.n_blocks for s in specs)
+    n_stages = len(specs)
+
+    def pad_stack(x):
+        rows = []
+        for s in specs:
+            piece = x[s.start:s.end]
+            if s.n_blocks < per_max:
+                pad_width = ((0, per_max - s.n_blocks),) + ((0, 0),) * (x.ndim - 1)
+                piece = jnp.pad(piece, pad_width)
+            rows.append(piece)
+        return jnp.stack(rows)
+
+    stacked = jax.tree_util.tree_map(pad_stack, params["blocks"])
+    return stacked, stage_valid_mask(specs)
+
+
+def stage_valid_mask(specs: Sequence[StageSpec]) -> jnp.ndarray:
+    """[n_stages, per_max] bool: True where a stacked block row is a real
+    layer, False where it is zero padding (see stack_stage_params_padded)."""
+    per_max = max(s.n_blocks for s in specs)
+    return jnp.asarray([[i < s.n_blocks for i in range(per_max)]
+                        for s in specs])
+
+
+def unstack_stage_params_padded(stacked_blocks: Params,
+                                specs: Sequence[StageSpec]) -> Params:
+    """Inverse of ``stack_stage_params_padded``: drop padding rows,
+    concatenate the per-stage valid prefixes back to ``[n_layer, ...]``."""
+    def merge(x):
+        return jnp.concatenate([x[s.index, :s.n_blocks] for s in specs])
+
+    return jax.tree_util.tree_map(merge, stacked_blocks)

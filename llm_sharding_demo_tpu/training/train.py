@@ -139,19 +139,22 @@ def MoETrainStep(config, optimizer: optax.GradientTransformation,
 
 def gpipe_lm_loss(params: Params, ids: jnp.ndarray, config: GPT2Config,
                   mesh: Mesh, n_microbatches: int,
-                  remat: bool = False) -> jnp.ndarray:
+                  remat: bool = False,
+                  valid: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """LM loss with the blocks run pipeline-parallel (``parallel.gpipe``).
 
     ``params`` uses the gpipe layout: ``wte``/``wpe``/``ln_f`` as usual
     plus ``stacked_blocks`` (stage-major, sharded over ``pp``). Embed and
     head run under plain GSPMD outside the manual pipeline program.
+    ``valid`` is the padding mask for unequal stage sizes (see
+    ``parallel.partition.stack_stage_params_padded``).
     """
     from ..parallel import gpipe  # local import: avoids a cycle at package init
 
     h = gpt2.embed(params, ids[:, :-1], 0)
     hm = gpipe.microbatch(h, n_microbatches)
     hm = gpipe.gpipe_apply_blocks(params["stacked_blocks"], hm, config, mesh,
-                                  remat=remat)
+                                  remat=remat, valid=valid)
     h = gpipe.unmicrobatch(hm)
     logits = gpt2.final_logits(params, h, config.layer_norm_epsilon)
     losses = optax.softmax_cross_entropy_with_integer_labels(
@@ -165,9 +168,16 @@ class GPipeTrainStep:
     automatic — the full composition on one mesh, one jitted program.
 
     ``init(params)`` converts a standard param pytree into the gpipe layout
-    (stage-major stacked blocks, equal stage sizes required) and shards it;
-    the optimizer state follows each leaf's sharding (eager init, see
-    ``TrainStep.init``).
+    (stage-major stacked blocks) and shards it; the optimizer state follows
+    each leaf's sharding (eager init, see ``TrainStep.init``). Stage sizes
+    need NOT be equal: uneven partitions (n_layer not divisible by pp, or
+    explicit uneven ``boundaries``) use zero-padded stacking with identity
+    masking (``partition.stack_stage_params_padded``), at the cost of every
+    stage executing the largest stage's block count.
+
+    ``boundaries``: optional interior split points (the serving BOUNDARIES
+    contract, ``utils.config``); must produce exactly ``pp`` stages.
+    Default: ``partition.balanced_boundaries``.
     """
 
     config: GPT2Config
@@ -175,19 +185,30 @@ class GPipeTrainStep:
     mesh: Mesh
     n_microbatches: int = 4
     remat: bool = False
+    boundaries: Optional[Any] = None
 
     def __post_init__(self):
+        from ..parallel import partition as P_
+
         if "pp" not in self.mesh.axis_names:
             raise ValueError(f"mesh {self.mesh.axis_names} has no 'pp' axis")
-        if self.config.n_layer % self.mesh.shape["pp"]:
+        pp = self.mesh.shape["pp"]
+        bounds = (list(self.boundaries) if self.boundaries is not None
+                  else P_.balanced_boundaries(self.config.n_layer, pp))
+        self._specs = P_.make_stage_specs(self.config.n_layer, bounds)
+        if len(self._specs) != pp:
             raise ValueError(
-                f"n_layer={self.config.n_layer} not divisible by "
-                f"pp={self.mesh.shape['pp']} (equal stages required)")
+                f"boundaries {bounds} give {len(self._specs)} stages; the "
+                f"mesh's pp axis has {pp} devices")
+        self._equal = len({s.n_blocks for s in self._specs}) == 1
+        # valid mask only materializes for uneven partitions; the equal
+        # case keeps the mask-free (slightly cheaper) program.
+        self._valid = None if self._equal else P_.stage_valid_mask(self._specs)
 
         def step(params, opt_state, ids):
             loss, grads = jax.value_and_grad(gpipe_lm_loss)(
                 params, ids, self.config, self.mesh, self.n_microbatches,
-                self.remat)
+                self.remat, self._valid)
             updates, opt_state = self.optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss
@@ -198,11 +219,10 @@ class GPipeTrainStep:
     def init(self, params: Params):
         from ..parallel import gpipe, partition as P_
 
-        pp = self.mesh.shape["pp"]
-        specs = P_.make_stage_specs(
-            self.config.n_layer,
-            P_.balanced_boundaries(self.config.n_layer, pp))
-        stacked = P_.stack_stage_params(params, specs)
+        if self._equal:
+            stacked = P_.stack_stage_params(params, self._specs)
+        else:
+            stacked, _ = P_.stack_stage_params_padded(params, self._specs)
         gp_params: Params = {
             "wte": jax.device_put(params["wte"], spmd.replicated(self.mesh)),
             "wpe": jax.device_put(params["wpe"], spmd.replicated(self.mesh)),
