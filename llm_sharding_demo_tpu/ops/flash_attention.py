@@ -87,7 +87,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
         p = jnp.exp(s - m_safe)                            # [bq, bk]
         p = jnp.where(mask, p, 0.0)
-        alpha = jnp.exp(jnp.where(m_prev <= NEG_INF / 2, 0.0, m_prev) - m_safe)
+        # exp(m_prev - m_safe) underflows to exactly 0 when m_prev is the
+        # NEG_INF init (nothing folded yet), which is the correct rescale
+        # of the empty accumulator. Shifting m_prev to 0 first (round-1
+        # formulation) overflows to inf when m_safe < -88 — all-visible-
+        # scores-very-negative rows then produced inf * 0 = NaN.
+        alpha = jnp.exp(m_prev - m_safe)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(p, v_ref[0].astype(jnp.float32),
                                  (((1,), (0,)), ((), ())),

@@ -86,3 +86,22 @@ def test_flash_is_differentiable():
 def test_config_rejects_unknown_impl():
     with pytest.raises(ValueError, match="attention_impl"):
         gpt2.GPT2Config(attention_impl="cuda")
+
+
+def test_flash_survives_extreme_negative_scores():
+    """All visible scores << -88 must not NaN (round-2 review finding).
+
+    The online-softmax rescale alpha = exp(m_prev - m_new) must underflow
+    to 0 against the NEG_INF init, not overflow to inf (inf * l_prev=0
+    poisoned whole rows with NaN in the round-1 formulation). Reference
+    behavior: softmax over uniformly tiny scores is uniform."""
+    rng = np.random.default_rng(0)
+    hd = 64
+    q = jnp.asarray(rng.normal(size=(1, 2, 128, hd)).astype(np.float32)) * 30
+    k = -q  # q·k/sqrt(hd) ≈ -hd*900/8 ≈ -7200 for the diagonal pairing
+    v = jnp.asarray(rng.normal(size=(1, 2, 128, hd)).astype(np.float32))
+    ref = causal_attention(q, k, v)
+    got = flash_attention(q, k, v, block_q=32, block_k=64, interpret=True)
+    assert np.isfinite(np.asarray(got)).all()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-3)
