@@ -11,12 +11,147 @@ vocab), but wire behavior, shapes, and tests don't depend on the hub.
 
 from __future__ import annotations
 
-from typing import List, Protocol
+import logging
+import os
+from typing import List, Optional, Protocol
+
+log = logging.getLogger(__name__)
+
+# Subdirectory of a checkpoint dir where tools/convert_hf.py drops the HF
+# tokenizer files (vocab.json/merges.txt/tokenizer.json...).
+TOKENIZER_SUBDIR = "tokenizer"
+
+# stdlib-re approximation of GPT-2's \p{L}/\p{N} split pattern, used when
+# the `regex` module is absent (the transformers-free serving image).
+# Letters via [^\W\d_]; punctuation must re-admit the underscore that \w
+# claims. Module-level so tests can assert against THIS pattern, not a
+# copy.
+RE_FALLBACK_PATTERN = (r"'s|'t|'re|'ve|'m|'ll|'d| ?[^\W\d_]+| ?\d+"
+                       r"| ?(?:[^\w\s]|_)+|\s+(?!\S)|\s+")
 
 
 class Tokenizer(Protocol):
     def encode(self, text: str) -> List[int]: ...
     def decode(self, ids: List[int]) -> str: ...
+
+
+def _bytes_to_unicode() -> dict:
+    """GPT-2's reversible byte -> printable-unicode-char table.
+
+    BPE operates on strings; raw bytes that aren't printable latin-1 are
+    remapped to 256+ codepoints so every byte has a distinct, visible
+    symbol. (Same table as OpenAI's encoder.py / HF GPT2Tokenizer — it
+    must be, or vocab.json symbols wouldn't line up.)
+    """
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("¡"), ord("¬") + 1))
+          + list(range(ord("®"), ord("ÿ") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
+
+
+def _get_pairs(word):
+    return {(a, b) for a, b in zip(word, word[1:])}
+
+
+class BPETokenizer:
+    """Pure-Python GPT-2 byte-level BPE — zero dependencies.
+
+    Serving pods deliberately exclude transformers/torch (Dockerfile,
+    requirements.txt), so checkpoint-shipped tokenizer assets must be
+    loadable without them; this class reads the standard ``vocab.json`` +
+    ``merges.txt`` pair that ``save_pretrained`` writes. Without it, an
+    air-gapped pod with perfectly converted weights would silently fall
+    back to ``ByteTokenizer`` and generate garbage (byte ids are not BPE
+    ids) — the round-1 advisor finding this class closes.
+
+    The token-split regex needs ``\\p{L}``/``\\p{N}``; the stdlib ``re``
+    can't express those, so when the ``regex`` module is absent we use the
+    closest ``re`` translation (letters via ``[^\\W\\d_]``). The two agree
+    on all ASCII and practically all natural text; exotic numerals (e.g.
+    Roman-numeral codepoints) may split differently.
+    """
+
+    def __init__(self, vocab: dict, merges: List[tuple]):
+        self.encoder = dict(vocab)
+        self.decoder = {v: k for k, v in self.encoder.items()}
+        self.ranks = {tuple(m): i for i, m in enumerate(merges)}
+        self.byte_enc = _bytes_to_unicode()
+        self.byte_dec = {v: k for k, v in self.byte_enc.items()}
+        self.cache: dict = {}
+        try:
+            import regex
+            self.pat = regex.compile(
+                r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+"
+                r"| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+")
+        except ImportError:
+            import re
+            self.pat = re.compile(RE_FALLBACK_PATTERN)
+        # unk fallback for pieces missing from vocab.json (mismatched
+        # vocab/merges pair): degrade like HF's encoder.get(tok, unk)
+        # instead of a serve-time KeyError on the first unlucky prompt
+        self.unk_id = self.encoder.get("<|endoftext|>", 0)
+
+    @classmethod
+    def from_dir(cls, directory: str) -> "BPETokenizer":
+        import json
+        with open(os.path.join(directory, "vocab.json"),
+                  encoding="utf-8") as f:
+            vocab = json.load(f)
+        merges = []
+        with open(os.path.join(directory, "merges.txt"),
+                  encoding="utf-8") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line or line.startswith("#version"):
+                    continue
+                a, _, b = line.partition(" ")
+                merges.append((a, b))
+        return cls(vocab, merges)
+
+    def _bpe(self, token: str) -> List[str]:
+        if token in self.cache:
+            return self.cache[token]
+        word = tuple(token)
+        while len(word) > 1:
+            pairs = _get_pairs(word)
+            bigram = min(pairs, key=lambda p: self.ranks.get(p, float("inf")))
+            if bigram not in self.ranks:
+                break
+            first, second = bigram
+            new_word: List[str] = []
+            i = 0
+            while i < len(word):
+                if (word[i] == first and i < len(word) - 1
+                        and word[i + 1] == second):
+                    new_word.append(first + second)
+                    i += 2
+                else:
+                    new_word.append(word[i])
+                    i += 1
+            word = tuple(new_word)
+        out = list(word)
+        self.cache[token] = out
+        return out
+
+    def encode(self, text: str) -> List[int]:
+        ids: List[int] = []
+        for tok in self.pat.findall(text):
+            sym = "".join(self.byte_enc[b] for b in tok.encode("utf-8"))
+            ids.extend(self.encoder.get(piece, self.unk_id)
+                       for piece in self._bpe(sym))
+        return ids
+
+    def decode(self, ids: List[int]) -> str:
+        text = "".join(self.decoder.get(int(i), "") for i in ids)
+        data = bytes(self.byte_dec[c] for c in text if c in self.byte_dec)
+        return data.decode("utf-8", errors="replace")
 
 
 class ByteTokenizer:
@@ -41,13 +176,42 @@ class ByteTokenizer:
         return "".join(out)
 
 
-def get_tokenizer(model_id: str) -> Tokenizer:
-    """HF tokenizer when loadable (cache/hub), else ``ByteTokenizer``."""
+def get_tokenizer(model_id: str,
+                  checkpoint_dir: Optional[str] = None) -> Tokenizer:
+    """Resolve a tokenizer: checkpoint assets -> HF cache/hub -> bytes.
+
+    ``tools/convert_hf.py`` ships the tokenizer files inside the checkpoint
+    directory (``<ckpt>/tokenizer``), so air-gapped pods restoring an Orbax
+    checkpoint get the REAL BPE vocab — falling back to ``ByteTokenizer``
+    with correctly converted weights would silently generate garbage (byte
+    ids don't match GPT-2's vocab), hence the WARNING below.
+    """
+    if checkpoint_dir:
+        tok_dir = os.path.join(checkpoint_dir, TOKENIZER_SUBDIR)
+        if os.path.isdir(tok_dir):
+            # Pure-Python loader first: identical behavior whether or not
+            # transformers is installed (serving images exclude it).
+            if os.path.exists(os.path.join(tok_dir, "vocab.json")):
+                try:
+                    return BPETokenizer.from_dir(tok_dir)
+                except Exception as e:
+                    log.warning("BPE load from %s failed (%s)", tok_dir, e)
+            try:  # non-BPE formats (tokenizer.json-only checkpoints)
+                from transformers import AutoTokenizer
+                return AutoTokenizer.from_pretrained(
+                    tok_dir, local_files_only=True)
+            except Exception as e:
+                log.warning("tokenizer assets at %s failed to load (%s); "
+                            "trying HF id %s", tok_dir, e, model_id)
     try:
         from .loader import hub_reachable
         offline = not hub_reachable()  # before transformers import: sets
         from transformers import AutoTokenizer  # HF_HUB_OFFLINE in time
         return AutoTokenizer.from_pretrained(
             model_id, local_files_only=offline)
-    except Exception:
+    except Exception as e:
+        log.warning(
+            "no tokenizer for %s (checkpoint assets absent, HF load failed: "
+            "%s); using byte-level fallback — generations will NOT match the "
+            "model's BPE vocab", model_id, e)
         return ByteTokenizer()

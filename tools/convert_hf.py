@@ -39,6 +39,23 @@ def main() -> int:
     print(f"converted: {config}", flush=True)
     ckpt.save(args.out_dir, params, config)
     print(f"wrote Orbax checkpoint to {args.out_dir}")
+
+    # Ship the tokenizer assets inside the checkpoint so air-gapped pods
+    # never fall back to the byte-level tokenizer (wrong vocab for GPT-2 —
+    # serving.tokenizer warns, but the real fix is having the files).
+    try:
+        import os
+
+        from transformers import AutoTokenizer
+
+        from llm_sharding_demo_tpu.serving.tokenizer import TOKENIZER_SUBDIR
+        tok = AutoTokenizer.from_pretrained(args.model_id)
+        tok_dir = os.path.join(args.out_dir, TOKENIZER_SUBDIR)
+        tok.save_pretrained(tok_dir)
+        print(f"wrote tokenizer assets to {tok_dir}")
+    except Exception as e:
+        print(f"WARNING: could not save tokenizer for {args.model_id} ({e}); "
+              "serving will fall back to HF cache or bytes", flush=True)
     return 0
 
 
