@@ -139,15 +139,25 @@ def embed(params: Params, input_ids: jnp.ndarray,
     """
     seq_len = input_ids.shape[-1]
     positions = jnp.maximum(position_offset + jnp.arange(seq_len), 0)
-    return params["wte"][input_ids] + params["wpe"][positions]
+    wte = params["wte"]
+    if isinstance(wte, dict):  # weight-only int8 table (ops.quant)
+        from ..ops.quant import embed_rows
+        return embed_rows(wte, input_ids) + params["wpe"][positions]
+    return wte[input_ids] + params["wpe"][positions]
 
 
 def _block(block_params: Params, h: jnp.ndarray, n_head: int, eps: float,
            cache_k: Optional[jnp.ndarray], cache_v: Optional[jnp.ndarray],
            offset, attn_impl: str = "xla",
            k_valid_from: Optional[jnp.ndarray] = None, mesh=None,
+           mlp_fn=None,
            ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
-    """One pre-LN transformer block; optionally reads/writes a KV cache slice."""
+    """One pre-LN transformer block; optionally reads/writes a KV cache slice.
+
+    ``mlp_fn(block_params, m) -> mlp_out`` swaps the dense MLP for another
+    feed-forward (``models.moe`` passes its routed expert MLP here), so the
+    attention half — the part every family shares — exists exactly once.
+    """
     a = layer_norm(h, block_params["ln_1"]["scale"], block_params["ln_1"]["bias"], eps)
     qkv = linear(a, block_params["attn"]["c_attn"]["kernel"],
                  block_params["attn"]["c_attn"]["bias"])
@@ -181,16 +191,20 @@ def _block(block_params: Params, h: jnp.ndarray, n_head: int, eps: float,
                       block_params["attn"]["c_proj"]["bias"])
     h = h + attn_out
     m = layer_norm(h, block_params["ln_2"]["scale"], block_params["ln_2"]["bias"], eps)
-    m = linear(gelu_new(linear(m, block_params["mlp"]["c_fc"]["kernel"],
-                               block_params["mlp"]["c_fc"]["bias"])),
-               block_params["mlp"]["c_proj"]["kernel"],
-               block_params["mlp"]["c_proj"]["bias"])
+    if mlp_fn is None:
+        m = linear(gelu_new(linear(m, block_params["mlp"]["c_fc"]["kernel"],
+                                   block_params["mlp"]["c_fc"]["bias"])),
+                   block_params["mlp"]["c_proj"]["kernel"],
+                   block_params["mlp"]["c_proj"]["bias"])
+    else:
+        m = mlp_fn(block_params, m)
     return h + m, new_ck, new_cv
 
 
 def apply_blocks(blocks: Params, h: jnp.ndarray, config: GPT2Config,
                  cache: Optional[KVCache] = None, remat: bool = False,
                  k_valid_from: Optional[jnp.ndarray] = None, mesh=None,
+                 valid: Optional[jnp.ndarray] = None,
                  ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Run a stack of blocks (leading layer axis) via ``lax.scan``.
 
@@ -202,20 +216,42 @@ def apply_blocks(blocks: Params, h: jnp.ndarray, config: GPT2Config,
     ``remat=True`` checkpoints each block under reverse-mode AD: the
     backward pass recomputes block activations instead of storing all
     ``L`` of them — the standard HBM-for-FLOPs trade for training.
+
+    ``valid`` ([L] bool, no-cache path only) masks padding layers to
+    identity — the mechanism behind unequal pipeline stages, where stage
+    blocks are zero-padded to a common count (``parallel.partition.
+    stack_stage_params_padded``). A masked layer contributes nothing to
+    the output, so its (zero) parameters also receive exactly zero
+    gradient and stay zero under training.
     """
     eps = config.layer_norm_epsilon
     n_head = config.n_head
 
     if cache is None:
-        def body(carry, layer_params):
-            out, _, _ = _block(layer_params, carry, n_head, eps, None, None,
-                               0, config.attention_impl, k_valid_from, mesh)
-            return out, None
+        if valid is None:
+            def body(carry, layer_params):
+                out, _, _ = _block(layer_params, carry, n_head, eps, None,
+                                   None, 0, config.attention_impl,
+                                   k_valid_from, mesh)
+                return out, None
+        else:
+            blocks = (blocks, valid)
+
+            def body(carry, xs):
+                layer_params, valid_l = xs
+                out, _, _ = _block(layer_params, carry, n_head, eps, None,
+                                   None, 0, config.attention_impl,
+                                   k_valid_from, mesh)
+                return jnp.where(valid_l, out, carry), None
 
         if remat:
             body = jax.checkpoint(body)
         h, _ = jax.lax.scan(body, h, blocks)
         return h, None
+    if valid is not None:
+        raise NotImplementedError("valid masking is a no-cache (pipeline "
+                                  "training) feature; cached decode stages "
+                                  "are never padded")
 
     offset = cache.length
 
@@ -241,6 +277,9 @@ def final_logits(params: Params, h: jnp.ndarray, eps: float) -> jnp.ndarray:
     tie behavior).
     """
     h = layer_norm(h, params["ln_f"]["scale"], params["ln_f"]["bias"], eps)
+    if isinstance(params["wte"], dict):  # int8 table: fold scale into h
+        from ..ops.quant import head_logits
+        return head_logits(h, params["wte"])
     return jnp.einsum("bsd,vd->bsv", h, params["wte"],
                       preferred_element_type=jnp.float32)
 

@@ -23,14 +23,15 @@ SURVEY.md §2.2 "EP: Not applicable"). TPU-first design:
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
-from ..ops.layers import gelu_new, layer_norm, linear
-from ..ops.attention import causal_attention, merge_heads, split_heads
-from .gpt2 import GPT2Config, Params, embed, final_logits
+from ..ops.layers import gelu_new
+from ..ops.attention import KVCache
+from .gpt2 import (GPT2Config, Params, _block as gpt2_block, embed,
+                   final_logits)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -98,8 +99,16 @@ def init_params(config: MoEConfig, key: jax.Array, dtype=jnp.float32) -> Params:
 
 
 def moe_mlp(moe_params: Params, h: jnp.ndarray, config: MoEConfig,
+            token_valid: Optional[jnp.ndarray] = None,
             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Top-k routed expert MLP. [B, S, d] -> ([B, S, d], aux_loss scalar)."""
+    """Top-k routed expert MLP. [B, S, d] -> ([B, S, d], aux_loss scalar).
+
+    ``token_valid`` ([B, S] bool, optional): tokens marked False (left-pad
+    columns of a ragged batch) are excluded from routing entirely — zero
+    combine weight AND zero dispatch, so they cannot consume per-expert
+    capacity slots that real tokens need. Their output rows are zero (the
+    residual carries them; nothing downstream reads pad positions).
+    """
     b, s, d = h.shape
     e, k = config.n_experts, config.expert_top_k
     cap = expert_capacity(config, s)
@@ -114,6 +123,8 @@ def moe_mlp(moe_params: Params, h: jnp.ndarray, config: MoEConfig,
     for _ in range(k):
         idx = jnp.argmax(sel_gates, axis=-1)                    # [B,S]
         oh = jax.nn.one_hot(idx, e, dtype=gates.dtype)          # [B,S,E]
+        if token_valid is not None:
+            oh = oh * token_valid[..., None]
         onehots.append(oh)
         weights.append(jnp.sum(sel_gates * oh, axis=-1))        # [B,S]
         sel_gates = sel_gates * (1.0 - oh)
@@ -155,29 +166,103 @@ def moe_mlp(moe_params: Params, h: jnp.ndarray, config: MoEConfig,
     return out, aux
 
 
+def _moe_block(layer_params: Params, h: jnp.ndarray, config: MoEConfig,
+               cache_k: Optional[jnp.ndarray], cache_v: Optional[jnp.ndarray],
+               offset, k_valid_from: Optional[jnp.ndarray] = None,
+               ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                          Optional[jnp.ndarray], Optional[jnp.ndarray]]:
+    """One pre-LN MoE block, optionally reading/writing a KV cache slice.
+
+    Delegates the attention half to ``gpt2._block`` (one implementation
+    serves both families) with the dense MLP swapped for ``moe_mlp`` via
+    ``mlp_fn``. Returns ``(h, aux_loss, new_ck, new_cv)``.
+
+    With left-padded ragged batches (``k_valid_from``), the pad columns'
+    garbage embeddings are excluded from routing (``token_valid``): a pad
+    token sitting at sequence start would otherwise win capacity slots in
+    the masked-cumsum race and evict real tokens to the residual path.
+    """
+    if k_valid_from is None:
+        token_valid = None
+    else:
+        s = h.shape[1]
+        token_valid = ((offset + jnp.arange(s))[None, :]
+                       >= k_valid_from[:, None])            # [B, S]
+    aux_cell = []
+
+    def mlp_fn(block_params: Params, m: jnp.ndarray) -> jnp.ndarray:
+        out, aux = moe_mlp(block_params["moe"], m, config, token_valid)
+        aux_cell.append(aux)
+        return out
+
+    h, new_ck, new_cv = gpt2_block(
+        layer_params, h, config.n_head, config.layer_norm_epsilon,
+        cache_k, cache_v, offset, k_valid_from=k_valid_from, mlp_fn=mlp_fn)
+    return h, aux_cell[0], new_ck, new_cv
+
+
 def forward(params: Params, input_ids: jnp.ndarray, config: MoEConfig,
             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """[B, S] -> ([B, S, vocab] logits, summed router aux loss)."""
     h = embed(params, input_ids, 0)
-    eps = config.layer_norm_epsilon
 
     def body(carry, layer_params):
         h, aux = carry
-        a = layer_norm(h, layer_params["ln_1"]["scale"],
-                       layer_params["ln_1"]["bias"], eps)
-        qkv = linear(a, layer_params["attn"]["c_attn"]["kernel"],
-                     layer_params["attn"]["c_attn"]["bias"])
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q, k, v = (split_heads(x, config.n_head) for x in (q, k, v))
-        attn = linear(merge_heads(causal_attention(q, k, v)),
-                      layer_params["attn"]["c_proj"]["kernel"],
-                      layer_params["attn"]["c_proj"]["bias"])
-        h = h + attn
-        m = layer_norm(h, layer_params["ln_2"]["scale"],
-                       layer_params["ln_2"]["bias"], eps)
-        mlp_out, layer_aux = moe_mlp(layer_params["moe"], m, config)
-        return (h + mlp_out, aux + layer_aux), None
+        h, layer_aux, _, _ = _moe_block(layer_params, h, config, None, None, 0)
+        return (h, aux + layer_aux), None
 
     (h, aux), _ = jax.lax.scan(body, (h, jnp.zeros((), jnp.float32)),
                                params["blocks"])
-    return final_logits(params, h, eps), aux
+    return final_logits(params, h, config.layer_norm_epsilon), aux
+
+
+def forward_with_cache(params: Params, input_ids: jnp.ndarray,
+                       config: MoEConfig, cache: KVCache,
+                       pad: Optional[jnp.ndarray] = None,
+                       ) -> Tuple[jnp.ndarray, KVCache]:
+    """Cached MoE forward (prefill / incremental decode), engine-compatible.
+
+    Same contract as ``gpt2.forward_with_cache`` so ``runtime.engine.
+    DecodeEngine`` can drive an MoE model unchanged; the router aux loss is
+    a training quantity and is dropped here (XLA dead-code-eliminates it).
+
+    Routing semantics under the capacity formulation: a *full-sequence*
+    forward makes tokens compete for per-expert slots (the cumsum in
+    ``moe_mlp``), so its outputs are sequence-dependent when capacity
+    binds. A single-token decode step routes one token against a fresh
+    capacity of ``max(int(cf·k/E), 1) >= 1`` slot per expert, so decode
+    NEVER drops. Cached decode therefore agrees exactly with the uncached
+    full re-forward iff prefill capacity doesn't bind (e.g.
+    ``capacity_factor >= n_experts / expert_top_k``); with binding capacity
+    decode is the *better-quality* path (no drops), not a divergence bug.
+    """
+    if pad is None:
+        h = embed(params, input_ids, cache.length)
+        k_valid_from = None
+    else:
+        h = embed(params, input_ids, cache.length - pad[:, None])
+        k_valid_from = pad
+    offset = cache.length
+
+    def body(carry, xs):
+        layer_params, ck, cv = xs
+        out, _, new_ck, new_cv = _moe_block(layer_params, carry, config,
+                                            ck, cv, offset, k_valid_from)
+        return out, (new_ck, new_cv)
+
+    h, (new_k, new_v) = jax.lax.scan(body, h,
+                                     (params["blocks"], cache.k, cache.v))
+    new_len = cache.length + jnp.asarray(h.shape[1], dtype=jnp.int32)
+    cache = KVCache(k=new_k, v=new_v, length=new_len)
+    return final_logits(params, h, config.layer_norm_epsilon), cache
+
+
+def make_cache(config: MoEConfig, batch: int, max_seq: int,
+               dtype=jnp.float32) -> KVCache:
+    """KV cache for the MoE model (attention is dense GPT-2 attention)."""
+    if max_seq > config.n_positions:
+        raise ValueError(
+            f"max_seq={max_seq} exceeds n_positions={config.n_positions}; "
+            "decode past the position table would silently clamp")
+    return KVCache.create(config.n_layer, batch, config.n_head, max_seq,
+                         config.head_dim, dtype)

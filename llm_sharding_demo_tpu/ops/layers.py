@@ -43,7 +43,7 @@ def gelu_new(x: jnp.ndarray) -> jnp.ndarray:
     return 0.5 * x * (1.0 + jnp.tanh(c * (x + 0.044715 * jnp.power(x, 3))))
 
 
-def linear(x: jnp.ndarray, kernel: jnp.ndarray, bias: jnp.ndarray | None = None
+def linear(x: jnp.ndarray, kernel, bias: jnp.ndarray | None = None
            ) -> jnp.ndarray:
     """Affine map with an ``[in, out]`` kernel.
 
@@ -51,8 +51,16 @@ def linear(x: jnp.ndarray, kernel: jnp.ndarray, bias: jnp.ndarray | None = None
     (weight is ``[in_features, out_features]``, the transpose of
     ``nn.Linear``) so checkpoint conversion is a direct copy — this is the
     Conv1D layout trap called out in SURVEY.md §5 "Checkpoint / resume".
+
+    ``kernel`` may be a weight-only-int8 quantized leaf (``{"q",
+    "scale"}``, see ``ops.quant``) — the int8 decode path flows through
+    here without the model code knowing.
     """
-    y = x @ kernel
+    if isinstance(kernel, dict):
+        from .quant import quant_matmul  # lazy: quant imports nothing heavy
+        y = quant_matmul(x, kernel)
+    else:
+        y = x @ kernel
     if bias is not None:
         y = y + bias
     return y

@@ -1,0 +1,116 @@
+"""Weight-only int8 quantization for the decode path.
+
+Single-stream decode is weight-bandwidth-bound: every generated token
+streams every parameter through HBM once (the reference never gets this
+far — it re-forwards the whole sequence on CPU, server.py:169-181). bf16
+already halves fp32 traffic; per-channel int8 halves it again, putting
+~2x steady-state decode on the table with <0.4% per-channel error.
+
+Scheme: symmetric per-OUTPUT-channel scales. For a ``[in, out]`` kernel,
+``scale[o] = max|W[:, o]| / 127`` and ``q = round(W / scale)`` in int8.
+The matmul computes ``(x @ q) * scale`` with the int8->activation-dtype
+convert fused into the dot by XLA (the int8 buffer is what lives in HBM;
+Mosaic/XLA dequantize tiles in VMEM). Per-channel (not per-tensor)
+scaling keeps outlier channels from widening everyone's quantization
+step; symmetric (no zero point) keeps the dot a plain multiply.
+
+A quantized kernel is a dict leaf ``{"q": int8 [..., in, out],
+"scale": f32 [..., out]}`` in the param pytree, so stacked block tensors
+([L, in, out]) quantize layer-by-layer along their own channel axes and
+``lax.scan`` carries the pair transparently. ``ops.layers.linear`` and
+the embedding/LM-head paths dispatch on the leaf type, so the model code
+is unchanged — ``runtime.engine.DecodeEngine(dtype="int8")`` is the only
+user-facing switch (activations/KV cache run bf16; LN stats, softmax and
+logits stay f32 as in the bf16 path).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+def quantize_array(w: jnp.ndarray, compute_dtype=jnp.bfloat16) -> dict:
+    """[..., in, out] float kernel -> {"q": int8, "scale": compute-dtype}.
+
+    The scale folds the dequant multiply; it is stored in the activation
+    compute dtype so the post-dot rescale doesn't upcast the activation.
+    Scale is per output channel, broadcast over every leading axis (layer
+    stack, expert stack).
+    """
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return {"q": q.astype(jnp.int8),
+            "scale": scale.squeeze(-2).astype(compute_dtype)}
+
+
+def is_quantized(leaf) -> bool:
+    return isinstance(leaf, dict) and set(leaf) == {"q", "scale"}
+
+
+def dequantize_array(qleaf: dict, dtype=jnp.float32) -> jnp.ndarray:
+    """Materialize the float kernel (tests / debugging only — the compute
+    paths never call this on full weights, that would defeat the point)."""
+    return (qleaf["q"].astype(dtype)
+            * qleaf["scale"][..., None, :].astype(dtype))
+
+
+def quant_matmul(x: jnp.ndarray, qleaf: dict) -> jnp.ndarray:
+    """x [..., in] @ quantized [in, out] -> [..., out] in x.dtype.
+
+    The int8->x.dtype convert sits directly on the dot operand so XLA
+    fuses it into the matmul read; only int8 bytes cross HBM.
+    """
+    y = jax.lax.dot_general(x, qleaf["q"].astype(x.dtype),
+                            (((x.ndim - 1,), (0,)), ((), ())))
+    return y * qleaf["scale"].astype(x.dtype)
+
+
+def quantize_params(params: Params, compute_dtype=jnp.bfloat16) -> Params:
+    """Quantize every matmul kernel + the embedding/LM-head table.
+
+    Kernels (``.../kernel``) and ``wte`` become quantized leaves; ``wpe``,
+    LN scales/biases, and biases stay in ``compute_dtype`` (tiny, and LN
+    math needs them exact-ish). Works on both model families' trees (the
+    MoE expert kernels are [L, E, in, out]: channel axis still last).
+    """
+    def walk(tree, path=()):
+        if isinstance(tree, dict) and not is_quantized(tree):
+            return {k: walk(v, path + (k,)) for k, v in tree.items()}
+        name = path[-1] if path else ""
+        if name == "kernel" or name == "wte":
+            return quantize_array(tree, compute_dtype)
+        if jnp.issubdtype(tree.dtype, jnp.floating):
+            return tree.astype(compute_dtype)
+        return tree
+
+    return walk(params)
+
+
+def embed_rows(qleaf: dict, ids: jnp.ndarray) -> jnp.ndarray:
+    """Gather embedding rows from a quantized [vocab, d] table.
+
+    Per-output-channel scales for ``wte`` are per *embedding dim* (the
+    last axis), so a gathered row dequantizes with the shared [d] scale.
+    """
+    rows = qleaf["q"][ids]                       # int8 [..., d]
+    return rows.astype(qleaf["scale"].dtype) * qleaf["scale"]
+
+
+def head_logits(h: jnp.ndarray, qleaf: dict) -> jnp.ndarray:
+    """Tied LM head against the quantized wte: [B,S,d] -> [B,S,vocab] f32.
+
+    ``wte`` scales are per embedding dim (axis d), which is the
+    CONTRACTED axis here — so the rescale must happen before the dot:
+    fold the [d] scale into the (small) activation instead of the (huge)
+    vocab table, keeping the dot's HBM side int8.
+    """
+    hs = h.astype(jnp.float32) * qleaf["scale"].astype(jnp.float32)
+    return jax.lax.dot_general(hs.astype(h.dtype), qleaf["q"].astype(h.dtype),
+                               (((2,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)

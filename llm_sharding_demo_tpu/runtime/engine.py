@@ -216,16 +216,51 @@ class DecodeEngine:
         every weight once); LN statistics, softmax, and the final logits
         stay float32 (ops.layers.layer_norm, ops.attention, final_logits),
         so bf16 degrades only the matmul operand precision. float32 remains
-        the greedy-parity mode BASELINE.json specifies."""
+        the greedy-parity mode BASELINE.json specifies.
+
+        ``dtype="int8"`` selects weight-only int8: matmul kernels and the
+        embedding/head table stored int8 with per-channel scales
+        (ops.quant), activations and KV cache in bfloat16 — halves weight
+        HBM traffic again over bf16. Tokens may diverge from the bf16
+        stream within quantization error; fp32/bf16 remain the parity
+        modes."""
         if max_seq > config.n_positions:
             raise ValueError(
                 f"max_seq={max_seq} exceeds n_positions={config.n_positions}")
-        self.params = jax.tree.map(
-            lambda x: x.astype(dtype)
-            if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+        quantize = dtype == "int8" or dtype == jnp.int8
+        if quantize:
+            dtype = jnp.bfloat16  # activation/KV-cache dtype under int8
+            from ..models.moe import MoEConfig
+            if isinstance(config, MoEConfig):
+                raise NotImplementedError(
+                    "int8 weight-only quantization covers the dense GPT-2 "
+                    "family (the MoE expert einsums address kernels "
+                    "directly); decode MoE in bfloat16")
+            from ..ops.quant import quantize_params
+            # quantize straight from the checkpoint dtype: a bf16 pre-cast
+            # would truncate mantissas BEFORE rounding to int8 codes
+            # (double rounding), wasting quantization accuracy for nothing
+            self.params = quantize_params(params, dtype)
+        else:
+            self.params = jax.tree.map(
+                lambda x: x.astype(dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
         self.config = config
         self.max_seq = max_seq
         self.dtype = dtype
+        # Model dispatch: any module exposing the (forward_with_cache,
+        # make_cache) pair can be decoded. MoE is the second family; its
+        # blocks aren't partitionable by the dense stage extractor, so
+        # staged mode stays GPT-2-only.
+        from ..models import moe
+        if isinstance(config, moe.MoEConfig):
+            if boundaries is not None:
+                raise NotImplementedError(
+                    "pipeline stage partitioning (boundaries) covers the "
+                    "dense GPT-2 param tree only; MoE decodes unstaged")
+            self._model = moe
+        else:
+            self._model = gpt2
         if boundaries is None:
             self.specs = None
             self.stage_params = None
@@ -252,7 +287,8 @@ class DecodeEngine:
 
     def _fresh_cache(self, batch: int):
         if self.specs is None:
-            return gpt2.make_cache(self.config, batch, self.max_seq, self.dtype)
+            return self._model.make_cache(self.config, batch, self.max_seq,
+                                          self.dtype)
         from ..parallel import partition as P
         return [P.make_stage_cache(s, self.config, batch, self.max_seq,
                                    self.dtype) for s in self.specs]
@@ -260,7 +296,8 @@ class DecodeEngine:
     def _forward_cached(self, params, x, cache, pad):
         """One cached forward — plain (fused model) or staged composition."""
         if self.specs is None:
-            return gpt2.forward_with_cache(params, x, self.config, cache, pad)
+            return self._model.forward_with_cache(params, x, self.config,
+                                                  cache, pad)
         from ..parallel import partition as P
         new_caches = []
         for sp, spec, c in zip(params, self.specs, cache):

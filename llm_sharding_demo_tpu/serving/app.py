@@ -77,7 +77,8 @@ def create_app(cfg: Optional[ServingConfig] = None,
     tokenizer."""
     cfg = cfg or from_env()
     config, params = model if model is not None else loader.resolve_model(cfg)
-    tokenizer = tokenizer or get_tokenizer(cfg.model_id)
+    tokenizer = tokenizer or get_tokenizer(cfg.model_id,
+                                           checkpoint_dir=cfg.checkpoint_dir)
 
     n_layer = config.n_layer
     for b in cfg.boundaries:
@@ -93,16 +94,34 @@ def create_app(cfg: Optional[ServingConfig] = None,
     #   /forward_b — the reference's ShardA/ShardB contract
     #   (server.py:51-105) regardless of how many stages /generate uses;
     # - coordinator + remote dispatch: nothing (shards hold the weights).
+    from ..models.moe import MoEConfig
+    is_moe = isinstance(config, MoEConfig)
+    if is_moe and cfg.dispatch == "remote":
+        # the remote topology relays hidden states between stage shards
+        # (/forward -> /forward_b), which MoE pods decline — /generate
+        # would die on a KeyError mid-relay; fail at startup instead
+        raise ValueError(
+            "DISPATCH=remote requires the dense stage-shard topology; "
+            "MoE models serve with DISPATCH=local")
     runner = None
     if cfg.shard_role == "coordinator" and cfg.dispatch == "local":
-        runner = PipelineRunner(params, config, list(cfg.boundaries),
-                                max_seq=cfg.max_seq)
-    compat_specs = P_.make_stage_specs(n_layer, [cfg.split_at])
-    compat_params = {
-        role: (P_.extract_stage_params(params, compat_specs[i])
-               if cfg.shard_role == role else None)
-        for i, role in enumerate(("a", "b"))
-    }
+        if is_moe:
+            # MoE blocks aren't partitionable by the dense stage extractor;
+            # the whole model decodes as one program on the pod's devices.
+            from ..runtime.engine import DecodeEngine
+            runner = DecodeEngine(params, config, max_seq=cfg.max_seq)
+        else:
+            runner = PipelineRunner(params, config, list(cfg.boundaries),
+                                    max_seq=cfg.max_seq)
+    if is_moe:
+        compat_specs = compat_params = None
+    else:
+        compat_specs = P_.make_stage_specs(n_layer, [cfg.split_at])
+        compat_params = {
+            role: (P_.extract_stage_params(params, compat_specs[i])
+                   if cfg.shard_role == role else None)
+            for i, role in enumerate(("a", "b"))
+        }
 
     app = JSONApp(title="llm-sharding-demo-tpu", version="0.1.0")
 
@@ -128,6 +147,9 @@ def create_app(cfg: Optional[ServingConfig] = None,
     def forward_a(req: InputIDs):
         if cfg.shard_role != "a":
             return {"error": "This instance is not shard A."}
+        if is_moe:
+            return {"error": "stage endpoints serve dense GPT-2 only; "
+                             "MoE models generate via /generate"}
         ids = jnp.asarray([req.input_ids], dtype=jnp.int32)
         hidden, _ = P_.stage_apply(compat_params["a"], compat_specs[0],
                                    config, ids)
@@ -137,6 +159,9 @@ def create_app(cfg: Optional[ServingConfig] = None,
     def forward_b(req: HiddenStates):
         if cfg.shard_role != "b":
             return {"error": "This instance is not shard B."}
+        if is_moe:
+            return {"error": "stage endpoints serve dense GPT-2 only; "
+                             "MoE models generate via /generate"}
         hidden = jnp.asarray(np.asarray(req.hidden_states, dtype=np.float32))
         logits, _ = P_.stage_apply(compat_params["b"], compat_specs[1],
                                    config, hidden)

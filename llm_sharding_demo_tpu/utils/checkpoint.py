@@ -34,19 +34,38 @@ CONFIG_FILE = "config.json"
 PARAMS_DIR = "params"
 
 
+def _config_family(config: GPT2Config) -> str:
+    """Model-family tag written next to the config fields.
+
+    ``dataclasses.asdict`` flattens both families to plain dicts; without a
+    tag an MoE checkpoint would restore as a GPT2Config crash (unknown
+    fields) or — worse, if fields ever overlapped — as the wrong model.
+    """
+    from ..models.moe import MoEConfig
+    return "moe" if isinstance(config, MoEConfig) else "gpt2"
+
+
 def save(directory: str, params: Params, config: GPT2Config) -> None:
     """Write config + params. Overwrites an existing checkpoint."""
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
+    payload = {"family": _config_family(config), **dataclasses.asdict(config)}
     with open(os.path.join(directory, CONFIG_FILE), "w") as f:
-        json.dump(dataclasses.asdict(config), f, indent=2)
+        json.dump(payload, f, indent=2)
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(os.path.join(directory, PARAMS_DIR), params, force=True)
 
 
 def load_config(directory: str) -> GPT2Config:
     with open(os.path.join(os.path.abspath(directory), CONFIG_FILE)) as f:
-        return GPT2Config(**json.load(f))
+        fields = json.load(f)
+    family = fields.pop("family", "gpt2")  # pre-tag checkpoints are dense
+    if family == "moe":
+        from ..models.moe import MoEConfig
+        return MoEConfig(**fields)
+    if family != "gpt2":
+        raise ValueError(f"unknown checkpoint model family {family!r}")
+    return GPT2Config(**fields)
 
 
 def load(directory: str) -> Tuple[GPT2Config, Params]:

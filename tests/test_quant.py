@@ -1,0 +1,138 @@
+"""Weight-only int8 decode path (ops.quant).
+
+Correctness bars: per-channel quantization error is bounded by scale/2;
+the quantized matmul equals the dequantized-reference matmul; the int8
+engine decodes end-to-end with logits close to bf16 and exact agreement
+with a manually-dequantized model (the quantization error itself is the
+only divergence, not the plumbing).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_sharding_demo_tpu.models import gpt2, moe
+from llm_sharding_demo_tpu.ops import quant
+from llm_sharding_demo_tpu.runtime.engine import DecodeEngine
+
+
+def test_quantize_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(scale=0.3, size=(64, 32)).astype(np.float32))
+    qleaf = quant.quantize_array(w, jnp.float32)
+    back = quant.dequantize_array(qleaf, jnp.float32)
+    # symmetric round-to-nearest: |err| <= scale/2 per element
+    err = np.abs(np.asarray(back) - np.asarray(w))
+    bound = np.asarray(qleaf["scale"])[None, :] / 2 + 1e-7
+    assert (err <= bound).all()
+
+
+def test_quant_matmul_matches_dequantized():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(3, 64)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(64, 32)).astype(np.float32))
+    qleaf = quant.quantize_array(w, jnp.float32)
+    got = quant.quant_matmul(x, qleaf)
+    want = x @ quant.dequantize_array(qleaf, jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_stacked_kernel_quantizes_per_layer_channel():
+    """[L, in, out] stacked kernels keep per-(layer, out-channel) scales."""
+    rng = np.random.default_rng(2)
+    w = np.ones((2, 8, 4), dtype=np.float32)
+    w[1] *= 100.0  # layer 1 has 100x the magnitude; scales must differ
+    qleaf = quant.quantize_array(jnp.asarray(w), jnp.float32)
+    assert qleaf["q"].shape == (2, 8, 4)
+    assert qleaf["scale"].shape == (2, 4)
+    np.testing.assert_allclose(np.asarray(qleaf["scale"][1]),
+                               100 * np.asarray(qleaf["scale"][0]),
+                               rtol=1e-6)
+
+
+@pytest.fixture(scope="module")
+def dense_model():
+    """Natural-scale init (std 0.02, unit LN). Amplified weights would
+    saturate the attention softmaxes and turn infinitesimal weight
+    perturbations into O(1) logit changes (measured: a x12 blow-up gives
+    30% logit error from 0.4% weight error) — that chaos regime tests
+    the model's conditioning, not the quantizer."""
+    config = gpt2.GPT2Config(vocab_size=211, n_positions=64, n_embd=32,
+                             n_layer=3, n_head=4)
+    return config, gpt2.init_params(config, jax.random.PRNGKey(3))
+
+
+def _dequant_tree(tree):
+    if quant.is_quantized(tree):
+        return quant.dequantize_array(tree, jnp.float32)
+    if isinstance(tree, dict):
+        return {k: _dequant_tree(v) for k, v in tree.items()}
+    return tree
+
+
+def test_int8_forward_matches_manual_dequant(dense_model):
+    """The int8 plumbing introduces NO error beyond quantization itself:
+    forward(quantized params) == forward(dequantized-float params)."""
+    config, params = dense_model
+    qparams = quant.quantize_params(params, jnp.float32)
+    deq = _dequant_tree(qparams)
+    ids = jnp.asarray(np.random.default_rng(4).integers(0, 211, size=(2, 9)))
+    got = gpt2.forward(qparams, ids, config)
+    want = gpt2.forward(deq, ids, config)
+    # not bit-equal: the quant path computes (x@q)*s (and folds the wte
+    # scale into h for the head), the dequant reference x@(q*s) — same
+    # math, different fp association. Observed ~2e-5 relative.
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=5e-3, rtol=1e-3)
+
+
+def test_int8_logit_error_bounded(dense_model):
+    """End-to-end quality bound: int8 logits within ~1% of fp32's scale.
+
+    (Token-stream agreement is NOT asserted anywhere: one flipped argmax
+    changes all subsequent context, so stream distance measures chaos,
+    not quantization quality. The per-position logit error is the honest
+    metric.)"""
+    config, params = dense_model
+    qparams = quant.quantize_params(params, jnp.float32)
+    ids = jnp.asarray(np.random.default_rng(5).integers(0, 211, size=(2, 9)))
+    ref = np.asarray(gpt2.forward(params, ids, config))
+    got = np.asarray(gpt2.forward(qparams, ids, config))
+    rel = np.abs(got - ref).max() / np.abs(ref).max()
+    # ~1.1% measured at n_embd=32; error scales down with real widths
+    # (relative accumulation ~1/sqrt(d)), so 3% is a loose toy-size bound
+    assert rel < 0.03, rel
+
+
+def test_int8_engine_decodes_deterministically(dense_model):
+    config, params = dense_model
+    prompt = np.random.default_rng(5).integers(0, 211, size=(2, 5))
+    ref = DecodeEngine(params, config, max_seq=32).generate(prompt, 8)
+    q = DecodeEngine(params, config, max_seq=32, dtype="int8")
+    a, b = q.generate(prompt, 8), q.generate(prompt, 8)
+    assert a.tokens.shape == ref.tokens.shape
+    np.testing.assert_array_equal(a.tokens, b.tokens)
+    assert ((a.tokens >= 0) & (a.tokens < config.vocab_size)).all()
+    # prompt section passes through untouched
+    np.testing.assert_array_equal(a.tokens[:, :5], prompt)
+
+
+def test_int8_staged_pipeline_matches_unstaged(dense_model):
+    """Stage slicing must slice both q and scale of quantized leaves."""
+    config, params = dense_model
+    prompt = np.random.default_rng(6).integers(0, 211, size=(1, 5))
+    a = DecodeEngine(params, config, max_seq=32, dtype="int8")
+    b = DecodeEngine(params, config, max_seq=32, dtype="int8",
+                     boundaries=[1])
+    np.testing.assert_array_equal(a.generate(prompt, 6).tokens,
+                                  b.generate(prompt, 6).tokens)
+
+
+def test_int8_rejects_moe(dense_model):
+    cfg = moe.MoEConfig(vocab_size=101, n_positions=32, n_embd=16,
+                        n_layer=2, n_head=2, n_experts=4, expert_top_k=2)
+    params = moe.init_params(cfg, jax.random.PRNGKey(7))
+    with pytest.raises(NotImplementedError, match="int8"):
+        DecodeEngine(params, cfg, max_seq=16, dtype="int8")
