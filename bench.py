@@ -71,56 +71,110 @@ def measure_reference_cpu(config, prompt_len: int, new_tokens: int) -> float:
     return new_tokens / dt
 
 
-def measure_dispatch_rtt() -> float:
-    """Fixed per-call overhead, ms: one small host->device transfer.
+def _fetch(out) -> None:
+    """Force a REAL device sync by pulling one scalar to the host.
 
-    On the tunneled bench chip, program dispatch is sub-0.1 ms but each
-    host<->device copy costs ~10-15 ms; a generate() call makes several
-    (prompt up, tokens down, keys), which is the fixed cost the two-point
+    On the tunneled bench chip ``block_until_ready`` returns before the
+    device work finishes (measured: chained 8k matmuls "complete" at
+    48 PFLOP/s), so any timing bounded by it records dispatch, not
+    compute. A host fetch drains the in-order execution queue for real.
+    Every timing window in this file must end with a host fetch (the
+    engine/pipeline ``generate`` paths already do, via ``np.asarray`` of
+    the token output).
+    """
+    import jax
+
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    idx = (0,) * getattr(leaf, "ndim", 0)
+    # slice ON DEVICE before transferring: pulling the full array pays
+    # ~1s/6MB over the tunnel and drowns the marginal signal in noise
+    np.asarray(leaf[idx] if idx else leaf)
+
+
+def measure_dispatch_rtt() -> float:
+    """Fixed per-sync overhead, ms: one host->device->host round trip.
+
+    On the tunneled bench chip each sync barrier costs ~tens of ms
+    (measured ~80 ms); a generate() call pays it a couple of times
+    (prompt up, tokens down). This fixed cost is what the two-point
     marginal timing cancels."""
     import jax.numpy as jnp
 
-    jnp.asarray(np.zeros((1, 256), np.int32)).block_until_ready()  # warmup
+    def roundtrip():
+        x = jnp.asarray(np.zeros((1, 256), np.int32))
+        _fetch(x + 1)  # +1 defeats any host-side short-circuit
+
+    roundtrip()  # warmup
     t0 = time.perf_counter()
-    n = 10
+    n = 5
     for _ in range(n):
-        jnp.asarray(np.zeros((1, 256), np.int32)).block_until_ready()
+        roundtrip()
     return (time.perf_counter() - t0) / n * 1e3
 
 
+def marginal_seconds(time_window, n1: int, n2: int, reps: int = 3):
+    """THE timing harness for the tunneled backend, used by every config.
+
+    ``time_window(n)`` must run one dependency-chained compiled program of
+    size ``n`` closed by a host fetch (see ``_fetch``) and return its wall
+    seconds. Two window sizes, min-of-``reps`` each, marginal cost
+    ``(t2-t1)/(n2-n1)`` — the fixed ~100 ms sync-barrier cost cancels.
+    Returns None when the marginal is non-positive (signal below the
+    barrier jitter) rather than reporting nonsense.
+    """
+    time_window(n1), time_window(n2)               # compile + warm
+    t1 = min(time_window(n1) for _ in range(reps))
+    t2 = min(time_window(n2) for _ in range(reps))
+    m = (t2 - t1) / (n2 - n1)
+    return m if m > 0 else None
+
+
 def _two_point(runner, prompt, s_a: int = STEPS_A, s_b: int = STEPS_B) -> dict:
-    """Steady-state decode cost via marginal timing between two windows."""
-    runner.generate(prompt, s_a)                   # compile window A
-    runner.generate(prompt, s_b)                   # compile window B
-    ra = runner.generate(prompt, s_a)
-    rb = runner.generate(prompt, s_b)
-    marginal = ((rb.decode_seconds - ra.decode_seconds)
-                / (rb.decode_steps - ra.decode_steps))
+    """Steady-state decode cost for a ``generate``-style runner."""
+    last = {}
+
+    def time_window(n):
+        result = runner.generate(prompt, n)
+        last[n] = result
+        return result.decode_seconds
+
+    marginal = marginal_seconds(time_window, s_a, s_b)
+    rb = last[s_b]
+    degraded = marginal is None
+    if degraded:  # below timer resolution: fall back to the e2e rate
+        marginal = rb.decode_seconds / rb.decode_steps
     batch = prompt.shape[0]
-    return {
+    out = {
         "tokens_per_sec": batch / marginal,
         "p50_token_latency_ms": marginal * 1e3,
         "e2e_tokens_per_sec": rb.tokens_per_second,
         "prefill_ms": rb.prefill_seconds * 1e3,
     }
+    if degraded:
+        out["degraded_timing"] = True
+    return out
 
 
 def measure_engine(config, prompt_len: int, batch: int,
-                   dtype_name: str = "float32") -> dict:
-    """Single-device engine: jitted prefill + scanned KV-cache decode."""
+                   dtype_name: str = "float32", s_b: int = STEPS_B) -> dict:
+    """Single-device engine: jitted prefill + scanned KV-cache decode.
+
+    ``dtype_name="int8"`` is the weight-only quantized fast path
+    (ops.quant): int8 kernels/embedding, bf16 activations + KV cache."""
     import jax
     import jax.numpy as jnp
 
     from llm_sharding_demo_tpu.models import gpt2
     from llm_sharding_demo_tpu.runtime.engine import DecodeEngine
 
-    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype_name]
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+             "int8": "int8"}[dtype_name]
     params = gpt2.init_params(config, jax.random.PRNGKey(0))
-    engine = DecodeEngine(params, config, max_seq=prompt_len + STEPS_B,
+    engine = DecodeEngine(params, config, max_seq=prompt_len + s_b,
                           dtype=dtype)
     prompt = np.random.default_rng(0).integers(
         0, config.vocab_size, size=(batch, prompt_len))
-    return _two_point(engine, prompt)
+    return _two_point(engine, prompt, s_b=s_b)
 
 
 def measure_pipeline(config, n_stages: int, prompt_len: int,
@@ -174,6 +228,131 @@ def measure_pipeline(config, n_stages: int, prompt_len: int,
     return out
 
 
+def measure_moe(prompt_len: int, batch: int = 1,
+                dtype_name: str = "bfloat16", config=None) -> dict:
+    """MoE decode: GPT-2-124M geometry with the MLP swapped for 8 experts
+    (top-2, ~7x the MLP weights). Exercises the second model family's
+    cached decode path end-to-end on-chip."""
+    import jax
+    import jax.numpy as jnp
+
+    from llm_sharding_demo_tpu.models import moe
+    from llm_sharding_demo_tpu.runtime.engine import DecodeEngine
+
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype_name]
+    if config is None:
+        config = moe.MoEConfig(vocab_size=50257, n_positions=1024, n_embd=768,
+                               n_layer=12, n_head=12, n_experts=8,
+                               expert_top_k=2)
+    params = moe.init_params(config, jax.random.PRNGKey(0))
+    engine = DecodeEngine(params, config, max_seq=prompt_len + STEPS_B,
+                          dtype=dtype)
+    prompt = np.random.default_rng(0).integers(
+        0, config.vocab_size, size=(batch, prompt_len))
+    return _two_point(engine, prompt)
+
+
+def measure_flash_attention(seq_lens=(1024, 2048, 4096), iters: int = 0,
+                            ) -> list:
+    """Pallas flash kernel vs the XLA einsum attention, fwd and fwd+bwd.
+
+    GPT-2 124M head geometry (H=12, hd=64), bf16 inputs, per-S speedups.
+    Run on whatever backend is visible; on CPU the kernel drops to
+    interpret mode, so only the TPU numbers are meaningful (rows carry the
+    backend name). ``iters=0`` picks a per-S window sized so the marginal
+    signal clears the tunnel's ~100ms sync-barrier jitter; a marginal that
+    still comes out non-positive is reported as null (below resolution),
+    never as a negative "speedup".
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from llm_sharding_demo_tpu.ops.attention import causal_attention
+    from llm_sharding_demo_tpu.ops.flash_attention import flash_attention
+
+    interpret = jax.default_backend() != "tpu"
+    if interpret:
+        # interpret mode runs the kernel grid in Python — thousands of
+        # chained calls would take hours and the numbers are meaningless
+        # anyway (the docstring's caveat); report the skip instead.
+        return [{"seq_len": s, "skipped": "non-TPU backend (interpret "
+                 "mode); kernel timings are TPU-only",
+                 "backend": jax.default_backend()} for s in seq_lens]
+    rows = []
+    for s in seq_lens:
+        q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (1, 12, s, 64),
+                                     dtype=jnp.bfloat16) for i in range(3))
+
+        def flash_fwd(q, k, v):
+            return flash_attention(q, k, v, interpret=interpret)
+
+        def _chain_grads(fwd, q, k, v):
+            # all three grads feed the carry (else XLA DCEs the dk/dv
+            # kernels); normalized so 100+ chained steps stay finite
+            dq, dk, dv = jax.grad(
+                lambda q, k, v: fwd(q, k, v).astype(jnp.float32).sum(),
+                argnums=(0, 1, 2))(q, k, v)
+            acc = (dq + dk + dv).astype(jnp.float32)
+            return (acc / jnp.maximum(jnp.max(jnp.abs(acc)), 1e-3)
+                    ).astype(q.dtype)
+
+        def flash_step(q, k, v):
+            return _chain_grads(flash_fwd, q, k, v)
+
+        def xla_step(q, k, v):
+            return _chain_grads(causal_attention, q, k, v)
+
+        def time_it(op, n_iters):
+            # N dependency-chained invocations inside ONE program (the
+            # output feeds the next call's q), closed by a host fetch:
+            # on the tunneled backend independent dispatches can't be
+            # trusted to serialize, and block_until_ready is not a sync
+            # barrier (see _fetch) — dataflow chaining is.
+            compiled = {}
+
+            def make(n):
+                if n not in compiled:
+                    @jax.jit
+                    def run(q, k, v):
+                        return jax.lax.fori_loop(
+                            0, n, lambda i, acc: op(acc, k, v), q)
+                    compiled[n] = run
+                return compiled[n]
+
+            def time_window(n):
+                fn = make(n)
+                t0 = time.perf_counter()
+                _fetch(fn(q, k, v))
+                return time.perf_counter() - t0
+
+            m = marginal_seconds(time_window, n_iters, 5 * n_iters)
+            return None if m is None else m * 1e3
+
+        # window sized inversely to the O(S^2) op cost so the marginal
+        # signal stays well above barrier jitter at every S
+        n = iters or max(25, int(400 * (1024 / s) ** 2))
+        t_flash, t_xla = time_it(flash_fwd, n), time_it(causal_attention, n)
+        tb_flash, tb_xla = time_it(flash_step, n), time_it(xla_step, n)
+
+        def rnd(x):
+            return None if x is None else round(x, 3)
+
+        def ratio(a, b):
+            return None if (a is None or b is None) else round(a / b, 2)
+
+        rows.append({
+            "seq_len": s,
+            "fwd_flash_ms": rnd(t_flash),
+            "fwd_xla_ms": rnd(t_xla),
+            "fwd_speedup": ratio(t_xla, t_flash),
+            "fwdbwd_flash_ms": rnd(tb_flash),
+            "fwdbwd_xla_ms": rnd(tb_xla),
+            "fwdbwd_speedup": ratio(tb_xla, tb_flash),
+            "backend": jax.default_backend(),
+        })
+    return rows
+
+
 def measure_uncached_jax(config, prompt_len: int, new_tokens: int,
                          dtype_name: str = "bfloat16") -> float:
     """Our model WITHOUT the KV cache: re-forward the full fixed-length
@@ -193,23 +372,40 @@ def measure_uncached_jax(config, prompt_len: int, new_tokens: int,
         if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
     total = prompt_len + new_tokens
 
-    @jax.jit
-    def step(params, ids, t):
+    def step(ids, t):
         logits = gpt2.forward(params, ids, config)          # [1, total, V]
-        nxt = jnp.argmax(logits[0, t - 1]).astype(jnp.int32)
+        nxt = jnp.argmax(jax.lax.dynamic_slice(
+            logits, (0, t - 1, 0), (1, 1, config.vocab_size))).astype(jnp.int32)
         return jax.lax.dynamic_update_slice(ids, nxt[None, None], (0, t))
 
-    ids = np.zeros((1, total), dtype=np.int32)
-    ids[0, :prompt_len] = np.random.default_rng(0).integers(
+    def make(n_tokens: int):
+        # the whole n-token O(n^2) decode as ONE chained program — each
+        # step's ids feed the next, so device time is dataflow-serialized
+        # and the closing host fetch (_fetch) bounds it honestly
+        @jax.jit
+        def run(ids):
+            return jax.lax.fori_loop(
+                prompt_len, prompt_len + n_tokens,
+                lambda t, ids: step(ids, t), ids)
+        return run
+
+    ids0 = np.zeros((1, total), dtype=np.int32)
+    ids0[0, :prompt_len] = np.random.default_rng(0).integers(
         0, config.vocab_size, size=(prompt_len,))
-    ids = jnp.asarray(ids)
-    ids = step(params, ids, prompt_len).block_until_ready()  # warmup/compile
-    t0 = time.perf_counter()
-    for t in range(prompt_len, total):
-        ids = step(params, ids, t)
-    ids.block_until_ready()
-    dt = time.perf_counter() - t0
-    return new_tokens / dt
+    ids0 = jnp.asarray(ids0)
+    compiled = {}
+
+    def time_window(n) -> float:
+        if n not in compiled:
+            compiled[n] = make(n)
+        t0 = time.perf_counter()
+        _fetch(compiled[n](ids0))
+        return time.perf_counter() - t0
+
+    # marginal rate over tokens [n1, n2) — the same decode window the
+    # cached engine's two-point marginal covers, fixed sync cost cancelled
+    m = marginal_seconds(time_window, new_tokens // 4, new_tokens)
+    return float("nan") if m is None else 1.0 / m
 
 
 def main() -> None:
@@ -231,18 +427,18 @@ def main() -> None:
     # steady-state row shows what the chip itself does.
     ref_tiny = measure_reference_cpu(tiny, 4, 20)
     pipe_tiny = measure_pipeline(tiny, 2, 4, two_point=False, new_tokens=20)
-    tiny_ss = measure_pipeline(tiny, 2, 4, two_point=True)
     configs.append({
         "name": "cfg1_tiny_gpt2_2shard_20tok",
         "tokens_per_sec": round(pipe_tiny["tokens_per_sec"], 2),
-        "steady_state_tokens_per_sec": round(tiny_ss["tokens_per_sec"], 2),
         "ref_cpu_tokens_per_sec": round(ref_tiny, 2),
         "vs_baseline": round(pipe_tiny["tokens_per_sec"] / ref_tiny, 2),
-        "steady_state_vs_baseline": round(
-            tiny_ss["tokens_per_sec"] / ref_tiny, 2),
         "transfer_rtt_ms": round(rtt_ms, 1),
         "note": "2-stage single-program pipeline, " + pipe_tiny["placement"]
-                + "; e2e 20-token run pays several fixed tunnel transfers",
+                + "; e2e 20-token run (the mandated notebook workload) "
+                  "pays several fixed ~100ms tunnel syncs. No steady-state "
+                  "row: the 2-dim toy decodes in ~µs/token, far below the "
+                  "tunnel's timer resolution — see cfg2 for real marginal "
+                  "rates",
     })
 
     if args.quick:
@@ -263,20 +459,26 @@ def main() -> None:
     pipe_124 = measure_pipeline(g124, 2, PROMPT_LEN, 1, "bfloat16")
     eng_f32 = measure_engine(g124, PROMPT_LEN, 1, "float32")
     eng_bf16 = measure_engine(g124, PROMPT_LEN, 1, "bfloat16")
+    eng_int8 = measure_engine(g124, PROMPT_LEN, 1, "int8")
     configs.append({
         "name": "cfg2_gpt2_124m_2shard_single_prompt",
         "tokens_per_sec": round(pipe_124["tokens_per_sec"], 2),
         "engine_fp32_tokens_per_sec": round(eng_f32["tokens_per_sec"], 2),
         "engine_bf16_tokens_per_sec": round(eng_bf16["tokens_per_sec"], 2),
+        "engine_int8_tokens_per_sec": round(eng_int8["tokens_per_sec"], 2),
         "p50_token_latency_ms": round(eng_bf16["p50_token_latency_ms"], 3),
         "e2e_tokens_per_sec": round(eng_bf16["e2e_tokens_per_sec"], 2),
         "ref_cpu_tokens_per_sec": round(ref_124, 2),
         "vs_baseline": round(pipe_124["tokens_per_sec"] / ref_124, 2),
         "engine_bf16_vs_baseline": round(
             eng_bf16["tokens_per_sec"] / ref_124, 2),
+        "engine_int8_vs_baseline": round(
+            eng_int8["tokens_per_sec"] / ref_124, 2),
         "note": "steady-state (marginal) decode rates; 2-stage bf16 "
                 "pipeline, " + pipe_124["placement"]
-                + "; engine rows are the unstaged single-chip path",
+                + "; engine rows are the unstaged single-chip path "
+                  "(fp32 = parity mode, bf16 = fast, int8 = weight-only "
+                  "quantized fast path)",
     })
 
     # cfg3: 124M batch=8. Reference baseline: 8 sequential bs=1 streams ==
@@ -307,18 +509,51 @@ def main() -> None:
     })
 
     # cfg5: KV cache vs O(n^2) — both on this framework, same chip, plus
-    # the reference CPU loop for scale.
-    uncached = measure_uncached_jax(g124, PROMPT_LEN, STEPS_B)
+    # the reference CPU loop for scale. Long window (most of the position
+    # table): at short sequences a fast chip hides the O(n^2) compute
+    # behind weight streaming, so the cache advantage only shows at depth.
+    long_steps = g124.n_positions - PROMPT_LEN - 16
+    uncached = measure_uncached_jax(g124, PROMPT_LEN, long_steps)
+    cached_long = measure_engine(g124, PROMPT_LEN, 1, "bfloat16",
+                                 s_b=long_steps)
     configs.append({
         "name": "cfg5_kv_cache_vs_on2",
-        "tokens_per_sec": round(eng_bf16["tokens_per_sec"], 2),
+        "tokens_per_sec": round(cached_long["tokens_per_sec"], 2),
         "uncached_jax_tokens_per_sec": round(uncached, 2),
-        "cache_speedup": round(eng_bf16["tokens_per_sec"] / uncached, 2),
+        "cache_speedup": round(
+            cached_long["tokens_per_sec"] / uncached, 2),
         "ref_cpu_tokens_per_sec": round(ref_124, 2),
-        "vs_baseline": round(eng_bf16["tokens_per_sec"] / ref_124, 2),
+        "vs_baseline": round(cached_long["tokens_per_sec"] / ref_124, 2),
         "note": "uncached = full fixed-length re-forward per token on-chip "
                 "(the reference's algorithm, server.py:169-181), bf16, "
-                f"{STEPS_B} tokens",
+                f"{long_steps} tokens; cached rate over the same window",
+    })
+
+    # cfg6 (beyond the BASELINE matrix): MoE decode — second model family.
+    # No reference denominator exists (the reference is dense-only,
+    # SURVEY.md §2.2 "EP: not applicable"); vs_baseline compares against
+    # the dense 124M reference loop as the nearest anchor.
+    moe_bf16 = measure_moe(PROMPT_LEN, 1, "bfloat16")
+    configs.append({
+        "name": "cfg6_moe_8e_top2_124m_geometry",
+        "tokens_per_sec": round(moe_bf16["tokens_per_sec"], 2),
+        "p50_token_latency_ms": round(moe_bf16["p50_token_latency_ms"], 3),
+        "ref_cpu_tokens_per_sec": round(ref_124, 2),
+        "vs_baseline": round(moe_bf16["tokens_per_sec"] / ref_124, 2),
+        "note": "GPT-2 124M geometry, dense MLP -> 8 experts top-2 "
+                "(~7x MLP weights); steady-state bf16 cached decode; "
+                "reference has no MoE — anchor is the dense 124M CPU loop",
+    })
+
+    # cfg7: flash attention kernel vs XLA at S in {1k, 2k, 4k} — the
+    # long-context hot op (no reference counterpart: its ceiling is 1024
+    # learned positions and O(n^2) re-forwarding).
+    flash_rows = measure_flash_attention()
+    configs.append({
+        "name": "cfg7_flash_attention_vs_xla",
+        "rows": flash_rows,
+        "note": "Pallas K-blocked online-softmax kernel vs XLA einsum "
+                "attention, GPT-2 head geometry, bf16; fwd and fwd+bwd",
     })
 
     print(json.dumps({

@@ -139,6 +139,64 @@ def test_select_token_sample_stays_in_topk():
     np.testing.assert_allclose(got, np.asarray(expected), atol=0.03)
 
 
+def test_sample_pmf_matches_torch_reference_sampler():
+    """Distribution-level equivalence vs the reference's torch sampler.
+
+    Cross-framework RNG streams can't be bit-matched (SURVEY.md §7 hard
+    part (d)), but the *distribution* can be compared exactly: the
+    reference samples from softmax(topk(logits / 0.6, 40)) via
+    torch.multinomial (server.py:187-205). We rebuild that pmf with torch
+    ops verbatim and assert our jitted sampler's implied pmf — softmax
+    over ``lax.top_k`` survivors scattered back through their indices —
+    is the same vocab-sized distribution, across random logit vectors.
+    """
+    rng = np.random.default_rng(0)
+    vocab, k, temp = 257, 40, 0.6
+    for trial in range(5):
+        logits = rng.normal(scale=3.0, size=(vocab,)).astype(np.float32)
+
+        # reference math, torch ops (server.py:187-205)
+        t_scaled = torch.tensor(logits) / temp
+        t_vals, t_idx = torch.topk(t_scaled, k)
+        t_probs = torch.nn.functional.softmax(t_vals, dim=-1)
+        torch_pmf = np.zeros(vocab)
+        torch_pmf[t_idx.numpy()] = t_probs.numpy()
+
+        # our sampler's implied pmf (engine.select_token's categorical
+        # over lax.top_k values, mapped back through the indices)
+        j_vals, j_idx = jax.lax.top_k(jnp.asarray(logits) / temp, k)
+        j_probs = jax.nn.softmax(j_vals)
+        jax_pmf = np.zeros(vocab)
+        jax_pmf[np.asarray(j_idx)] = np.asarray(j_probs)
+
+        assert set(np.asarray(j_idx).tolist()) == set(t_idx.numpy().tolist())
+        np.testing.assert_allclose(jax_pmf, torch_pmf, atol=1e-6,
+                                   err_msg=f"trial {trial}")
+
+
+def test_empirical_sampler_matches_torch_pmf():
+    """End-to-end: frequencies from the ACTUAL jitted select_token match
+    the torch-computed pmf (not a hand-derived one)."""
+    rng = np.random.default_rng(1)
+    vocab, k, temp, n = 64, 8, 0.6, 4000
+    logits = rng.normal(scale=2.0, size=(vocab,)).astype(np.float32)
+
+    t_vals, t_idx = torch.topk(torch.tensor(logits) / temp, k)
+    torch_pmf = np.zeros(vocab)
+    torch_pmf[t_idx.numpy()] = torch.nn.functional.softmax(
+        t_vals, dim=-1).numpy()
+
+    sampling = SamplingConfig(mode="sample", temperature=temp, top_k=k)
+    keys = jax.random.split(jax.random.PRNGKey(2), n)
+    batched = jax.jit(jax.vmap(
+        lambda key: select_token(jnp.asarray(logits)[None, :], sampling,
+                                 key)[0]))
+    draws = np.asarray(batched(keys))
+    freq = np.bincount(draws, minlength=vocab) / n
+    assert set(np.nonzero(freq)[0]) <= set(t_idx.numpy().tolist())
+    np.testing.assert_allclose(freq, torch_pmf, atol=0.03)
+
+
 def test_sampled_generation_deterministic_given_key(hf_engine):
     _, config, engine = hf_engine
     prompt = np.asarray([3, 14, 15])
