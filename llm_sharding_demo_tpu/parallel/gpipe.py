@@ -28,6 +28,7 @@ and lets XLA lay out the vocab matmul freely.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -78,9 +79,38 @@ def gpipe_apply_blocks(stacked_blocks: Params, h_micro: jnp.ndarray,
     """
     if pp_axis not in mesh.axis_names:
         raise ValueError(f"mesh has no {pp_axis!r} axis: {mesh.axis_names}")
-    n_stages = mesh.shape[pp_axis]
     n_micro = h_micro.shape[0]
+    fn = _compiled_pipeline(mesh, config, pp_axis, remat, n_micro,
+                            valid is not None)
+    if valid is None:
+        return fn(stacked_blocks, h_micro)
+    valid = jax.device_put(valid, NamedSharding(mesh, P(pp_axis)))
+    return fn(stacked_blocks, valid, h_micro)
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_pipeline(mesh: Mesh, config: GPT2Config, pp_axis: str,
+                       remat: bool, n_micro: int, has_valid: bool):
+    """Build + jit the pipeline program once per (mesh, config, schedule).
+
+    Cached on hashable keys because jit's own cache is keyed on function
+    identity — rebuilding the shard_map closure per call would make every
+    eager call re-trace AND re-XLA-compile the whole S-stage scan. The
+    jit wrapper itself is required: EAGER shard_map hard-aborts (not
+    raises) on the per-core lax.cond below in current JAX; under jit the
+    same program compiles and runs correctly. Inside an outer jit (the
+    train step) the inner jit is inlined for free.
+    """
+    n_stages = mesh.shape[pp_axis]
     n_ticks = n_micro + n_stages - 1
+    # Bubble ticks can skip the block FLOPs via a per-core lax.cond — but
+    # only when the block computation contains no cross-device collectives:
+    # tp/sp shard the matmuls/sequence and XLA's partitioner inserts
+    # all-reduces inside the block, and collectives inside divergent
+    # control flow abort. pp-only (±dp, which all-reduces grads outside
+    # the blocks) is the common fast case; tp/sp meshes keep the
+    # compute-and-mask schedule.
+    skip_bubbles = all(mesh.shape.get(ax, 1) == 1 for ax in ("tp", "sp"))
 
     def per_stage(blocks_local: Params, valid_local,
                   h_all: jnp.ndarray) -> jnp.ndarray:
@@ -99,8 +129,25 @@ def gpipe_apply_blocks(stacked_blocks: Params, h_micro: jnp.ndarray,
             feed = jax.lax.dynamic_index_in_dim(
                 h_all, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False)
             x = jnp.where(stage == 0, feed, state)
-            y, _ = apply_blocks(blocks_local, x, config, remat=remat,
-                                valid=valid_row)
+            if skip_bubbles:
+                # bubble ticks (stage i is idle before tick i and after
+                # tick i + M - 1) skip the block FLOPs entirely: inside
+                # shard_map this cond is real per-core control flow — each
+                # TPU core has its own program counter, and the collective
+                # (ppermute below) stays OUTSIDE the cond so every core
+                # still joins it. With M microbatches on S stages this
+                # recovers the (S-1)/(M+S-1) bubble fraction round 1
+                # burned on recomputing stale microbatches.
+                active = (t >= stage) & (t < stage + n_micro)
+                y = jax.lax.cond(
+                    active,
+                    lambda x: apply_blocks(blocks_local, x, config,
+                                           remat=remat, valid=valid_row)[0],
+                    lambda x: x,
+                    x)
+            else:
+                y, _ = apply_blocks(blocks_local, x, config, remat=remat,
+                                    valid=valid_row)
             # hop to the next stage over the ICI ring; stage 0 receives
             # zeros (it is fed from h_all, never from a predecessor)
             incoming = jax.lax.ppermute(
@@ -116,16 +163,15 @@ def gpipe_apply_blocks(stacked_blocks: Params, h_micro: jnp.ndarray,
         outputs = jnp.where(stage == n_stages - 1, outputs, 0.0)
         return jax.lax.psum(outputs, pp_axis)
 
-    if valid is None:
-        return jax.shard_map(
+    if not has_valid:
+        return jax.jit(jax.shard_map(
             lambda b, h: per_stage(b, None, h), mesh=mesh,
             in_specs=(P(pp_axis), P()), out_specs=P(),
-            axis_names={pp_axis})(stacked_blocks, h_micro)
-    valid = jax.device_put(valid, NamedSharding(mesh, P(pp_axis)))
-    return jax.shard_map(
+            axis_names={pp_axis}))
+    return jax.jit(jax.shard_map(
         per_stage, mesh=mesh,
         in_specs=(P(pp_axis), P(pp_axis), P()), out_specs=P(),
-        axis_names={pp_axis})(stacked_blocks, valid, h_micro)
+        axis_names={pp_axis}))
 
 
 def stacked_block_pspecs(mesh: Mesh, pp_axis: str = "pp") -> Params:

@@ -110,6 +110,27 @@ def test_gpipe_validation(setup):
         gpipe.microbatch(jnp.zeros((5, 2, 2)), 2)
 
 
+def test_gpipe_bubble_ticks_compile_to_conditional(setup):
+    """pp-only meshes skip bubble-tick FLOPs via per-core control flow;
+    tp meshes (collectives inside the block) keep compute-and-mask."""
+    import functools
+
+    config, params, _ = setup
+
+    def lowered_text(mesh):
+        stacked = _stack_for(config, params, mesh)
+        h = gpipe.microbatch(jnp.zeros((4, 10, config.n_embd)), 2)
+        return jax.jit(functools.partial(
+            gpipe.gpipe_apply_blocks, config=config, mesh=mesh,
+        )).lower(stacked, h).as_text()
+
+    # lax.cond lowers to stablehlo.case ("cond" alone also matches the
+    # scan while-loop's region name, so it can't discriminate)
+    assert "stablehlo.case" in lowered_text(spmd.make_mesh({"pp": 4, "dp": 2}))
+    assert "stablehlo.case" not in lowered_text(
+        spmd.make_mesh({"pp": 2, "tp": 2, "dp": 2}))
+
+
 # -- unequal stage sizes (padded stacking + identity masking) ----------------
 
 @pytest.mark.parametrize("n_layer,pp,boundaries", [
