@@ -51,6 +51,16 @@ from .tokenizer import get_tokenizer
 log = logging.getLogger(__name__)
 
 
+class UpstreamError(Exception):
+    """A shard hop failed (connection, HTTP error, or error body)."""
+
+    def __init__(self, shard: str, url: str, detail: str):
+        super().__init__(f"shard {shard} at {url}: {detail}")
+        self.shard = shard
+        self.url = url
+        self.detail = detail
+
+
 class InputIDs(BaseModel):
     input_ids: List[int]
 
@@ -180,24 +190,54 @@ def create_app(cfg: Optional[ServingConfig] = None,
                                  key=jax.random.PRNGKey(seed))
         return [int(t) for t in result.tokens[0]]
 
+    def _relay(shard: str, url: str, payload: dict, key: str):
+        """One shard hop with a single retry and typed failure.
+
+        Failure modes the reference leaves raw (SURVEY.md §2.3.5: its
+        role-guard 200s make raise_for_status useless and a misroute dies
+        as a KeyError): connection errors/timeouts (retried once after a
+        short backoff — enough for transient socket blips and service-VIP
+        re-resolution; a full k8s pod restart takes longer and still
+        surfaces as a typed error), HTTP errors, and
+        200-with-``{"error"}`` bodies. All surface as UpstreamError -> a
+        typed 502 from /generate, never a raw 500.
+        """
+        import time as _time
+
+        import requests
+
+        last: Exception = None
+        for attempt in range(2):
+            if attempt:
+                _time.sleep(0.25)
+            try:
+                resp = requests.post(url, json=payload, timeout=30)
+                resp.raise_for_status()
+                body = resp.json()
+                if key not in body:
+                    raise UpstreamError(
+                        shard, url,
+                        str(body.get("error", f"response missing {key!r}")))
+                return body[key]
+            except UpstreamError:
+                raise
+            except requests.exceptions.RequestException as e:
+                last = e
+        raise UpstreamError(shard, url, f"{type(last).__name__}: {last}")
+
     def _generate_remote(req: GenerateReq, prompt_ids: List[int]) -> List[int]:
         """Reference-topology decode: per token, POST the full sequence to
         shard A, relay hidden states to shard B, sample host-side
         (reference server.py:169-206). O(n²) and JSON-lossy by design —
         it exists for wire-level drop-in compatibility, not speed."""
-        import requests
-
         ids = list(prompt_ids)
         rng = np.random.default_rng(req.seed)
         for _ in range(req.max_new_tokens):
-            resp = requests.post(f"{cfg.shard_a_url}/forward",
-                                 json={"input_ids": ids}, timeout=30)
-            resp.raise_for_status()
-            hidden = resp.json()["hidden_states"]
-            resp2 = requests.post(f"{cfg.shard_b_url}/forward_b",
-                                  json={"hidden_states": hidden}, timeout=30)
-            resp2.raise_for_status()
-            logits = np.asarray(resp2.json()["logits"])[0, -1]
+            hidden = _relay("a", f"{cfg.shard_a_url}/forward",
+                            {"input_ids": ids}, "hidden_states")
+            logits = np.asarray(_relay(
+                "b", f"{cfg.shard_b_url}/forward_b",
+                {"hidden_states": hidden}, "logits"))[0, -1]
             if req.mode == "greedy":
                 ids.append(int(np.argmax(logits)))
             else:
@@ -231,7 +271,16 @@ def create_app(cfg: Optional[ServingConfig] = None,
         with timed("generate_request_seconds", mode=req.mode,
                    dispatch=cfg.dispatch):
             if cfg.dispatch == "remote":
-                ids = _generate_remote(req, prompt_ids)
+                try:
+                    ids = _generate_remote(req, prompt_ids)
+                except UpstreamError as e:
+                    # typed upstream failure (the reference propagates a
+                    # raw exception -> opaque 500, server.py:173-180)
+                    log.warning("upstream failure: %s", e)
+                    REGISTRY.inc("upstream_failures_total", shard=e.shard)
+                    return 502, {"error": "upstream_failure",
+                                 "shard": e.shard, "upstream": e.url,
+                                 "detail": e.detail}
             else:
                 ids = _generate_local(req, prompt_ids)
         REGISTRY.inc("generate_requests_total", mode=req.mode)

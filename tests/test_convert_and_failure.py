@@ -78,7 +78,44 @@ def test_dead_shard_yields_clean_error():
     try:
         r = coord.post("/generate", json={"prompt": "x", "max_new_tokens": 2,
                                           "mode": "greedy"})
-        assert r.status_code == 500
-        assert "ConnectionError" in r.json()["detail"]
+        assert r.status_code == 502
+        body = r.json()
+        assert body["error"] == "upstream_failure"
+        assert body["shard"] == "b"
+        assert "ConnectionError" in body["detail"]
+    finally:
+        sa.shutdown()
+
+
+def test_misrouted_shard_yields_typed_error():
+    """Shard B pointing at an A-role pod: the role guard answers 200 +
+    {"error": ...} (reference wire quirk, server.py:146-147) — the
+    reference coordinator then dies on a KeyError (SURVEY.md §2.3.5);
+    here it surfaces as a typed 502 carrying the guard's message."""
+    config = gpt2.GPT2Config(vocab_size=256, n_positions=32, n_embd=8,
+                             n_layer=2, n_head=2)
+    import jax
+    params = gpt2.init_params(config, jax.random.PRNGKey(0))
+    model = (config, params)
+
+    port_a = _free_port()
+    app_a = create_app(
+        ServingConfig(model_id="t", shard_role="a", boundaries=(1,),
+                      max_seq=32), model=model, tokenizer=ByteTokenizer())
+    sa = serve(app_a, host="127.0.0.1", port=port_a, block=False)
+    coord = TestClient(create_app(
+        ServingConfig(model_id="t", shard_role="coordinator",
+                      boundaries=(1,), max_seq=32, dispatch="remote",
+                      shard_a_service=f"127.0.0.1:{port_a}",
+                      shard_b_service=f"127.0.0.1:{port_a}"),  # misroute
+        model=model, tokenizer=ByteTokenizer()))
+    try:
+        r = coord.post("/generate", json={"prompt": "x", "max_new_tokens": 2,
+                                          "mode": "greedy"})
+        assert r.status_code == 502
+        body = r.json()
+        assert body["error"] == "upstream_failure"
+        assert body["shard"] == "b"
+        assert "not shard B" in body["detail"]
     finally:
         sa.shutdown()
