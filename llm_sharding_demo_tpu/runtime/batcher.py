@@ -1,0 +1,238 @@
+"""Request batching for serving: concurrent /generate calls share a chip.
+
+The reference processes one request at a time end-to-end (a single
+uvicorn worker looping over synchronous HTTP hops, reference
+server.py:154-210). Single-stream decode leaves most of a TPU idle —
+throughput scales near-linearly with batch size until the MXU saturates
+(bench cfg3: 8 rows ≈ 2x the aggregate tokens/sec of 1 row... per row).
+This module multiplexes concurrent requests onto batched decodes:
+
+- callers block in ``generate`` while a worker thread drains a queue,
+  groups compatible requests, left-pads the ragged prompts
+  (``runtime.engine`` handles per-row offsets/masks), runs ONE batched
+  decode, and distributes per-row results;
+- **shape bucketing keeps the compile space finite** — XLA compiles one
+  program per (batch, prompt_len, steps) triple, so raw request shapes
+  would compile forever. Batch sizes round up to powers of two (dummy
+  rows replicate the last real request and are dropped), prompt lengths
+  to multiples of ``prompt_bucket`` (extra left-pad columns; the pad
+  mask already excludes them), steps to multiples of ``steps_bucket``
+  (extra tokens generated then truncated per row). Bucketing never
+  pushes a batch past ``max_seq``: requests whose bucketed shapes can't
+  coexist are split into separately-feasible sub-batches instead of
+  erroring (each request individually fitting ``max_seq`` is the
+  caller's contract, enforced on entry);
+- only greedy requests batch together: sample-mode requests carry a
+  per-request PRNG seed whose reproducibility would be lost inside a
+  shared batch, so they run solo (documented contract, not a silent
+  behavior change). A policy change never starves anyone: the
+  out-of-policy request is held as the guaranteed head of the next
+  round, preserving FIFO.
+
+Greedy batching is exact: batched rows equal solo runs token-for-token
+(pinned by tests via the engine's ragged-parity guarantees).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from .engine import DecodeEngine, GenerateResult, SamplingConfig
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def _bucket_batch(n: int, cap: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+@dataclasses.dataclass
+class _Request:
+    prompt: np.ndarray            # [S] int32
+    max_new_tokens: int
+    sampling: SamplingConfig
+    key: Optional[jax.Array]
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    result: Optional[np.ndarray] = None   # [prompt+new] tokens
+    timing: Optional[GenerateResult] = None  # the batch's engine result
+    error: Optional[Exception] = None
+
+
+class BatchingEngine:
+    """Thread-safe batched front end over a ``DecodeEngine``.
+
+    ``generate`` may be called concurrently from many threads (the
+    serving stack runs one thread per request); calls block until their
+    tokens are ready. One worker thread owns all device dispatch, so JAX
+    sees single-threaded use.
+    """
+
+    def __init__(self, engine: DecodeEngine, max_batch: int = 8,
+                 max_wait_ms: float = 5.0, prompt_bucket: int = 16,
+                 steps_bucket: int = 32):
+        self.engine = engine
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_ms / 1e3
+        self.prompt_bucket = prompt_bucket
+        self.steps_bucket = steps_bucket
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._pending: Optional[_Request] = None  # held head of next round
+        self._stats_lock = threading.Lock()
+        self.batches_run = 0
+        self.rows_served = 0
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    # -- caller side ---------------------------------------------------------
+
+    def generate(self, prompt_ids, max_new_tokens: int,
+                 sampling: SamplingConfig = SamplingConfig(),
+                 key: Optional[jax.Array] = None,
+                 timeout: Optional[float] = None) -> GenerateResult:
+        """Single-sequence generate; blocks until the batch containing it
+        completes. Accepts [S] or [1, S] prompts (a batcher batches
+        *requests*; pre-batched multi-row input should go straight to the
+        engine)."""
+        prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("prompt must be non-empty")
+        if len(prompt) + max_new_tokens > self.engine.max_seq:
+            # per-request contract, checked on the caller's thread so the
+            # error is immediate and names THIS request's numbers (the
+            # worker plans sub-batches assuming every request fits)
+            raise ValueError(
+                f"prompt_len={len(prompt)} + max_new_tokens="
+                f"{max_new_tokens} exceeds max_seq={self.engine.max_seq}")
+        req = _Request(prompt=prompt, max_new_tokens=max_new_tokens,
+                       sampling=sampling, key=key)
+        self._queue.put(req)
+        if not req.done.wait(timeout):
+            raise TimeoutError("batched generate timed out")
+        if req.error is not None:
+            raise req.error
+        inner = req.timing
+        return GenerateResult(
+            tokens=req.result[None, :], prompt_len=len(prompt),
+            prefill_seconds=inner.prefill_seconds,
+            decode_seconds=inner.decode_seconds,
+            new_tokens=max_new_tokens,
+            decode_steps=inner.decode_steps)
+
+    # -- worker side ---------------------------------------------------------
+
+    def _gather(self) -> List[_Request]:
+        """Block for the first request, then collect batchable peers for
+        up to ``max_wait_ms``. Sample-mode requests always go solo (see
+        module docstring); greedy requests group freely. An out-of-policy
+        request ends the round and is HELD as the next round's first
+        request — re-queueing it at the tail would let sustained traffic
+        of the other policy starve it forever."""
+        first = self._pending or self._queue.get()
+        self._pending = None
+        batch = [first]
+        if first.sampling.mode != "greedy":
+            return batch
+        deadline = _monotonic() + self.max_wait_s
+        while len(batch) < self.max_batch:
+            remaining = deadline - _monotonic()
+            if remaining <= 0:
+                break
+            try:
+                nxt = self._queue.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if nxt.sampling == first.sampling:
+                batch.append(nxt)
+            else:
+                self._pending = nxt
+                break
+        return batch
+
+    def _plan(self, batch: List[_Request]) -> List[List[_Request]]:
+        """Split a gathered batch into bucket-feasible sub-batches.
+
+        Bucketing rounds the longest prompt up, so two requests that each
+        fit ``max_seq`` may not fit TOGETHER (a 500-token prompt next to
+        a 90-token-generation request at max_seq=512). Greedy first-fit
+        keeps arrival order within each sub-batch.
+        """
+        subs: List[List[_Request]] = []
+        for req in batch:
+            placed = False
+            for sub in subs:
+                trial = sub + [req]
+                if self._shapes(trial) is not None:
+                    sub.append(req)
+                    placed = True
+                    break
+            if not placed:
+                subs.append([req])
+        return subs
+
+    def _shapes(self, batch: List[_Request]):
+        """(s_max, steps) for a candidate batch, or None if infeasible.
+
+        Prompt bucketing is capped so bucket padding alone never pushes
+        past max_seq; a batch is feasible iff the capped bucket still
+        covers its longest prompt.
+        """
+        raw_s = max(len(r.prompt) for r in batch)
+        need = max(r.max_new_tokens for r in batch)
+        s_max = min(_round_up(raw_s, self.prompt_bucket),
+                    self.engine.max_seq - need)
+        if s_max < raw_s:
+            return None
+        steps = min(_round_up(need, self.steps_bucket),
+                    self.engine.max_seq - s_max)
+        return s_max, steps
+
+    def _loop(self):
+        while True:
+            gathered = self._gather()
+            for batch in self._plan(gathered):
+                try:
+                    self._run(batch)
+                except Exception as e:  # noqa: BLE001 — delivered per-request
+                    for req in batch:
+                        req.error = e
+                        req.done.set()
+
+    def _run(self, batch: List[_Request]):
+        s_max, steps = self._shapes(batch)  # planned feasible: not None
+        b = _bucket_batch(len(batch), self.max_batch)
+
+        ids = np.zeros((b, s_max), dtype=np.int32)
+        pad = np.zeros((b,), dtype=np.int32)
+        for i in range(b):
+            r = batch[min(i, len(batch) - 1)]  # dummy rows replicate last
+            ids[i, s_max - len(r.prompt):] = r.prompt
+            pad[i] = s_max - len(r.prompt)
+
+        key = batch[0].key  # greedy never consumes it; solo sample uses it
+        result = self.engine.generate(ids, steps,
+                                      sampling=batch[0].sampling, key=key,
+                                      pad=pad)
+        with self._stats_lock:
+            self.batches_run += 1
+            self.rows_served += len(batch)
+        for i, req in enumerate(batch):
+            row = result.tokens[i, int(pad[i]):]          # strip left pad
+            req.result = row[:len(req.prompt) + req.max_new_tokens]
+            req.timing = result
+            req.done.set()
+
+
+def _monotonic() -> float:
+    import time
+    return time.monotonic()

@@ -107,18 +107,27 @@ def left_pad(prompts, pad_id: int = 0) -> Tuple[np.ndarray, np.ndarray]:
 def prepare_generate(prompt_ids, max_new_tokens: int, max_seq: int,
                      sampling: SamplingConfig, key: Optional[jax.Array],
                      allow_ragged: bool = True,
+                     pad: Optional[np.ndarray] = None,
                      ) -> Tuple[np.ndarray, int, int, jax.Array, np.ndarray]:
     """Shared validation/normalization for every ``generate`` front end
     (single-device engine and pipeline runner).
 
     Returns ``(ids [B,S], batch, prompt_len, key, pad [B])``. Ragged input
     (a list of unequal-length sequences) is left-padded; ``pad[b]`` is row
-    b's pad-prefix length (all zeros for rectangular input). The overflow
-    check is the static guard against silent KV-cache clamping: past
-    ``max_seq``, ``dynamic_update_slice`` would clamp the write offset and
-    corrupt generation without an error (see ops.attention.cached_attention).
+    b's pad-prefix length (all zeros for rectangular input). Callers that
+    pre-pad themselves (``runtime.batcher`` buckets shapes) pass their own
+    ``pad`` vector with rectangular ids. The overflow check is the static
+    guard against silent KV-cache clamping: past ``max_seq``,
+    ``dynamic_update_slice`` would clamp the write offset and corrupt
+    generation without an error (see ops.attention.cached_attention).
     """
-    if (isinstance(prompt_ids, (list, tuple)) and prompt_ids
+    if pad is not None:
+        ids = np.asarray(prompt_ids)
+        if ids.ndim != 2 or len(pad) != ids.shape[0]:
+            raise ValueError("explicit pad requires [B, S] ids with one "
+                             "pad entry per row")
+        pad = np.asarray(pad, dtype=np.int32)
+    elif (isinstance(prompt_ids, (list, tuple)) and prompt_ids
             and not np.isscalar(prompt_ids[0])
             and len({len(np.asarray(p).reshape(-1)) for p in prompt_ids}) > 1):
         if not allow_ragged:
@@ -350,14 +359,17 @@ class DecodeEngine:
 
     def generate(self, prompt_ids, max_new_tokens: int,
                  sampling: SamplingConfig = SamplingConfig(),
-                 key: Optional[jax.Array] = None) -> GenerateResult:
+                 key: Optional[jax.Array] = None,
+                 pad: Optional[np.ndarray] = None) -> GenerateResult:
         """[B, S] (or [S]) prompt ids -> GenerateResult with [B, S+N] tokens.
 
         Validation (including the static cache-overflow guard) is shared
-        with the pipeline runner via ``prepare_generate``.
+        with the pipeline runner via ``prepare_generate``. ``pad`` lets
+        pre-padded callers (runtime.batcher) declare their left-pad
+        prefixes explicitly.
         """
         ids, batch, prompt_len, key, pad = prepare_generate(
-            prompt_ids, max_new_tokens, self.max_seq, sampling, key)
+            prompt_ids, max_new_tokens, self.max_seq, sampling, key, pad=pad)
 
         ids_j = jnp.asarray(ids, dtype=jnp.int32)
         # Rectangular batches keep pad=None: the compiled programs then skip

@@ -86,6 +86,12 @@ def create_app(cfg: Optional[ServingConfig] = None,
     for tests; by default resolved via ``serving.loader`` / HF-or-byte
     tokenizer."""
     cfg = cfg or from_env()
+    # multi-host glue sits HERE, where every entry path converges (CLI,
+    # `serving.app:app` lazy attribute, tests) — it must run before the
+    # first backend use, i.e. before the model loads. No-op when the
+    # COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID contract is unset.
+    from ..parallel.distributed import maybe_initialize
+    maybe_initialize()
     config, params = model if model is not None else loader.resolve_model(cfg)
     tokenizer = tokenizer or get_tokenizer(cfg.model_id,
                                            checkpoint_dir=cfg.checkpoint_dir)
@@ -120,9 +126,22 @@ def create_app(cfg: Optional[ServingConfig] = None,
             # the whole model decodes as one program on the pod's devices.
             from ..runtime.engine import DecodeEngine
             runner = DecodeEngine(params, config, max_seq=cfg.max_seq)
+        elif cfg.max_batch > 1:
+            # Continuous batching multiplexes concurrent requests onto
+            # shared ragged batched decodes (runtime.batcher). It rides
+            # the staged DecodeEngine (single program per phase, ragged
+            # support); the per-device PipelineRunner stays the
+            # single-stream serving path.
+            from ..runtime.engine import DecodeEngine
+            runner = DecodeEngine(params, config, max_seq=cfg.max_seq,
+                                  boundaries=list(cfg.boundaries))
         else:
             runner = PipelineRunner(params, config, list(cfg.boundaries),
                                     max_seq=cfg.max_seq)
+        if cfg.max_batch > 1:
+            from ..runtime.batcher import BatchingEngine
+            runner = BatchingEngine(runner, max_batch=cfg.max_batch,
+                                    max_wait_ms=cfg.batch_wait_ms)
     if is_moe:
         compat_specs = compat_params = None
     else:
@@ -150,6 +169,7 @@ def create_app(cfg: Optional[ServingConfig] = None,
             "model": cfg.model_id,
             "n_stages": len(cfg.boundaries) + 1,
             "dispatch": cfg.dispatch,
+            "max_batch": cfg.max_batch,
             "devices": [str(d) for d in jax.devices()],
         }
 

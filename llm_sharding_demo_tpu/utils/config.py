@@ -39,6 +39,11 @@ class ServingConfig:
     # POSTs to shard-a/shard-b services over HTTP (reference
     # server.py:172-181).
     dispatch: str = "local"
+    # Continuous batching (runtime.batcher): >1 multiplexes concurrent
+    # /generate requests onto shared batched decodes. 1 = off (the
+    # reference's one-at-a-time behavior).
+    max_batch: int = 1
+    batch_wait_ms: float = 5.0
 
     def __post_init__(self):
         if self.shard_role not in VALID_ROLES:
@@ -55,6 +60,11 @@ class ServingConfig:
                 "strictly increasing (single source of truth for ALL roles)")
         if self.max_seq < 2:
             raise ValueError(f"max_seq={self.max_seq} too small")
+        if self.max_batch < 1:
+            raise ValueError(f"MAX_BATCH={self.max_batch} must be >= 1")
+        if self.batch_wait_ms < 0:
+            raise ValueError(
+                f"BATCH_WAIT_MS={self.batch_wait_ms} must be >= 0")
 
     @property
     def split_at(self) -> int:
@@ -113,4 +123,6 @@ def from_env() -> ServingConfig:
         checkpoint_dir=os.environ.get("CHECKPOINT_DIR") or None,
         max_seq=_env_int("MAX_SEQ", 512),
         dispatch=os.environ.get("DISPATCH", "local"),
+        max_batch=_env_int("MAX_BATCH", 1),
+        batch_wait_ms=float(os.environ.get("BATCH_WAIT_MS", "5.0")),
     )

@@ -1,0 +1,180 @@
+"""Continuous batching (runtime.batcher): concurrent requests share
+batched decodes without changing any request's greedy tokens.
+
+Correctness bar: a request through the batcher — whatever it got batched
+with, however shapes were bucketed — produces exactly the tokens of a
+solo engine run (the engine's ragged-parity guarantees make left-pad
+bucketing invisible). Sample mode is self-consistent (same seed, same
+tokens) but runs solo by contract.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from llm_sharding_demo_tpu.models import gpt2
+from llm_sharding_demo_tpu.runtime.batcher import BatchingEngine
+from llm_sharding_demo_tpu.runtime.engine import DecodeEngine, SamplingConfig
+
+
+@pytest.fixture(scope="module")
+def setup():
+    config = gpt2.GPT2Config(vocab_size=211, n_positions=128, n_embd=32,
+                             n_layer=2, n_head=4)
+    params = gpt2.init_params(config, jax.random.PRNGKey(0))
+    engine = DecodeEngine(params, config, max_seq=96)
+    # generous wait so slow CI thread scheduling still coalesces batches
+    # (the batches_run < rows_served assertion below would flake at
+    # small waits if every request trickled in solo)
+    return engine, BatchingEngine(engine, max_batch=4, max_wait_ms=200.0)
+
+
+def test_concurrent_greedy_matches_solo(setup):
+    engine, batcher = setup
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, 211, size=(n,)) for n in (3, 7, 12, 5, 9, 4)]
+    want = [engine.generate(p[None, :], 8).tokens[0] for p in prompts]
+
+    results = [None] * len(prompts)
+
+    def worker(i):
+        results[i] = batcher.generate(prompts[i], 8).tokens[0]
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    for i, (got, ref) in enumerate(zip(results, want)):
+        assert got is not None, f"request {i} never completed"
+        np.testing.assert_array_equal(got, ref, err_msg=f"request {i}")
+    # the point of the exercise: fewer device batches than requests
+    assert batcher.batches_run < batcher.rows_served
+    assert batcher.rows_served >= len(prompts)
+
+
+def test_varied_token_counts_truncate_per_request(setup):
+    engine, batcher = setup
+    rng = np.random.default_rng(2)
+    p1, p2 = rng.integers(0, 211, size=(6,)), rng.integers(0, 211, size=(11,))
+    results = {}
+
+    def run(name, p, n):
+        results[name] = batcher.generate(p, n).tokens[0]
+
+    a = threading.Thread(target=run, args=("a", p1, 3))
+    b = threading.Thread(target=run, args=("b", p2, 17))
+    a.start(), b.start()
+    a.join(timeout=300), b.join(timeout=300)
+    assert len(results["a"]) == 6 + 3
+    assert len(results["b"]) == 11 + 17
+    np.testing.assert_array_equal(results["a"],
+                                  engine.generate(p1[None, :], 3).tokens[0])
+    np.testing.assert_array_equal(results["b"],
+                                  engine.generate(p2[None, :], 17).tokens[0])
+
+
+def test_sample_mode_runs_solo_and_reproducibly(setup):
+    _, batcher = setup
+    p = np.asarray([5, 17, 33])
+    s = SamplingConfig(mode="sample", temperature=0.6, top_k=10)
+    a = batcher.generate(p, 6, sampling=s, key=jax.random.PRNGKey(3))
+    b = batcher.generate(p, 6, sampling=s, key=jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(a.tokens, b.tokens)
+
+
+def test_overflow_surfaces_as_request_error(setup):
+    _, batcher = setup
+    p = np.arange(60) % 211
+    with pytest.raises(ValueError, match="max_seq"):
+        batcher.generate(p, 90)
+
+
+def test_infeasible_together_requests_split_into_subbatches(setup):
+    """Each request fits max_seq alone, but bucketed together they would
+    exceed it (long prompt + long generation) — the planner must split
+    them, not error (round-2 review finding)."""
+    engine, batcher = setup  # max_seq = 96
+    rng = np.random.default_rng(5)
+    long_prompt = rng.integers(0, 211, size=(80,))   # 80 + 8  = 88 <= 96
+    long_gen = rng.integers(0, 211, size=(8,))       # 8  + 60 = 68 <= 96
+    assert batcher._shapes([
+        _fake(long_prompt, 8), _fake(long_gen, 60)]) is None  # infeasible
+
+    results = {}
+
+    def run(name, p, n):
+        results[name] = batcher.generate(p, n).tokens[0]
+
+    a = threading.Thread(target=run, args=("a", long_prompt, 8))
+    b = threading.Thread(target=run, args=("b", long_gen, 60))
+    a.start(), b.start()
+    a.join(timeout=600), b.join(timeout=600)
+    np.testing.assert_array_equal(
+        results["a"], engine.generate(long_prompt[None, :], 8).tokens[0])
+    np.testing.assert_array_equal(
+        results["b"], engine.generate(long_gen[None, :], 60).tokens[0])
+
+
+def _fake(prompt, n):
+    from llm_sharding_demo_tpu.runtime.batcher import _Request
+    from llm_sharding_demo_tpu.runtime.engine import SamplingConfig
+    return _Request(prompt=np.asarray(prompt, np.int32), max_new_tokens=n,
+                    sampling=SamplingConfig(), key=None)
+
+
+def test_serving_integration_with_batching():
+    """Real-socket server with MAX_BATCH=4: concurrent POSTs all answer
+    and match the unbatched app's deterministic greedy output."""
+    import json
+    import urllib.request
+
+    from llm_sharding_demo_tpu.serving.app import create_app
+    from llm_sharding_demo_tpu.serving.http import TestClient, serve
+    from llm_sharding_demo_tpu.serving.tokenizer import ByteTokenizer
+    from llm_sharding_demo_tpu.utils.config import ServingConfig
+    from tests.test_convert_and_failure import _free_port
+
+    config = gpt2.GPT2Config(vocab_size=256, n_positions=64, n_embd=16,
+                             n_layer=2, n_head=2)
+    params = gpt2.init_params(config, jax.random.PRNGKey(4))
+    model = (config, params)
+
+    ref_app = TestClient(create_app(
+        ServingConfig(model_id="t", shard_role="coordinator", max_seq=48),
+        model=model, tokenizer=ByteTokenizer()))
+
+    port = _free_port()
+    app = create_app(
+        ServingConfig(model_id="t", shard_role="coordinator", max_seq=48,
+                      max_batch=4, batch_wait_ms=25.0),
+        model=model, tokenizer=ByteTokenizer())
+    server = serve(app, host="127.0.0.1", port=port, block=False)
+    try:
+        prompts = ["Hi", "Hello there", "abc", "xyzw"]
+        want = {p: ref_app.post("/generate", json={
+            "prompt": p, "max_new_tokens": 4, "mode": "greedy"}
+        ).json()["generated"] for p in prompts}
+
+        results = {}
+
+        def post(p):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                json.dumps({"prompt": p, "max_new_tokens": 4,
+                            "mode": "greedy"}).encode(),
+                {"content-type": "application/json"})
+            results[p] = json.loads(
+                urllib.request.urlopen(req, timeout=300).read())["generated"]
+
+        threads = [threading.Thread(target=post, args=(p,)) for p in prompts]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert results == want
+    finally:
+        server.shutdown()
