@@ -1,0 +1,112 @@
+"""Multi-host bootstrap: DCN glue so one program spans TPU hosts.
+
+The reference's only "distributed backend" is synchronous HTTP/JSON
+between single-host pods (reference server.py:172-181; SURVEY.md §2.2
+last row). The TPU-native equivalent has two layers:
+
+- **intra-slice (ICI)**: already covered everywhere else — device meshes,
+  GSPMD annotations, ``ppermute``/``psum`` collectives (parallel.spmd,
+  parallel.gpipe, parallel.ppdecode);
+- **inter-host (DCN)**: this module. ``jax.distributed`` connects the
+  per-host processes into one runtime: after ``initialize()``, every
+  process sees the GLOBAL device set (``jax.devices()``), a single jitted
+  program spans all hosts, and XLA routes collectives over ICI within a
+  slice and DCN across slices. The same mesh/sharding code used on one
+  host then works unchanged — which is the whole point: no NCCL/MPI-style
+  separate codepath exists to port (SURVEY.md: the reference has none
+  either).
+
+Environment contract (standard JAX + k8s-friendly): ``COORDINATOR_ADDRESS``
+(host:port of process 0), ``NUM_PROCESSES``, ``PROCESS_ID``. All three
+unset means single-process (the common dev / single-pod case, a no-op);
+set them together or get a startup error. Cloud TPU pod slices can
+auto-detect these from TPU metadata via a bare
+``jax.distributed.initialize()`` — deliberately NOT wired here, because
+this module can't verify that path in this environment and a silent
+half-initialized guess is worse than an explicit contract; call
+``jax.distributed.initialize()`` yourself on managed pod slices.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+log = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def maybe_initialize(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> bool:
+    """Connect this process to the multi-host runtime if configured.
+
+    Explicit arguments win over env vars. Returns True when
+    ``jax.distributed.initialize`` ran (now or earlier), False for the
+    single-process no-op. Must be called before the first backend use —
+    same constraint jax.distributed itself imposes.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    addr = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    nproc = num_processes if num_processes is not None else (
+        int(os.environ["NUM_PROCESSES"])
+        if "NUM_PROCESSES" in os.environ else None)
+    pid = process_id if process_id is not None else (
+        int(os.environ["PROCESS_ID"])
+        if "PROCESS_ID" in os.environ else None)
+
+    if addr is None and nproc is None and pid is None:
+        return False  # single-process: nothing to connect
+    if addr is None or nproc is None or pid is None:
+        raise ValueError(
+            "partial multi-host config: COORDINATOR_ADDRESS, NUM_PROCESSES "
+            "and PROCESS_ID must be set together "
+            f"(got addr={addr!r}, nproc={nproc!r}, pid={pid!r})")
+    jax.distributed.initialize(coordinator_address=addr,
+                               num_processes=nproc, process_id=pid)
+    _initialized = True
+    log.info("joined multi-host runtime: process %d/%d via %s; "
+             "%d global devices on %d processes", pid, nproc, addr,
+             len(jax.devices()), jax.process_count())
+    return True
+
+
+def global_mesh(axes: Dict[str, int]) -> Mesh:
+    """A mesh over the GLOBAL device set (all hosts), axes as given.
+
+    Multi-host layout guidance baked in: the FIRST axis is the
+    slowest-varying over the device list, and JAX orders global devices
+    process-major — so put the data-parallel (or pipeline) axis first to
+    make it the cross-host axis (gradient all-reduce / stage handoff over
+    DCN once per step) and keep tensor/sequence axes inside a host's
+    slice where collectives ride ICI per layer.
+    """
+    devices = np.asarray(jax.devices())
+    total = int(np.prod(list(axes.values())))
+    if total != devices.size:
+        raise ValueError(
+            f"mesh axes {axes} need {total} devices, the global runtime "
+            f"has {devices.size} (across {jax.process_count()} processes)")
+    return Mesh(devices.reshape(tuple(axes.values())), tuple(axes.keys()))
+
+
+def shard_host_batch(local_batch, mesh: Mesh, axis: str = "dp"):
+    """Per-host input pipeline -> one global sharded array.
+
+    Each process passes its HOST-LOCAL batch shard (e.g. its slice of a
+    dataset); the result is the global [sum-of-locals, ...] array sharded
+    over ``axis``, built without any host ever materializing the full
+    batch (``jax.make_array_from_process_local_data`` moves only local
+    data to local devices; DCN is never touched for input).
+    """
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(local_batch))

@@ -25,7 +25,7 @@ def main() -> None:
                         help="default: SHARD_PORT env (5000)")
     args = parser.parse_args()
     cfg = from_env()
-    app = create_app(cfg)
+    app = create_app(cfg)  # create_app joins the multi-host runtime
     port = args.port if args.port is not None else cfg.shard_port
     logging.getLogger(__name__).info(
         "serving role=%s dispatch=%s on %s:%d",
