@@ -239,7 +239,8 @@ def measure_moe(prompt_len: int, batch: int = 1,
     from llm_sharding_demo_tpu.models import moe
     from llm_sharding_demo_tpu.runtime.engine import DecodeEngine
 
-    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype_name]
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+             "int8": "int8"}[dtype_name]
     if config is None:
         config = moe.MoEConfig(vocab_size=50257, n_positions=1024, n_embd=768,
                                n_layer=12, n_head=12, n_experts=8,
@@ -541,14 +542,17 @@ def main() -> None:
     # SURVEY.md §2.2 "EP: not applicable"); vs_baseline compares against
     # the dense 124M reference loop as the nearest anchor.
     moe_bf16 = measure_moe(PROMPT_LEN, 1, "bfloat16")
+    moe_int8 = measure_moe(PROMPT_LEN, 1, "int8")
     configs.append({
         "name": "cfg6_moe_8e_top2_124m_geometry",
         "tokens_per_sec": round(moe_bf16["tokens_per_sec"], 2),
+        "int8_tokens_per_sec": round(moe_int8["tokens_per_sec"], 2),
         "p50_token_latency_ms": round(moe_bf16["p50_token_latency_ms"], 3),
         "ref_cpu_tokens_per_sec": round(ref_124, 2),
         "vs_baseline": round(moe_bf16["tokens_per_sec"] / ref_124, 2),
         "note": "GPT-2 124M geometry, dense MLP -> 8 experts top-2 "
-                "(~7x MLP weights); steady-state bf16 cached decode; "
+                "(~7x MLP weights); steady-state bf16 cached decode, plus "
+                "the weight-only int8 row (router+experts+wte quantized); "
                 "reference has no MoE — anchor is the dense 124M CPU loop",
     })
 

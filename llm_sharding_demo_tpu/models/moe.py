@@ -28,7 +28,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.layers import gelu_new
+from ..ops.layers import gelu_new, linear
 from ..ops.attention import KVCache
 from .gpt2 import (GPT2Config, Params, _block as gpt2_block, embed,
                    final_logits)
@@ -98,6 +98,20 @@ def init_params(config: MoEConfig, key: jax.Array, dtype=jnp.float32) -> Params:
     }
 
 
+def _expert_einsum(eq: str, x: jnp.ndarray, kernel) -> jnp.ndarray:
+    """Batched-over-experts contraction, int8-aware.
+
+    A quantized expert kernel is ``{"q": int8 [E, in, out], "scale":
+    [E, out]}`` (ops.quant stores per-(expert, out-channel) scales); the
+    int8->activation convert sits on the dot operand so only int8 bytes
+    cross HBM, and the rescale broadcasts over the [E, b, c, out] result.
+    """
+    if isinstance(kernel, dict):
+        y = jnp.einsum(eq, x, kernel["q"].astype(x.dtype))
+        return y * kernel["scale"][:, None, None, :].astype(x.dtype)
+    return jnp.einsum(eq, x, kernel)
+
+
 def moe_mlp(moe_params: Params, h: jnp.ndarray, config: MoEConfig,
             token_valid: Optional[jnp.ndarray] = None,
             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -113,7 +127,8 @@ def moe_mlp(moe_params: Params, h: jnp.ndarray, config: MoEConfig,
     e, k = config.n_experts, config.expert_top_k
     cap = expert_capacity(config, s)
 
-    gate_logits = h @ moe_params["router"]["kernel"]            # [B,S,E]
+    # via ops.layers.linear so the weight-only-int8 router leaf works too
+    gate_logits = linear(h, moe_params["router"]["kernel"])     # [B,S,E]
     gates = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
 
     # top-k selection: iteratively take the argmax, zero it, repeat —
@@ -151,11 +166,11 @@ def moe_mlp(moe_params: Params, h: jnp.ndarray, config: MoEConfig,
 
     # expert compute: everything below is batched over E (the ep axis)
     xin = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(h.dtype), h)
-    h1 = jnp.einsum("ebcd,edf->ebcf", xin,
-                    moe_params["experts"]["c_fc"]["kernel"])
+    h1 = _expert_einsum("ebcd,edf->ebcf", xin,
+                        moe_params["experts"]["c_fc"]["kernel"])
     h1 = gelu_new(h1 + moe_params["experts"]["c_fc"]["bias"][:, None, None, :])
-    h2 = jnp.einsum("ebcf,efd->ebcd", h1,
-                    moe_params["experts"]["c_proj"]["kernel"])
+    h2 = _expert_einsum("ebcf,efd->ebcd", h1,
+                        moe_params["experts"]["c_proj"]["kernel"])
     h2 = h2 + moe_params["experts"]["c_proj"]["bias"][:, None, None, :]
     out = jnp.einsum("bsec,ebcd->bsd", combine.astype(h.dtype), h2)
 

@@ -89,13 +89,15 @@ def global_mesh(axes: Dict[str, int]) -> Mesh:
     DCN once per step) and keep tensor/sequence axes inside a host's
     slice where collectives ride ICI per layer.
     """
-    devices = np.asarray(jax.devices())
+    from .spmd import make_mesh
+
     total = int(np.prod(list(axes.values())))
-    if total != devices.size:
+    if total != len(jax.devices()):
         raise ValueError(
             f"mesh axes {axes} need {total} devices, the global runtime "
-            f"has {devices.size} (across {jax.process_count()} processes)")
-    return Mesh(devices.reshape(tuple(axes.values())), tuple(axes.keys()))
+            f"has {len(jax.devices())} (across {jax.process_count()} "
+            "processes)")
+    return make_mesh(axes)
 
 
 def shard_host_batch(local_batch, mesh: Mesh, axis: str = "dp"):

@@ -43,6 +43,7 @@ from typing import List, Optional
 import jax
 import numpy as np
 
+from ..utils.metrics import REGISTRY
 from .engine import DecodeEngine, GenerateResult, SamplingConfig
 
 
@@ -226,6 +227,9 @@ class BatchingEngine:
         with self._stats_lock:
             self.batches_run += 1
             self.rows_served += len(batch)
+        REGISTRY.inc("decode_batches_total")
+        REGISTRY.inc("batched_requests_total", value=len(batch))
+        REGISTRY.inc("batched_rows_padded_total", value=b - len(batch))
         for i, req in enumerate(batch):
             row = result.tokens[i, int(pad[i]):]          # strip left pad
             req.result = row[:len(req.prompt) + req.max_new_tokens]

@@ -239,12 +239,6 @@ class DecodeEngine:
         quantize = dtype == "int8" or dtype == jnp.int8
         if quantize:
             dtype = jnp.bfloat16  # activation/KV-cache dtype under int8
-            from ..models.moe import MoEConfig
-            if isinstance(config, MoEConfig):
-                raise NotImplementedError(
-                    "int8 weight-only quantization covers the dense GPT-2 "
-                    "family (the MoE expert einsums address kernels "
-                    "directly); decode MoE in bfloat16")
             from ..ops.quant import quantize_params
             # quantize straight from the checkpoint dtype: a bf16 pre-cast
             # would truncate mantissas BEFORE rounding to int8 codes
