@@ -140,7 +140,8 @@ def embed(params: Params, input_ids: jnp.ndarray,
     seq_len = input_ids.shape[-1]
     positions = jnp.maximum(position_offset + jnp.arange(seq_len), 0)
     wte = params["wte"]
-    if isinstance(wte, dict):  # weight-only int8 table (ops.quant)
+    from ..ops.quant import is_quantized
+    if is_quantized(wte):  # weight-only int8 table (ops.quant)
         from ..ops.quant import embed_rows
         return embed_rows(wte, input_ids) + params["wpe"][positions]
     return wte[input_ids] + params["wpe"][positions]
@@ -277,7 +278,8 @@ def final_logits(params: Params, h: jnp.ndarray, eps: float) -> jnp.ndarray:
     tie behavior).
     """
     h = layer_norm(h, params["ln_f"]["scale"], params["ln_f"]["bias"], eps)
-    if isinstance(params["wte"], dict):  # int8 table: fold scale into h
+    from ..ops.quant import is_quantized
+    if is_quantized(params["wte"]):  # int8 table: fold scale into h
         from ..ops.quant import head_logits
         return head_logits(h, params["wte"])
     return jnp.einsum("bsd,vd->bsv", h, params["wte"],

@@ -101,14 +101,27 @@ def init_params(config: MoEConfig, key: jax.Array, dtype=jnp.float32) -> Params:
 def _expert_einsum(eq: str, x: jnp.ndarray, kernel) -> jnp.ndarray:
     """Batched-over-experts contraction, int8-aware.
 
-    A quantized expert kernel is ``{"q": int8 [E, in, out], "scale":
-    [E, out]}`` (ops.quant stores per-(expert, out-channel) scales); the
-    int8->activation convert sits on the dot operand so only int8 bytes
-    cross HBM, and the rescale broadcasts over the [E, b, c, out] result.
+    A quantized expert kernel is a ``QuantizedTensor`` with ``q`` int8
+    [E, in, out] and per-(expert, out-channel) ``scale`` [E, out]; the
+    int8->activation convert sits on the dot operand and the rescale
+    broadcasts over the [E, ..., out] result.
+
+    Deliberately the XLA lowering, NOT a Pallas kernel: measured on the
+    bench chip at the 8-expert/124M geometry, the expert-batched einsum
+    decodes at ~975 tok/s vs ~755 for a grid=(E, out_blocks) Pallas
+    kernel (1-row tiles pay per-cell overhead XLA's batched matmul
+    avoids) and ~595 for per-expert unrolled kernel launches. The dense
+    model's matvecs are where the custom kernel wins (see
+    quant.quant_matmul); here XLA already streams the batch well.
     """
-    if isinstance(kernel, dict):
-        y = jnp.einsum(eq, x, kernel["q"].astype(x.dtype))
-        return y * kernel["scale"][:, None, None, :].astype(x.dtype)
+    from ..ops import quant
+
+    if quant.is_quantized(kernel):
+        lead = x.shape[1:-1]
+        e, _, out = kernel.q.shape
+        y = jnp.einsum(eq, x, kernel.q.astype(x.dtype))
+        return y * kernel.scale.reshape(
+            (e,) + (1,) * len(lead) + (out,)).astype(x.dtype)
     return jnp.einsum(eq, x, kernel)
 
 
@@ -128,6 +141,8 @@ def moe_mlp(moe_params: Params, h: jnp.ndarray, config: MoEConfig,
     cap = expert_capacity(config, s)
 
     # via ops.layers.linear so the weight-only-int8 router leaf works too
+    # (E is rarely lane-aligned, so the router usually takes the XLA
+    # path — it is a negligible fraction of the weight bytes)
     gate_logits = linear(h, moe_params["router"]["kernel"])     # [B,S,E]
     gates = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
 

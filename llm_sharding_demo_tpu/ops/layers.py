@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from .quant import QuantizedTensor, quant_matmul
+
 
 def layer_norm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
                eps: float = 1e-5) -> jnp.ndarray:
@@ -52,12 +54,11 @@ def linear(x: jnp.ndarray, kernel, bias: jnp.ndarray | None = None
     ``nn.Linear``) so checkpoint conversion is a direct copy — this is the
     Conv1D layout trap called out in SURVEY.md §5 "Checkpoint / resume".
 
-    ``kernel`` may be a weight-only-int8 quantized leaf (``{"q",
-    "scale"}``, see ``ops.quant``) — the int8 decode path flows through
-    here without the model code knowing.
+    ``kernel`` may be a weight-only-int8 ``QuantizedTensor`` (see
+    ``ops.quant``) — the int8 decode path flows through here without the
+    model code knowing.
     """
-    if isinstance(kernel, dict):
-        from .quant import quant_matmul  # lazy: quant imports nothing heavy
+    if isinstance(kernel, QuantizedTensor):
         y = quant_matmul(x, kernel)
     else:
         y = x @ kernel

@@ -91,13 +91,12 @@ def global_mesh(axes: Dict[str, int]) -> Mesh:
     """
     from .spmd import make_mesh
 
-    total = int(np.prod(list(axes.values())))
-    if total != len(jax.devices()):
-        raise ValueError(
-            f"mesh axes {axes} need {total} devices, the global runtime "
-            f"has {len(jax.devices())} (across {jax.process_count()} "
-            "processes)")
-    return make_mesh(axes)
+    try:
+        return make_mesh(axes)
+    except ValueError as e:
+        raise ValueError(  # add the multi-process context to the count error
+            f"{e} (global runtime spans {jax.process_count()} "
+            "process(es))") from None
 
 
 def shard_host_batch(local_batch, mesh: Mesh, axis: str = "dp"):

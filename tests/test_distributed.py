@@ -47,7 +47,7 @@ def test_global_mesh_and_host_batch():
 
 
 def test_global_mesh_size_mismatch():
-    with pytest.raises(ValueError, match="need 16 devices"):
+    with pytest.raises(ValueError, match="needs 16 devices"):
         distributed.global_mesh({"dp": 4, "tp": 4})
 
 

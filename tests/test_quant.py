@@ -24,7 +24,7 @@ def test_quantize_roundtrip_error_bound():
     back = quant.dequantize_array(qleaf, jnp.float32)
     # symmetric round-to-nearest: |err| <= scale/2 per element
     err = np.abs(np.asarray(back) - np.asarray(w))
-    bound = np.asarray(qleaf["scale"])[None, :] / 2 + 1e-7
+    bound = np.asarray(qleaf.scale)[None, :] / 2 + 1e-7
     assert (err <= bound).all()
 
 
@@ -45,10 +45,10 @@ def test_stacked_kernel_quantizes_per_layer_channel():
     w = np.ones((2, 8, 4), dtype=np.float32)
     w[1] *= 100.0  # layer 1 has 100x the magnitude; scales must differ
     qleaf = quant.quantize_array(jnp.asarray(w), jnp.float32)
-    assert qleaf["q"].shape == (2, 8, 4)
-    assert qleaf["scale"].shape == (2, 4)
-    np.testing.assert_allclose(np.asarray(qleaf["scale"][1]),
-                               100 * np.asarray(qleaf["scale"][0]),
+    assert qleaf.q.shape == (2, 8, 4)
+    assert qleaf.scale.shape == (2, 4)
+    np.testing.assert_allclose(np.asarray(qleaf.scale[1]),
+                               100 * np.asarray(qleaf.scale[0]),
                                rtol=1e-6)
 
 
@@ -159,3 +159,47 @@ def test_int8_expert_einsum_matches_dequantized():
                       quant.dequantize_array(qleaf, jnp.float32))
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=1e-5, rtol=1e-5)
+
+
+
+
+# -- Pallas decode kernels (interpret mode on CPU; Mosaic on TPU) ------------
+
+def test_pallas_linear_matches_xla_path():
+    """The int8-streaming kernel == the XLA fallback, lane-aligned shapes."""
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.normal(size=(2, 128)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(128, 256)).astype(np.float32))
+    qleaf = quant.quantize_array(w, jnp.float32)
+    got = quant.quant_matmul(x, qleaf, force_pallas=True)
+    want = quant.quant_matmul(x, qleaf)  # XLA path on CPU
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_pallas_head_matches_xla_and_slices_vocab_pad():
+    """Padded-vocab head kernel: logits equal the XLA path and the padded
+    rows are sliced off (a zero pad logit would poison argmax whenever
+    all real logits are negative)."""
+    rng = np.random.default_rng(10)
+    d, v = 128, 200  # pads to _VOCAB_PAD
+    h = jnp.asarray(rng.normal(size=(1, 1, d)).astype(np.float32))
+    wte = jnp.asarray(rng.normal(size=(v, d)).astype(np.float32))
+    qleaf = quant.quantize_params({"wte": wte}, jnp.float32)["wte"]
+    assert qleaf.rows == v and qleaf.q.shape[0] == quant._round_up_vocab(v)
+    got = quant.head_logits(h, qleaf, force_pallas=True)
+    want = quant.head_logits(h, qleaf)
+    assert got.shape == (1, 1, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_embed_rows_ignores_vocab_padding():
+    rng = np.random.default_rng(11)
+    wte = jnp.asarray(rng.normal(size=(200, 128)).astype(np.float32))
+    qleaf = quant.quantize_params({"wte": wte}, jnp.float32)["wte"]
+    ids = jnp.asarray([[0, 37, 199]])
+    got = quant.embed_rows(qleaf, ids)
+    want = quant.dequantize_array(qleaf, jnp.float32)[ids]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-6, rtol=1e-6)
