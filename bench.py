@@ -44,7 +44,7 @@ PROMPT_LEN = 16
 # two windows cancels it, giving the steady-state per-token cost the
 # hardware actually delivers.
 STEPS_A = 64
-STEPS_B = 256
+STEPS_B = 512
 
 
 def measure_reference_cpu(config, prompt_len: int, new_tokens: int) -> float:
@@ -112,7 +112,7 @@ def measure_dispatch_rtt() -> float:
     return (time.perf_counter() - t0) / n * 1e3
 
 
-def marginal_seconds(time_window, n1: int, n2: int, reps: int = 3):
+def marginal_seconds(time_window, n1: int, n2: int, reps: int = 5):
     """THE timing harness for the tunneled backend, used by every config.
 
     ``time_window(n)`` must run one dependency-chained compiled program of

@@ -233,7 +233,7 @@ def quantize_params(params: Params, compute_dtype=jnp.bfloat16) -> Params:
     MoE expert kernels are [L, E, in, out]: channel axis still last).
     """
     def walk(tree, path=()):
-        if isinstance(tree, dict) and not is_quantized(tree):
+        if isinstance(tree, dict):
             return {k: walk(v, path + (k,)) for k, v in tree.items()}
         name = path[-1] if path else ""
         if name == "kernel":

@@ -57,6 +57,13 @@ class PipelineRunner:
         self.config = config
         self.max_seq = max_seq
         self.dtype = dtype
+        # inference compute dtype applies to the WEIGHTS too (the decode
+        # bottleneck is streaming them), exactly as DecodeEngine casts —
+        # dtype only sizing the KV cache would silently leave fp32
+        # matmuls behind a bf16 label.
+        params = jax.tree.map(
+            lambda x: x.astype(dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
         # make_stage_specs already enforces disjoint+exhaustive coverage;
         # validate_specs exists for externally supplied spec lists.
         self.specs = P.make_stage_specs(config.n_layer, boundaries)

@@ -121,23 +121,29 @@ def create_app(cfg: Optional[ServingConfig] = None,
             "MoE models serve with DISPATCH=local")
     runner = None
     if cfg.shard_role == "coordinator" and cfg.dispatch == "local":
+        import jax.numpy as _jnp
+        dtype = {"float32": _jnp.float32, "bfloat16": _jnp.bfloat16,
+                 "int8": "int8"}[cfg.inference_dtype]
         if is_moe:
             # MoE blocks aren't partitionable by the dense stage extractor;
             # the whole model decodes as one program on the pod's devices.
             from ..runtime.engine import DecodeEngine
-            runner = DecodeEngine(params, config, max_seq=cfg.max_seq)
-        elif cfg.max_batch > 1:
+            runner = DecodeEngine(params, config, max_seq=cfg.max_seq,
+                                  dtype=dtype)
+        elif cfg.max_batch > 1 or cfg.inference_dtype == "int8":
             # Continuous batching multiplexes concurrent requests onto
-            # shared ragged batched decodes (runtime.batcher). It rides
-            # the staged DecodeEngine (single program per phase, ragged
-            # support); the per-device PipelineRunner stays the
-            # single-stream serving path.
+            # shared ragged batched decodes (runtime.batcher), riding the
+            # staged DecodeEngine (single program per phase, ragged +
+            # int8 support); int8 also needs the engine (the per-device
+            # PipelineRunner casts float dtypes but doesn't quantize).
+            # The PipelineRunner stays the plain single-stream path.
             from ..runtime.engine import DecodeEngine
             runner = DecodeEngine(params, config, max_seq=cfg.max_seq,
-                                  boundaries=list(cfg.boundaries))
+                                  boundaries=list(cfg.boundaries),
+                                  dtype=dtype)
         else:
             runner = PipelineRunner(params, config, list(cfg.boundaries),
-                                    max_seq=cfg.max_seq)
+                                    max_seq=cfg.max_seq, dtype=dtype)
         if cfg.max_batch > 1:
             from ..runtime.batcher import BatchingEngine
             runner = BatchingEngine(runner, max_batch=cfg.max_batch,
@@ -170,6 +176,7 @@ def create_app(cfg: Optional[ServingConfig] = None,
             "n_stages": len(cfg.boundaries) + 1,
             "dispatch": cfg.dispatch,
             "max_batch": cfg.max_batch,
+            "inference_dtype": cfg.inference_dtype,
             "devices": [str(d) for d in jax.devices()],
         }
 

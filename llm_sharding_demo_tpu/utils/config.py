@@ -44,6 +44,10 @@ class ServingConfig:
     # reference's one-at-a-time behavior).
     max_batch: int = 1
     batch_wait_ms: float = 5.0
+    # Serving compute dtype: "float32" (greedy-parity mode, default),
+    # "bfloat16" (fast), "int8" (weight-only quantized fast path —
+    # generations may diverge from fp32 within quantization error).
+    inference_dtype: str = "float32"
 
     def __post_init__(self):
         if self.shard_role not in VALID_ROLES:
@@ -65,6 +69,10 @@ class ServingConfig:
         if self.batch_wait_ms < 0:
             raise ValueError(
                 f"BATCH_WAIT_MS={self.batch_wait_ms} must be >= 0")
+        if self.inference_dtype not in ("float32", "bfloat16", "int8"):
+            raise ValueError(
+                f"INFERENCE_DTYPE={self.inference_dtype!r} not "
+                "float32|bfloat16|int8")
 
     @property
     def split_at(self) -> int:
@@ -125,4 +133,5 @@ def from_env() -> ServingConfig:
         dispatch=os.environ.get("DISPATCH", "local"),
         max_batch=_env_int("MAX_BATCH", 1),
         batch_wait_ms=float(os.environ.get("BATCH_WAIT_MS", "5.0")),
+        inference_dtype=os.environ.get("INFERENCE_DTYPE", "float32"),
     )
