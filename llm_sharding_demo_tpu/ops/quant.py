@@ -92,6 +92,17 @@ def is_quantized(leaf) -> bool:
     return isinstance(leaf, QuantizedTensor)
 
 
+def reject_raw_int8(dtype) -> None:
+    """Guard for cast-only runners: ``astype(int8)`` would TRUNCATE
+    floats to garbage integers, not quantize. Shared so every runner
+    that merely casts (pipeline, ppdecode) raises the same error."""
+    if dtype == "int8" or dtype == jnp.int8:
+        raise ValueError(
+            "weight-only int8 quantization lives in runtime.engine."
+            "DecodeEngine (an astype here would truncate floats to "
+            "garbage integers, not quantize)")
+
+
 def dequantize_array(qleaf: QuantizedTensor, dtype=jnp.float32) -> jnp.ndarray:
     """Materialize the float kernel (tests / debugging only — the compute
     paths never call this on full weights, that would defeat the point).

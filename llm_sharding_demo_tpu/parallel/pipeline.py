@@ -57,6 +57,8 @@ class PipelineRunner:
         self.config = config
         self.max_seq = max_seq
         self.dtype = dtype
+        from ..ops.quant import reject_raw_int8
+        reject_raw_int8(dtype)
         # inference compute dtype applies to the WEIGHTS too (the decode
         # bottleneck is streaming them), exactly as DecodeEngine casts —
         # dtype only sizing the KV cache would silently leave fp32

@@ -71,6 +71,8 @@ class PipelinedDecoder:
                 f"n_stages={self.n_stages} (stage-major stacking)")
         self.per_stage = config.n_layer // self.n_stages
 
+        from ..ops.quant import reject_raw_int8
+        reject_raw_int8(dtype)
         cast = lambda x: (x.astype(dtype)
                           if jnp.issubdtype(x.dtype, jnp.floating) else x)
         params = jax.tree.map(cast, params)

@@ -21,9 +21,11 @@ Token selection modes mirror the reference:
   §2.3.4: cross-framework RNG parity is impossible; we mirror the
   distribution math).
 
-Batching is a leading batch dim; prompts in a batch share one length
-(per-sequence lengths + padding masks are a planned extension; the
-reference hardcodes batch=1, server.py:137).
+Batching is a leading batch dim; unequal-length prompts left-pad into a
+rectangle with per-row position offsets and key masks (``left_pad`` /
+``prepare_generate`` — the reference hardcodes batch=1, server.py:137),
+and ``runtime.batcher`` multiplexes concurrent serving requests onto
+these batched decodes.
 """
 
 from __future__ import annotations

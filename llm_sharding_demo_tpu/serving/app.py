@@ -119,11 +119,20 @@ def create_app(cfg: Optional[ServingConfig] = None,
         raise ValueError(
             "DISPATCH=remote requires the dense stage-shard topology; "
             "MoE models serve with DISPATCH=local")
+    if cfg.inference_dtype != "float32" and not (
+            cfg.shard_role == "coordinator" and cfg.dispatch == "local"):
+        # only the local decode runner implements the fast dtypes; a
+        # silently-ignored knob with /healthz still reporting it would
+        # tell monitoring the fleet is quantized when it is not
+        raise ValueError(
+            f"INFERENCE_DTYPE={cfg.inference_dtype} applies to the "
+            "coordinator's local decode path only; shard/remote roles "
+            "serve the fp32 parity endpoints")
     runner = None
     if cfg.shard_role == "coordinator" and cfg.dispatch == "local":
-        import jax.numpy as _jnp
-        dtype = {"float32": _jnp.float32, "bfloat16": _jnp.bfloat16,
-                 "int8": "int8"}[cfg.inference_dtype]
+        # the validated dtype name passes straight through: astype/zeros
+        # accept dtype strings and the engine branches on "int8" itself
+        dtype = cfg.inference_dtype
         if is_moe:
             # MoE blocks aren't partitionable by the dense stage extractor;
             # the whole model decodes as one program on the pod's devices.

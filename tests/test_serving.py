@@ -131,6 +131,11 @@ def test_inference_dtype_paths(model):
         assert isinstance(r.json()["generated"], str)
     with pytest.raises(ValueError, match="INFERENCE_DTYPE"):
         ServingConfig(model_id="t", inference_dtype="fp8")
+    # fast dtypes only exist on the coordinator's local decode path;
+    # other roles must refuse at startup rather than report a dtype
+    # they silently ignore
+    with pytest.raises(ValueError, match="local decode path"):
+        make_client(model, "a", inference_dtype="int8")
 
 
 def test_pipeline_runner_casts_weights_to_dtype(model):
