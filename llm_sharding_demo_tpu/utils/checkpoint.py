@@ -32,6 +32,7 @@ from ..parallel import partition as P_
 
 CONFIG_FILE = "config.json"
 PARAMS_DIR = "params"
+TRAIN_DIR = "train_state"
 
 
 def _config_family(config: GPT2Config) -> str:
@@ -75,6 +76,53 @@ def load(directory: str) -> Tuple[GPT2Config, Params]:
     ckptr = ocp.PyTreeCheckpointer()
     params = ckptr.restore(os.path.join(directory, PARAMS_DIR))
     return config, params
+
+
+def save_train_state(directory: str, params: Params, opt_state: Any,
+                     step: int) -> None:
+    """Mid-training snapshot: params + optimizer moments + step counter.
+
+    A crashed/preempted training job resumes bit-exactly — Adam moments
+    and the schedule position (optax's counter inside ``opt_state``) are
+    part of the trajectory, so restarting from params alone would change
+    every subsequent update. ``step`` is caller bookkeeping (data/loop
+    position), saved alongside but not consulted by the optimizer. Lives
+    under ``<dir>/train_state`` beside the serving layout.
+    """
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    payload = {"params": params, "opt_state": opt_state,
+               "step": jax.numpy.asarray(step)}
+    ocp.PyTreeCheckpointer().save(
+        os.path.join(directory, TRAIN_DIR), payload, force=True)
+
+
+def load_train_state(directory: str, params_template: Params,
+                     opt_state_template: Any) -> Tuple[Params, Any, int]:
+    """Restore a ``save_train_state`` snapshot as ``(params, opt_state,
+    step)``.
+
+    Orbax serializes pytree STRUCTURE loosely (optax states are nested
+    NamedTuples that round-trip as plain containers), so callers pass
+    templates — typically a fresh ``TrainStep.init(...)`` result — and
+    the restore maps leaves back onto the exact optimizer-state classes.
+    ``restore_args`` built from the templates make leaves restore
+    directly into the RESUMING job's shardings; without them orbax reads
+    device layouts from the checkpoint file, which it itself flags as
+    unsafe when the resumed pod's topology differs from the saver's —
+    the exact preemption-resume case this function exists for.
+
+    ``step`` is loop/data-position bookkeeping for the caller; the LR
+    schedule's own position is optax state inside ``opt_state`` and
+    restores with it regardless of this value.
+    """
+    directory = os.path.abspath(directory)
+    template = {"params": params_template, "opt_state": opt_state_template,
+                "step": jax.numpy.asarray(0)}
+    restored = ocp.PyTreeCheckpointer().restore(
+        os.path.join(directory, TRAIN_DIR), item=template,
+        restore_args=ocp.checkpoint_utils.construct_restore_args(template))
+    return restored["params"], restored["opt_state"], int(restored["step"])
 
 
 def load_stage_params(directory: str, spec: P_.StageSpec,
