@@ -152,6 +152,10 @@ def _two_point(runner, prompt, s_a: int = STEPS_A, s_b: int = STEPS_B) -> dict:
     }
     if degraded:
         out["degraded_timing"] = True
+    if rb.verify_steps is not None:  # speculative runner: acceptance stats
+        out["verify_steps"] = rb.verify_steps
+        out["accepted_tokens_per_verify"] = round(
+            rb.new_tokens / rb.verify_steps, 2)
     return out
 
 
@@ -251,6 +255,52 @@ def measure_moe(prompt_len: int, batch: int = 1,
     prompt = np.random.default_rng(0).integers(
         0, config.vocab_size, size=(batch, prompt_len))
     return _two_point(engine, prompt)
+
+
+def measure_spec_decode(config, prompt_len: int,
+                        dtype_name: str = "bfloat16", draft_len: int = 6,
+                        s_b: int = STEPS_B) -> dict:
+    """Prompt-lookup speculative decode vs the plain engine, same weights.
+
+    Greedy speculation is token-exact (runtime.spec_decode), so this is a
+    pure latency measurement: tokens/sec of the verify-loop program vs the
+    one-token-per-forward scan, plus the realized acceptance (tokens per
+    verify forward). Greedy decode from a random prompt settles into a
+    repetition loop — the favorable case for lookup drafting; the row
+    reports acceptance so the speedup can be read in context (worst case,
+    zero acceptance, speculation degrades toward the K+1-token forward
+    cost per token)."""
+    import jax
+    import jax.numpy as jnp
+
+    from llm_sharding_demo_tpu.models import gpt2
+    from llm_sharding_demo_tpu.runtime.engine import DecodeEngine
+    from llm_sharding_demo_tpu.runtime.spec_decode import SpecDecodeEngine
+
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+             "int8": "int8"}[dtype_name]
+    params = gpt2.init_params(config, jax.random.PRNGKey(0))
+    max_seq = min(prompt_len + s_b + draft_len, config.n_positions)
+    spec = SpecDecodeEngine(params, config, max_seq=max_seq, dtype=dtype,
+                            draft_len=draft_len)
+    plain = DecodeEngine(params, config, max_seq=max_seq, dtype=dtype)
+    prompt = np.random.default_rng(0).integers(
+        0, config.vocab_size, size=(1, prompt_len))
+
+    spec_out = _two_point(spec, prompt, s_b=s_b)      # shared harness:
+    plain_out = _two_point(plain, prompt, s_b=s_b)    # degraded fallback etc.
+    out = {
+        "spec_tokens_per_sec": spec_out["tokens_per_sec"],
+        "plain_tokens_per_sec": plain_out["tokens_per_sec"],
+        "verify_steps": spec_out["verify_steps"],
+        "accepted_tokens_per_verify": spec_out["accepted_tokens_per_verify"],
+        "draft_len": draft_len,
+        "speedup": round(
+            spec_out["tokens_per_sec"] / plain_out["tokens_per_sec"], 2),
+    }
+    if spec_out.get("degraded_timing") or plain_out.get("degraded_timing"):
+        out["degraded_timing"] = True
+    return out
 
 
 def measure_flash_attention(seq_lens=(1024, 2048, 4096), iters: int = 0,
@@ -555,6 +605,26 @@ def main() -> None:
                 "the weight-only int8 row (router+experts+wte quantized); "
                 "reference has no MoE — anchor is the dense 124M CPU loop",
     })
+
+    # cfg8 (beyond the BASELINE matrix): speculative decoding — greedy
+    # token-exact prompt-lookup speculation vs the plain engine.
+    sd = measure_spec_decode(g124, PROMPT_LEN, "bfloat16")
+    row8 = {
+        "name": "cfg8_speculative_decode_124m",
+        "tokens_per_sec": round(sd["spec_tokens_per_sec"], 2),
+        "plain_tokens_per_sec": round(sd["plain_tokens_per_sec"], 2),
+        "speedup_vs_plain": sd["speedup"],
+        "accepted_tokens_per_verify": sd["accepted_tokens_per_verify"],
+        "draft_len": sd["draft_len"],
+        "ref_cpu_tokens_per_sec": round(ref_124, 2),
+        "vs_baseline": round(sd["spec_tokens_per_sec"] / ref_124, 2),
+        "note": "prompt-lookup speculation (runtime.spec_decode), bf16, "
+                "greedy token-exact; acceptance column shows how repetitive "
+                "this workload's greedy continuation actually was",
+    }
+    if sd.get("degraded_timing"):
+        row8["degraded_timing"] = True
+    configs.append(row8)
 
     # cfg7: flash attention kernel vs XLA at S in {1k, 2k, 4k} — the
     # long-context hot op (no reference counterpart: its ceiling is 1024

@@ -182,6 +182,10 @@ class GenerateResult:
     new_tokens: int
     decode_steps: int
     pad: Optional[np.ndarray] = None  # [B] left-pad prefix lengths (ragged)
+    # Speculative decode only (runtime.spec_decode): number of verify
+    # forwards actually run; zero acceptance costs new_tokens - 1 verifies
+    # (the first token comes from prefill), fewer means drafts landed.
+    verify_steps: Optional[int] = None
 
     def row_tokens(self, i: int) -> np.ndarray:
         """Row i's tokens with its left-pad prefix stripped."""

@@ -1,0 +1,211 @@
+"""Speculative decoding: prompt-lookup drafts + one-program greedy verify.
+
+Latency optimization with **no reference counterpart** (the reference
+forwards one token per two HTTP round-trips, reference server.py:169-181;
+this module emits up to ``draft_len + 1`` tokens per forward). Greedy
+speculative decoding is *provably token-exact*: a draft token is kept only
+when it equals the model's own argmax at that position, so the emitted
+stream is byte-identical to plain greedy decode — the parity test pins
+this (tests/test_spec_decode.py).
+
+Why it pays on TPU: single-stream decode is HBM-bandwidth-bound — every
+step streams all weights to produce ONE token's worth of MXU work. A
+verify step forwards ``K+1`` tokens through the same weights for the same
+weight traffic, so each accepted draft is a nearly-free token. With
+prompt-lookup drafting (Saxena's "prompt lookup decoding" /
+assisted-generation n-gram variant) the draft model is the sequence
+itself — no second network:
+
+- **draft**: find the most recent previous occurrence of the last
+  ``ngram`` tokens in the sequence so far; propose the ``draft_len``
+  tokens that followed it (natural text and greedy GPT-2 output are both
+  highly repetitive, so acceptance is high exactly when decode is long);
+- **verify**: one cached forward of ``[t_last, d_1..d_K]`` at the current
+  cache offset (ops.attention.cached_attention already supports S>1
+  writes at a dynamic offset); accept the longest prefix where
+  ``d_j == argmax(logits_{j-1})``, emit one bonus token from the first
+  mismatch position;
+- **rewind**: the KV written for rejected drafts is logically dropped by
+  resetting ``KVCache.length`` (a traced scalar) — the stale slots sit
+  beyond the valid length, are masked out of attention by ``kv_length``,
+  and are physically overwritten by the next verify step's write at the
+  rewound offset.
+
+The whole generation after prefill is ONE compiled program: a
+``lax.while_loop`` whose body is draft-match (vectorized n-gram scan, no
+host work) + verify forward + buffer/cache bookkeeping. Single-stream
+(batch=1) by design: per-row acceptance counts would need per-row cache
+offsets, and speculation is a latency feature for exactly the
+single-stream case (batched throughput is served by ``runtime.batcher``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.gpt2 import GPT2Config, Params
+from .engine import DecodeEngine, GenerateResult, SamplingConfig, prepare_generate
+
+
+class SpecDecodeEngine:
+    """Greedy-only speculative decode engine (single stream).
+
+    Composes a ``DecodeEngine`` for parameter preparation (dtype cast /
+    int8 quantization / model-family dispatch) and its jitted prefill;
+    replaces the token-by-token decode scan with the verify loop above.
+
+    ``draft_len`` (K) is the speculation depth: each verify forward costs
+    one (K+1)-token step and emits 1..K+1 tokens. ``ngram`` is the match
+    width for prompt lookup (2 is the standard sweet spot: long enough to
+    avoid noise matches, short enough to fire often).
+    """
+
+    def __init__(self, params: Params, config: GPT2Config, max_seq: int,
+                 dtype=jnp.float32, draft_len: int = 6, ngram: int = 2):
+        if draft_len < 1:
+            raise ValueError("draft_len must be >= 1")
+        if ngram < 1:
+            raise ValueError("ngram must be >= 1")
+        self.draft_len = draft_len
+        self.ngram = ngram
+        # The engine owns params/cache sizing; its overflow guard also
+        # covers ours (we re-check with draft headroom in generate()).
+        self._eng = DecodeEngine(params, config, max_seq, dtype=dtype)
+        self.config = config
+        self.max_seq = max_seq
+        self._loop = jax.jit(self._loop_impl, static_argnames=("max_new",),
+                             donate_argnums=(2,))
+
+    @property
+    def plain(self) -> DecodeEngine:
+        """The wrapped plain engine (shared weights/compilations) — the
+        serving layer routes sample-mode and batched requests here."""
+        return self._eng
+
+    # -- compiled verify loop ------------------------------------------------
+
+    def _loop_impl(self, params, first_token, cache, buf, total, *,
+                   max_new: int):
+        """(buf, total, cache) after prefill -> (buf, verify_steps).
+
+        Invariant at loop entry: ``buf[:total]`` holds prompt + emitted
+        tokens, ``cache.length == total - 1`` (the last emitted token has
+        not been forwarded yet), ``emitted`` counts new tokens so far.
+        """
+        K, ngram = self.draft_len, self.ngram
+        buflen = buf.shape[0]
+        j_arr = jnp.arange(buflen, dtype=jnp.int32)
+
+        def draft(buf, total, t_last):
+            """Propose K tokens via most-recent n-gram match."""
+            last = jax.lax.dynamic_slice(buf, (total - ngram,), (ngram,))
+            match = jnp.ones((buflen,), dtype=bool)
+            for t in range(ngram):
+                match = match & (jnp.roll(buf, -t) == last[t])
+            # exclude the current occurrence itself and anything past it
+            match = match & (j_arr < total - ngram)
+            cand = jnp.where(match, j_arr, -1)
+            best = cand.max()
+            found = best >= 0
+            start = jnp.where(found, best + ngram, 0)
+            got = jax.lax.dynamic_slice(buf, (start,), (K,))
+            # fallback: repeat the last token (catches token-loop output)
+            return jnp.where(found, got, jnp.full((K,), t_last, jnp.int32))
+
+        def body(carry):
+            buf, total, cache, emitted, steps = carry
+            t_last = buf[total - 1]
+            drafts = draft(buf, total, t_last)
+            x = jnp.concatenate([t_last[None], drafts])[None, :]  # [1, K+1]
+            logits, cache = self._eng._forward_cached(params, x, cache, None)
+            greedy = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)  # [K+1]
+            # greedy[j] is the model's token after x[j]; drafts[j] == x[j+1]
+            hits = (drafts == greedy[:K]).astype(jnp.int32)
+            n_accept = jnp.cumprod(hits).sum()           # leading matches
+            n_emit = jnp.minimum(n_accept + 1, max_new - emitted)
+            # splice the emitted prefix of `greedy` into buf at `total`
+            # (greedy[:n_accept] == drafts[:n_accept], then one bonus token)
+            old = jax.lax.dynamic_slice(buf, (total,), (K + 1,))
+            patch = jnp.where(jnp.arange(K + 1) < n_emit, greedy, old)
+            buf = jax.lax.dynamic_update_slice(buf, patch, (total,))
+            # rewind: forwarded-and-kept = t_last + the accepted prefix;
+            # slots beyond are stale and masked by kv_length until the
+            # next verify overwrites them at the rewound offset
+            cache = cache._replace(
+                length=(total - 1 + n_emit).astype(jnp.int32))
+            return (buf, total + n_emit, cache, emitted + n_emit, steps + 1)
+
+        def cond(carry):
+            return carry[3] < max_new
+
+        first = first_token.reshape(()).astype(jnp.int32)
+        buf = jax.lax.dynamic_update_slice(buf, first[None], (total,))
+        carry = (buf, total + 1, cache, jnp.int32(1), jnp.int32(0))
+        buf, _, cache, _, steps = jax.lax.while_loop(cond, body, carry)
+        return buf, steps, cache
+
+    # -- public API ----------------------------------------------------------
+
+    def generate(self, prompt_ids, max_new_tokens: int,
+                 sampling: SamplingConfig = SamplingConfig(),
+                 key: Optional[jax.Array] = None) -> GenerateResult:
+        """Greedy generate, token-exact vs ``DecodeEngine.generate``.
+
+        Rejects batches (speculation is single-stream) and sample mode
+        (draft acceptance under sampling needs rejection-sampling to stay
+        distribution-exact; greedy is the BASELINE.json parity mode).
+        """
+        if sampling.mode != "greedy":
+            raise NotImplementedError(
+                "speculative decoding is greedy-only: acceptance compares "
+                "drafts against the model argmax; distribution-exact "
+                "sampled speculation (rejection sampling) is not built")
+        ids, batch, prompt_len, key, pad = prepare_generate(
+            prompt_ids, max_new_tokens, self.max_seq, sampling, key,
+            allow_ragged=False)
+        if batch != 1:
+            raise ValueError("speculative decoding is single-stream "
+                             "(batch=1); batched throughput goes through "
+                             "DecodeEngine / runtime.batcher")
+        if prompt_len < self.ngram:
+            raise ValueError(
+                f"prompt_len={prompt_len} shorter than ngram={self.ngram}")
+        # Verify steps write up to draft_len tokens past the final length,
+        # so the cache/position headroom check is stricter than the
+        # engine's prompt+new <= max_seq guard.
+        total_max = prompt_len + max_new_tokens + self.draft_len
+        if total_max > self.max_seq:
+            raise ValueError(
+                f"prompt_len + max_new_tokens + draft_len = {total_max} "
+                f"exceeds max_seq={self.max_seq}; verify writes need "
+                "draft_len slots of headroom")
+
+        ids_j = jnp.asarray(ids, dtype=jnp.int32)
+        run_params = self._eng._run_params()
+
+        t0 = time.perf_counter()
+        last_logits, cache = self._eng._prefill(run_params, ids_j, None)
+        first = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+        first.block_until_ready()
+        t1 = time.perf_counter()
+
+        buf = jnp.zeros((self.max_seq + self.draft_len + 1,), jnp.int32)
+        buf = jax.lax.dynamic_update_slice(buf, ids_j[0], (0,))
+        buf, steps, _ = self._loop(run_params, first[0], cache, buf,
+                                   jnp.int32(prompt_len),
+                                   max_new=max_new_tokens)
+        buf = np.asarray(jax.block_until_ready(buf))
+        t2 = time.perf_counter()
+
+        tokens = buf[None, :prompt_len + max_new_tokens]
+        return GenerateResult(tokens=tokens, prompt_len=prompt_len,
+                              prefill_seconds=t1 - t0,
+                              decode_seconds=t2 - t1,
+                              new_tokens=max_new_tokens,
+                              decode_steps=max_new_tokens - 1,
+                              verify_steps=int(steps))

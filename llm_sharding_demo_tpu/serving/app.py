@@ -128,17 +128,47 @@ def create_app(cfg: Optional[ServingConfig] = None,
             f"INFERENCE_DTYPE={cfg.inference_dtype} applies to the "
             "coordinator's local decode path only; shard/remote roles "
             "serve the fp32 parity endpoints")
+    if cfg.spec_decode > 0 and not (
+            cfg.shard_role == "coordinator" and cfg.dispatch == "local"):
+        raise ValueError(
+            f"SPEC_DECODE={cfg.spec_decode} applies to the coordinator's "
+            "local decode path only")
+    if cfg.spec_decode > 0 and cfg.max_batch > 1:
+        raise ValueError(
+            "SPEC_DECODE and MAX_BATCH>1 are mutually exclusive: "
+            "speculation is a single-stream latency feature, continuous "
+            "batching a multi-stream throughput one")
     runner = None
+    spec_runner = None
+    # What /healthz reports as n_stages: the decode topology actually
+    # serving /generate, not just the configured partition — a monitoring
+    # read of "3 stages" while an unstaged engine answers requests is the
+    # same silent-knob misreport the INFERENCE_DTYPE guard above refuses.
+    decode_stages = len(cfg.boundaries) + 1
     if cfg.shard_role == "coordinator" and cfg.dispatch == "local":
         # the validated dtype name passes straight through: astype/zeros
         # accept dtype strings and the engine branches on "int8" itself
         dtype = cfg.inference_dtype
-        if is_moe:
+        if cfg.spec_decode > 0:
+            # prompt-lookup speculation (runtime.spec_decode): greedy
+            # single-stream requests emit up to draft_len+1 tokens per
+            # forward, token-exact; sample-mode requests fall through to
+            # the wrapped plain engine (same weights, no duplication).
+            # The spec engine decodes unstaged (one program, one device
+            # group) — reflected in decode_stages below.
+            from ..runtime.spec_decode import SpecDecodeEngine
+            spec_runner = SpecDecodeEngine(params, config,
+                                           max_seq=cfg.max_seq, dtype=dtype,
+                                           draft_len=cfg.spec_decode)
+            runner = spec_runner.plain
+            decode_stages = 1
+        elif is_moe:
             # MoE blocks aren't partitionable by the dense stage extractor;
             # the whole model decodes as one program on the pod's devices.
             from ..runtime.engine import DecodeEngine
             runner = DecodeEngine(params, config, max_seq=cfg.max_seq,
                                   dtype=dtype)
+            decode_stages = 1  # MoE decodes unstaged (no dense partition)
         elif cfg.max_batch > 1 or cfg.inference_dtype == "int8":
             # Continuous batching multiplexes concurrent requests onto
             # shared ragged batched decodes (runtime.batcher), riding the
@@ -182,10 +212,11 @@ def create_app(cfg: Optional[ServingConfig] = None,
             "status": "ok",
             "role": cfg.shard_role,
             "model": cfg.model_id,
-            "n_stages": len(cfg.boundaries) + 1,
+            "n_stages": decode_stages,
             "dispatch": cfg.dispatch,
             "max_batch": cfg.max_batch,
             "inference_dtype": cfg.inference_dtype,
+            "spec_decode": cfg.spec_decode,
             "devices": [str(d) for d in jax.devices()],
         }
 
@@ -220,10 +251,20 @@ def create_app(cfg: Optional[ServingConfig] = None,
                                         top_k=req.top_k))
         seed = req.seed if req.seed is not None else int(
             np.random.default_rng().integers(2 ** 31))
-        result = runner.generate(np.asarray(prompt_ids),
-                                 max_new_tokens=req.max_new_tokens,
-                                 sampling=sampling,
-                                 key=jax.random.PRNGKey(seed))
+        # Speculation serves only the requests it is exact and safe for:
+        # greedy mode, prompt at least ngram long, and draft_len slots of
+        # cache headroom left. Everything else uses the plain engine —
+        # same weights, same tokens, just one token per forward.
+        eng = runner
+        if (spec_runner is not None and sampling.mode == "greedy"
+                and len(prompt_ids) >= spec_runner.ngram
+                and (len(prompt_ids) + req.max_new_tokens
+                     + spec_runner.draft_len) <= cfg.max_seq):
+            eng = spec_runner
+        result = eng.generate(np.asarray(prompt_ids),
+                              max_new_tokens=req.max_new_tokens,
+                              sampling=sampling,
+                              key=jax.random.PRNGKey(seed))
         return [int(t) for t in result.tokens[0]]
 
     def _relay(shard: str, url: str, payload: dict, key: str):

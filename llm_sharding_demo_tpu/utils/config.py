@@ -48,6 +48,11 @@ class ServingConfig:
     # "bfloat16" (fast), "int8" (weight-only quantized fast path —
     # generations may diverge from fp32 within quantization error).
     inference_dtype: str = "float32"
+    # Speculative decoding (runtime.spec_decode): >0 enables prompt-lookup
+    # speculation with this draft depth for single-stream greedy /generate
+    # requests (token-exact; sample-mode requests use the plain engine).
+    # 0 = off.
+    spec_decode: int = 0
 
     def __post_init__(self):
         if self.shard_role not in VALID_ROLES:
@@ -73,6 +78,10 @@ class ServingConfig:
             raise ValueError(
                 f"INFERENCE_DTYPE={self.inference_dtype!r} not "
                 "float32|bfloat16|int8")
+        if self.spec_decode < 0:
+            raise ValueError(
+                f"SPEC_DECODE={self.spec_decode} must be >= 0 "
+                "(0 disables, >0 is the speculation draft depth)")
 
     @property
     def split_at(self) -> int:
@@ -134,4 +143,5 @@ def from_env() -> ServingConfig:
         max_batch=_env_int("MAX_BATCH", 1),
         batch_wait_ms=float(os.environ.get("BATCH_WAIT_MS", "5.0")),
         inference_dtype=os.environ.get("INFERENCE_DTYPE", "float32"),
+        spec_decode=_env_int("SPEC_DECODE", 0),
     )

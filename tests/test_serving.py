@@ -165,3 +165,22 @@ def test_config_validation():
                          vocab_size=16, n_positions=8, n_embd=4,
                          n_layer=2, n_head=2), jax.random.PRNGKey(0))),
                     "coordinator", boundaries=(99,))
+
+
+def test_spec_decode_serving(model):
+    """SPEC_DECODE>0: greedy /generate routes through speculation and
+    matches the plain engine's output; sample mode still works (plain
+    path); misconfigured roles refuse at startup."""
+    spec = make_client(model, "coordinator", spec_decode=4)
+    assert spec.get("/healthz").json()["spec_decode"] == 4
+    plain = make_client(model, "coordinator")
+    body = {"prompt": "Hi, Hi, Hi, ", "max_new_tokens": 8, "mode": "greedy"}
+    assert spec.post("/generate", json=body).json() == \
+        plain.post("/generate", json=body).json()
+    sampled = spec.post("/generate", json={"prompt": "abc", "seed": 3,
+                                           "max_new_tokens": 4})
+    assert sampled.status_code == 200
+    with pytest.raises(ValueError, match="local decode path"):
+        make_client(model, "a", spec_decode=4)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        make_client(model, "coordinator", spec_decode=4, max_batch=4)

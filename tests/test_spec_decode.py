@@ -1,0 +1,95 @@
+"""Speculative decoding tests: greedy token-exactness vs the plain engine,
+actual draft acceptance on repetitive text, guards, and dtype paths.
+
+Greedy speculation is exact by construction (a draft survives only when it
+equals the model argmax); these tests pin the implementation to that
+property rather than trusting the construction.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_sharding_demo_tpu.models import gpt2
+from llm_sharding_demo_tpu.runtime.engine import DecodeEngine, SamplingConfig
+from llm_sharding_demo_tpu.runtime.spec_decode import SpecDecodeEngine
+
+CFG = gpt2.GPT2Config(vocab_size=97, n_positions=128, n_embd=32,
+                      n_layer=2, n_head=4)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt2.init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def plain(params):
+    return DecodeEngine(params, CFG, max_seq=128)
+
+
+def test_spec_matches_plain_greedy(params, plain):
+    """Random prompts, several speculation depths: streams byte-identical."""
+    rng = np.random.default_rng(0)
+    for i, (draft_len, ngram) in enumerate([(4, 2), (6, 2), (1, 1), (8, 3)]):
+        spec = SpecDecodeEngine(params, CFG, max_seq=128,
+                                draft_len=draft_len, ngram=ngram)
+        prompt = rng.integers(0, CFG.vocab_size, size=(9 + i,))
+        want = plain.generate(prompt, max_new_tokens=25).tokens
+        got = spec.generate(prompt, max_new_tokens=25).tokens
+        np.testing.assert_array_equal(got, want)
+
+
+def test_spec_accepts_on_repetitive_prompt(params, plain):
+    """A repeating prompt must yield accepted drafts: fewer verify forwards
+    than tokens (otherwise 'speculation' is just a slower greedy loop)."""
+    period = [5, 17, 3, 42]
+    prompt = np.asarray(period * 6, dtype=np.int32)  # 24 tokens, period 4
+    spec = SpecDecodeEngine(params, CFG, max_seq=128, draft_len=6)
+    got = spec.generate(prompt, max_new_tokens=30)
+    want = plain.generate(prompt, max_new_tokens=30).tokens
+    np.testing.assert_array_equal(got.tokens, want)
+    # Zero acceptance would take exactly 29 verifies (the first token comes
+    # from prefill), so the bound must be strictly below 29 — and a
+    # repetitive prompt should do far better than one-below.
+    assert got.verify_steps is not None and got.verify_steps <= 24, (
+        f"speculation barely accepted: {got.verify_steps} verifies for 30 "
+        "tokens (29 = zero acceptance)")
+
+
+def test_spec_single_token_and_exact_budget(params, plain):
+    """max_new_tokens=1 (no verify loop at all) and a budget that ends
+    mid-acceptance both stop at exactly max_new tokens."""
+    prompt = np.arange(10, dtype=np.int32) % CFG.vocab_size
+    spec = SpecDecodeEngine(params, CFG, max_seq=128, draft_len=5)
+    for n in (1, 2, 7):
+        got = spec.generate(prompt, max_new_tokens=n)
+        want = plain.generate(prompt, max_new_tokens=n).tokens
+        assert got.tokens.shape == (1, 10 + n)
+        np.testing.assert_array_equal(got.tokens, want)
+
+
+def test_spec_bf16_matches_bf16_plain(params):
+    """Exactness holds per-dtype: bf16 spec ≡ bf16 plain greedy."""
+    spec = SpecDecodeEngine(params, CFG, max_seq=128, dtype=jnp.bfloat16)
+    plain16 = DecodeEngine(params, CFG, max_seq=128, dtype=jnp.bfloat16)
+    prompt = (np.arange(12, dtype=np.int32) * 7) % CFG.vocab_size
+    got = spec.generate(prompt, max_new_tokens=20).tokens
+    want = plain16.generate(prompt, max_new_tokens=20).tokens
+    np.testing.assert_array_equal(got, want)
+
+
+def test_spec_guards(params):
+    spec = SpecDecodeEngine(params, CFG, max_seq=64, draft_len=4)
+    prompt = np.arange(8, dtype=np.int32)
+    with pytest.raises(NotImplementedError, match="greedy-only"):
+        spec.generate(prompt, 5, sampling=SamplingConfig(mode="sample"),
+                      key=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="single-stream"):
+        spec.generate(np.stack([prompt, prompt]), 5)
+    with pytest.raises(ValueError, match="headroom"):
+        spec.generate(prompt, 64 - 8)  # fits max_seq but not + draft_len
+    with pytest.raises(ValueError, match="shorter than ngram"):
+        SpecDecodeEngine(params, CFG, max_seq=64, ngram=3).generate(
+            np.arange(2, dtype=np.int32), 5)
