@@ -92,7 +92,15 @@ def create_app(cfg: Optional[ServingConfig] = None,
     # COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID contract is unset.
     from ..parallel.distributed import maybe_initialize
     maybe_initialize()
-    config, params = model if model is not None else loader.resolve_model(cfg)
+    # Role-aware loading: shard pods with a checkpoint partial-restore only
+    # their stage's layers (utils.checkpoint.load_stage_params); a
+    # remote-dispatch coordinator reads config only. ``params`` is None in
+    # those cases and ``stage_only`` holds a shard role's subset.
+    if model is not None:
+        config, params = model
+        stage_only = None
+    else:
+        config, params, stage_only = loader.resolve_for_role(cfg)
     tokenizer = tokenizer or get_tokenizer(cfg.model_id,
                                            checkpoint_dir=cfg.checkpoint_dir)
 
@@ -192,7 +200,8 @@ def create_app(cfg: Optional[ServingConfig] = None,
     else:
         compat_specs = P_.make_stage_specs(n_layer, [cfg.split_at])
         compat_params = {
-            role: (P_.extract_stage_params(params, compat_specs[i])
+            role: ((stage_only if stage_only is not None
+                    else P_.extract_stage_params(params, compat_specs[i]))
                    if cfg.shard_role == role else None)
             for i, role in enumerate(("a", "b"))
         }

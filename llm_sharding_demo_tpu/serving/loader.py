@@ -17,7 +17,7 @@ in every pod (reference server.py:40-42) with an explicit resolution order:
 from __future__ import annotations
 
 import logging
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 
@@ -27,6 +27,48 @@ from ..utils import checkpoint as ckpt
 from ..utils.config import ServingConfig
 
 log = logging.getLogger(__name__)
+
+
+def resolve_for_role(cfg: ServingConfig,
+                     ) -> Tuple[GPT2Config, Optional[Params],
+                                Optional[Params]]:
+    """Role-aware resolution: ``(config, full_params, stage_params)`` —
+    load only what this role actually serves (the reference loads the full
+    model into every pod regardless of role, server.py:40-42, 108-110).
+
+    - shard ``a``/``b`` with a dense checkpoint: TRUE partial restore of
+      just that role's two-stage compat subset (``ckpt.load_stage_params``
+      reads only those layers' bytes) → ``(config, None, stage)``;
+    - coordinator with ``DISPATCH=remote`` and a checkpoint: the weights
+      live in the shard pods; only the config is read →
+      ``(config, None, None)``;
+    - everything else (coordinator+local, or no checkpoint — the HF/
+      random-init fallbacks produce a full tree anyway) →
+      ``(config, params, None)``.
+    """
+    if cfg.checkpoint_dir:
+        if cfg.shard_role in ("a", "b"):
+            config = ckpt.load_config(cfg.checkpoint_dir)
+            from ..models.moe import MoEConfig
+            if isinstance(config, MoEConfig):
+                # MoE stage endpoints decline every request (app.py), so
+                # an MoE shard pod needs no weights at all — config only
+                return config, None, None
+            from ..parallel import partition as P_
+            specs = P_.make_stage_specs(config.n_layer, [cfg.split_at])
+            idx = 0 if cfg.shard_role == "a" else 1
+            log.info("partial-restoring stage %s (blocks [%d, %d)) "
+                     "from %s", cfg.shard_role, specs[idx].start,
+                     specs[idx].end, cfg.checkpoint_dir)
+            config, stage = ckpt.load_stage_params(
+                cfg.checkpoint_dir, specs[idx])
+            return config, None, stage
+        elif cfg.shard_role == "coordinator" and cfg.dispatch == "remote":
+            log.info("remote-dispatch coordinator: config only from %s",
+                     cfg.checkpoint_dir)
+            return ckpt.load_config(cfg.checkpoint_dir), None, None
+    config, params = resolve_model(cfg)
+    return config, params, None
 
 
 def hub_reachable(timeout: float = 1.0) -> bool:

@@ -10,8 +10,18 @@ access and each pipeline stage can load only its own parameter subset
 
 Layout on disk::
 
-    <dir>/config.json          # GPT2Config fields
+    <dir>/config.json          # GPT2Config fields (+ "family" tag)
     <dir>/params/              # Orbax PyTreeCheckpointer payload
+
+In memory the block stack is ``[n_layer, ...]`` leaves (the ``lax.scan``
+layout, models.gpt2.apply_blocks); on disk each layer is its own subtree
+(``blocks/{i}/...``) so a pipeline-stage restore reads ONLY its layers'
+bytes from storage (``load_stage_params`` — Orbax partial restore via
+``transforms={}``). Round-1 review flagged the old stacked layout for
+pulling the whole model through host RAM per stage pod; per-layer
+storage is what makes the partial read possible at all, since Orbax
+can skip whole arrays but not slice inside one. Pre-existing stacked
+checkpoints still load (structural detection + full-read fallback).
 
 Training state (params + optimizer + step counter) uses the same
 mechanism under ``<dir>/train_state``.
@@ -25,6 +35,7 @@ import os
 from typing import Any, Optional, Tuple
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 from ..models.gpt2 import GPT2Config, Params
@@ -33,6 +44,46 @@ from ..parallel import partition as P_
 CONFIG_FILE = "config.json"
 PARAMS_DIR = "params"
 TRAIN_DIR = "train_state"
+
+
+def _split_blocks(blocks: Params) -> dict:
+    """Stacked ``[L, ...]`` block leaves -> ``{"0": layer_tree, ...}``."""
+    n_layer = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+    return {str(i): jax.tree.map(lambda x: np.asarray(x[i]), blocks)
+            for i in range(n_layer)}
+
+
+def _stack_blocks(per_layer: dict) -> Params:
+    """``{"0": layer_tree, ...}`` -> stacked ``[L, ...]`` leaves.
+
+    Copies layer by layer into preallocated output and drops each source
+    layer as it lands, so peak host RAM is ~1x the stack plus the not-yet-
+    copied layers — not the 2x of a naive ``np.stack`` over a list that
+    keeps every source alive until the end.
+    """
+    keys = sorted(per_layer, key=int)
+    n = len(keys)
+
+    def _alloc(x):
+        out = np.empty((n,) + np.shape(x), np.asarray(x).dtype)
+        out[0] = x
+        return out
+
+    out = jax.tree.map(_alloc, per_layer[keys[0]])
+    per_layer[keys[0]] = None
+    for i, k in enumerate(keys[1:], start=1):
+        jax.tree.map(lambda dst, src, i=i: dst.__setitem__(i, src),
+                     out, per_layer[k])
+        per_layer[k] = None  # free the source layer's arrays promptly
+    return out
+
+
+def _is_per_layer(blocks) -> bool:
+    """Structural layout detection: per-layer checkpoints key blocks by
+    layer index ("0", "1", ...); the legacy stacked layout keys them by
+    module name ("attn", "ln_1", ...)."""
+    return (isinstance(blocks, dict) and bool(blocks)
+            and all(k.isdigit() for k in blocks))
 
 
 def _config_family(config: GPT2Config) -> str:
@@ -47,14 +98,17 @@ def _config_family(config: GPT2Config) -> str:
 
 
 def save(directory: str, params: Params, config: GPT2Config) -> None:
-    """Write config + params. Overwrites an existing checkpoint."""
+    """Write config + params (per-layer block layout — see module doc).
+    Overwrites an existing checkpoint."""
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
     payload = {"family": _config_family(config), **dataclasses.asdict(config)}
     with open(os.path.join(directory, CONFIG_FILE), "w") as f:
         json.dump(payload, f, indent=2)
+    on_disk = {k: v for k, v in params.items() if k != "blocks"}
+    on_disk["blocks"] = _split_blocks(params["blocks"])
     ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(os.path.join(directory, PARAMS_DIR), params, force=True)
+    ckptr.save(os.path.join(directory, PARAMS_DIR), on_disk, force=True)
 
 
 def load_config(directory: str) -> GPT2Config:
@@ -70,11 +124,16 @@ def load_config(directory: str) -> GPT2Config:
 
 
 def load(directory: str) -> Tuple[GPT2Config, Params]:
-    """Restore (config, params) from ``save``'s layout."""
+    """Restore (config, params); restacks per-layer blocks into the
+    in-memory ``[L, ...]`` scan layout. Legacy stacked checkpoints pass
+    through unchanged."""
     directory = os.path.abspath(directory)
     config = load_config(directory)
     ckptr = ocp.PyTreeCheckpointer()
     params = ckptr.restore(os.path.join(directory, PARAMS_DIR))
+    if _is_per_layer(params.get("blocks")):
+        params = dict(params)
+        params["blocks"] = _stack_blocks(params["blocks"])
     return config, params
 
 
@@ -127,13 +186,48 @@ def load_train_state(directory: str, params_template: Params,
 
 def load_stage_params(directory: str, spec: P_.StageSpec,
                       ) -> Tuple[GPT2Config, Params]:
-    """Restore only one pipeline stage's parameter subset.
+    """Restore only one pipeline stage's parameter subset — a TRUE partial
+    read: Orbax fetches just the stage's layer subtrees (plus embeddings
+    for the first stage / ln_f + the tied head table for the last), so
+    neither device nor host memory ever holds the rest of the model. This
+    is the storage-level fix for the reference quirk of every role holding
+    the full model (server.py:108-110).
 
-    Fixes the reference quirk of every role holding the full model
-    (server.py:108-110): a stage server restores the full tree then slices
-    immediately, so only the stage subset stays referenced; device memory
-    never sees the rest (host RAM does transiently — true partial-restore
-    via Orbax transforms is a later optimization).
+    Legacy stacked-layout checkpoints can't be read partially (one
+    ``[L, ...]`` array per leaf on disk); those fall back to full restore
+    + slice, as before.
     """
-    config, params = load(directory)
-    return config, P_.extract_stage_params(params, spec)
+    directory = os.path.abspath(directory)
+    path = os.path.join(directory, PARAMS_DIR)
+    ckptr = ocp.PyTreeCheckpointer()
+    disk_tree = ckptr.metadata(path).item_metadata.tree
+    if not _is_per_layer(disk_tree.get("blocks")):
+        config, params = load(directory)
+        return config, P_.extract_stage_params(params, spec)
+    config = load_config(directory)
+
+    item: dict = {"blocks": {str(i): disk_tree["blocks"][str(i)]
+                             for i in range(spec.start, spec.end)}}
+    if spec.is_first:
+        item["wte"] = disk_tree["wte"]
+        item["wpe"] = disk_tree["wpe"]
+    if spec.is_last:
+        item["ln_f"] = disk_tree["ln_f"]
+        item.setdefault("wte", disk_tree["wte"])  # tied LM head table
+    # metadata leaves are placeholders; restore_type=np.ndarray reads each
+    # array as host numpy (shape/dtype from disk) without consulting the
+    # saver's sharding file — a stage pod's topology never matches the
+    # saver's anyway. transforms={} limits the read to exactly the keys
+    # present in ``item``.
+    restore_args = jax.tree.map(
+        lambda _: ocp.RestoreArgs(restore_type=np.ndarray), item)
+    got = ckptr.restore(path, item=item, transforms={},
+                        restore_args=restore_args)
+    out: Params = {"blocks": _stack_blocks(got["blocks"])}
+    if spec.is_first:
+        out["wte"] = got["wte"]
+        out["wpe"] = got["wpe"]
+    if spec.is_last:
+        out["ln_f"] = got["ln_f"]
+        out["wte_out"] = got["wte"]
+    return config, out

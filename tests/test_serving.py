@@ -184,3 +184,32 @@ def test_spec_decode_serving(model):
         make_client(model, "a", spec_decode=4)
     with pytest.raises(ValueError, match="mutually exclusive"):
         make_client(model, "coordinator", spec_decode=4, max_batch=4)
+
+
+def test_shard_pod_partial_restores_from_checkpoint(model, tmp_path):
+    """A shard pod with CHECKPOINT_DIR loads ONLY its stage subset
+    (utils.checkpoint.load_stage_params), and its /forward output matches
+    the full-model stage composition."""
+    from llm_sharding_demo_tpu.parallel import partition as P_
+    from llm_sharding_demo_tpu.serving import loader
+    from llm_sharding_demo_tpu.utils import checkpoint as ckpt
+
+    config, params = model
+    d = str(tmp_path / "ckpt")
+    ckpt.save(d, params, config)
+
+    cfg = ServingConfig(model_id="test", shard_role="a", max_seq=64,
+                        boundaries=(2,), checkpoint_dir=d)
+    got_cfg, full, stage = loader.resolve_for_role(cfg)
+    assert got_cfg == config
+    assert full is None and stage is not None          # no full tree loaded
+    assert set(stage) == {"blocks", "wte", "wpe"}
+
+    app = create_app(cfg, tokenizer=ByteTokenizer())   # model NOT injected
+    r = TestClient(app).post("/forward", json={"input_ids": [5, 17, 33]})
+    hidden = np.asarray(r.json()["hidden_states"])
+    spec = P_.make_stage_specs(config.n_layer, [2])[0]
+    want, _ = P_.stage_apply(P_.extract_stage_params(params, spec), spec,
+                             config, np.asarray([[5, 17, 33]]))
+    np.testing.assert_allclose(hidden, np.asarray(want),
+                               atol=1e-4, rtol=1e-3)
