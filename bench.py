@@ -168,12 +168,13 @@ def measure_engine(config, prompt_len: int, batch: int,
     import jax
     import jax.numpy as jnp
 
-    from llm_sharding_demo_tpu.models import gpt2
+    from llm_sharding_demo_tpu.models import family_module
     from llm_sharding_demo_tpu.runtime.engine import DecodeEngine
 
     dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
              "int8": "int8"}[dtype_name]
-    params = gpt2.init_params(config, jax.random.PRNGKey(0))
+    mod = family_module(config)  # gpt2 or llama geometry, same harness
+    params = mod.init_params(config, jax.random.PRNGKey(0))
     engine = DecodeEngine(params, config, max_seq=prompt_len + s_b,
                           dtype=dtype)
     prompt = np.random.default_rng(0).integers(
@@ -625,6 +626,34 @@ def main() -> None:
     if sd.get("degraded_timing"):
         row8["degraded_timing"] = True
     configs.append(row8)
+
+    # cfg9 (beyond the BASELINE matrix): llama family — RoPE + GQA
+    # (n_kv_head=4 vs 12 query heads: the KV cache is 3x smaller) +
+    # SwiGLU, 124M-comparable geometry. The long-context column decodes at
+    # ~3k depth, past GPT-2's 1024-learned-position ceiling (the
+    # reference's hard limit, server.py:57) — only the llama family can
+    # run it at all.
+    from llm_sharding_demo_tpu.models import llama as llama_mod
+    lcfg = llama_mod.CONFIGS["llama-124m"]
+    ll_bf16 = measure_engine(lcfg, PROMPT_LEN, 1, "bfloat16")
+    ll_int8 = measure_engine(lcfg, PROMPT_LEN, 1, "int8")
+    ll_long = measure_engine(lcfg, 3072, 1, "bfloat16")
+    row9 = {
+        "name": "cfg9_llama_124m_gqa",
+        "tokens_per_sec": round(ll_bf16["tokens_per_sec"], 2),
+        "int8_tokens_per_sec": round(ll_int8["tokens_per_sec"], 2),
+        "long_context_tokens_per_sec": round(ll_long["tokens_per_sec"], 2),
+        "long_context_prefill_ms": round(ll_long["prefill_ms"], 1),
+        "p50_token_latency_ms": round(ll_bf16["p50_token_latency_ms"], 3),
+        "ref_cpu_tokens_per_sec": round(ref_124, 2),
+        "vs_baseline": round(ll_bf16["tokens_per_sec"] / ref_124, 2),
+        "note": "llama family (RMSNorm/RoPE/SwiGLU/GQA kv=4), bf16 + "
+                "weight-only int8 steady-state decode; long-context column "
+                "= 3072-token prompt, decode at ~3-3.5k depth — beyond the "
+                "reference's 1024-position ceiling; anchor is the dense "
+                "124M CPU loop",
+    }
+    configs.append(row9)
 
     # cfg7: flash attention kernel vs XLA at S in {1k, 2k, 4k} — the
     # long-context hot op (no reference counterpart: its ceiling is 1024

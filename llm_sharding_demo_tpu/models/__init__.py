@@ -1,0 +1,34 @@
+"""Model families and the family registry.
+
+Every family module exposes the same pure-function surface —
+``init_params`` / ``forward`` / ``forward_with_cache`` / ``make_cache``
+over a stacked-block param pytree — so the runtime (decode engine,
+speculative decoding, serving, quantization, checkpointing) dispatches on
+the config object alone via ``family_module``.
+"""
+
+from __future__ import annotations
+
+
+def family_module(config):
+    """Config dataclass -> the model module implementing it.
+
+    MoEConfig subclasses GPT2Config, so it is tested first; LlamaConfig is
+    standalone. Plain GPT2Config is the only family the dense pipeline
+    partitioner (parallel.partition) can stage.
+    """
+    from . import gpt2, llama, moe
+    if isinstance(config, moe.MoEConfig):
+        return moe
+    if isinstance(config, llama.LlamaConfig):
+        return llama
+    if isinstance(config, gpt2.GPT2Config):
+        return gpt2
+    raise TypeError(f"unknown model config type {type(config).__name__}")
+
+
+def is_partitionable(config) -> bool:
+    """True when the dense GPT-2 stage partitioner applies to ``config``."""
+    from . import gpt2, moe
+    return (isinstance(config, gpt2.GPT2Config)
+            and not isinstance(config, moe.MoEConfig))

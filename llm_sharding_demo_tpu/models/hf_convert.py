@@ -115,3 +115,102 @@ def params_from_hf_model(model: Any, dtype=jnp.float32):
     """Convenience: torch ``GPT2LMHeadModel`` instance -> (config, params)."""
     config = config_from_hf(model.config)
     return config, params_from_state_dict(model.state_dict(), config, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# LLaMA family. Unlike GPT-2's Conv1D, HF llama uses ``nn.Linear`` whose
+# weight is stored ``[out_features, in_features]`` — every matmul weight
+# below is TRANSPOSED into our [in, out] kernel layout.
+# ---------------------------------------------------------------------------
+
+def llama_config_from_hf(hf_config: Any) -> "Any":
+    """Map an HF ``LlamaConfig`` to ours; reject unimplemented semantics."""
+    from .llama import LlamaConfig
+
+    if getattr(hf_config, "tie_word_embeddings", False):
+        raise ValueError("tied llama embeddings not supported: the family "
+                         "converts a separate lm_head tensor")
+    act = getattr(hf_config, "hidden_act", "silu")
+    if act != "silu":
+        raise ValueError(f"hidden_act={act!r} not supported; the SwiGLU MLP "
+                         "hard-wires silu")
+    if getattr(hf_config, "rope_scaling", None):
+        raise ValueError("rope_scaling not supported: ops.rope implements "
+                         "plain RoPE only")
+    if getattr(hf_config, "attention_bias", False):
+        raise ValueError("attention_bias=True not supported: llama kernels "
+                         "are bias-free")
+    hd = getattr(hf_config, "head_dim", None)
+    derived = hf_config.hidden_size // hf_config.num_attention_heads
+    if hd is not None and hd != derived:
+        raise ValueError(f"explicit head_dim={hd} != hidden/heads={derived} "
+                         "not supported")
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        n_positions=hf_config.max_position_embeddings,
+        n_embd=hf_config.hidden_size,
+        n_layer=hf_config.num_hidden_layers,
+        n_head=hf_config.num_attention_heads,
+        n_kv_head=getattr(hf_config, "num_key_value_heads",
+                          hf_config.num_attention_heads),
+        intermediate_size=hf_config.intermediate_size,
+        rms_norm_eps=hf_config.rms_norm_eps,
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+    )
+
+
+def llama_params_from_state_dict(state_dict: Dict[str, Any], config: Any,
+                                 dtype=jnp.float32) -> Params:
+    """Convert a torch ``LlamaForCausalLM.state_dict()`` into our pytree."""
+
+    def get_t(name: str) -> np.ndarray:
+        t = state_dict[name]
+        if hasattr(t, "detach"):
+            t = t.detach().cpu().numpy()
+        return np.asarray(t).T          # nn.Linear [out, in] -> [in, out]
+
+    def get(name: str) -> np.ndarray:
+        t = state_dict[name]
+        if hasattr(t, "detach"):
+            t = t.detach().cpu().numpy()
+        return np.asarray(t)
+
+    def stack_t(fmt: str) -> jnp.ndarray:
+        return jnp.asarray(
+            np.stack([get_t(fmt.format(i)) for i in range(config.n_layer)]),
+            dtype=dtype)
+
+    def stack(fmt: str) -> jnp.ndarray:
+        return jnp.asarray(
+            np.stack([get(fmt.format(i)) for i in range(config.n_layer)]),
+            dtype=dtype)
+
+    L = "model.layers.{}."
+    return {
+        "wte": jnp.asarray(get("model.embed_tokens.weight"), dtype=dtype),
+        "blocks": {
+            "ln_attn": {"scale": stack(L + "input_layernorm.weight")},
+            "attn": {
+                "wq": {"kernel": stack_t(L + "self_attn.q_proj.weight")},
+                "wk": {"kernel": stack_t(L + "self_attn.k_proj.weight")},
+                "wv": {"kernel": stack_t(L + "self_attn.v_proj.weight")},
+                "wo": {"kernel": stack_t(L + "self_attn.o_proj.weight")},
+            },
+            "ln_mlp": {"scale": stack(L + "post_attention_layernorm.weight")},
+            "mlp": {
+                "gate": {"kernel": stack_t(L + "mlp.gate_proj.weight")},
+                "up": {"kernel": stack_t(L + "mlp.up_proj.weight")},
+                "down": {"kernel": stack_t(L + "mlp.down_proj.weight")},
+            },
+        },
+        "ln_f": {"scale": jnp.asarray(get("model.norm.weight"), dtype=dtype)},
+        "lm_head": {"kernel": jnp.asarray(get_t("lm_head.weight"),
+                                          dtype=dtype)},
+    }
+
+
+def llama_params_from_hf_model(model: Any, dtype=jnp.float32):
+    """torch ``LlamaForCausalLM`` instance -> (config, params)."""
+    config = llama_config_from_hf(model.config)
+    return config, llama_params_from_state_dict(model.state_dict(), config,
+                                                dtype=dtype)

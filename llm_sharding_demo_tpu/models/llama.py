@@ -1,0 +1,268 @@
+"""LLaMA-family model (RMSNorm + RoPE + SwiGLU + GQA) as pure JAX.
+
+Second dense model family, beyond reference parity (the reference serves
+GPT-2 only, reference server.py:41). Same pure-pytree design and public
+surface as ``models.gpt2`` — ``init_params`` / ``forward`` /
+``forward_with_cache`` / ``make_cache`` over stacked ``[n_layer, ...]``
+block leaves scanned by ``lax.scan`` — so the decode engine, speculative
+decoding, serving, quantization, and checkpointing all work via the
+family registry (``models.family_module``) without knowing the
+architecture. Differences from GPT-2 that matter here:
+
+- **RoPE instead of a learned position table** (``ops.rope``): positions
+  are computed, not gathered, so context length is bounded only by cache
+  memory — this family is the framework's genuine long-context path
+  (GPT-2 hard-stops at 1024 learned positions, the reference's ceiling).
+- **Grouped-query attention**: ``n_kv_head <= n_head``; the KV cache is
+  allocated at kv-head width (``ops.attention`` handles grouped q/kv
+  natively), shrinking decode's cache traffic by ``n_head/n_kv_head``.
+- **RMSNorm** (no biases anywhere) and **SwiGLU** MLP
+  (``down(silu(gate(x)) * up(x))``).
+- **Untied LM head** (HF ``LlamaForCausalLM`` default).
+
+Numerics mirror HF ``modeling_llama`` (fp32 norm statistics, fp32 rotary
+angles, fp32 logits) so the logit-parity oracle
+(tests/test_llama.py) pins conversion + forward exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import (KVCache, cached_attention, causal_attention,
+                             merge_heads, split_heads)
+from ..ops.layers import linear, rms_norm
+from ..ops.rope import apply_rope, rope_angles
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    """Architecture hyperparameters (mirrors the HF ``LlamaConfig`` fields
+    we use; ``n_*`` naming kept consistent with ``GPT2Config``)."""
+
+    vocab_size: int = 32000
+    n_positions: int = 4096          # cache/serving bound, NOT a table size
+    n_embd: int = 768                # hidden_size
+    n_layer: int = 12
+    n_head: int = 12
+    n_kv_head: int = 12              # < n_head => grouped-query attention
+    intermediate_size: int = 2048
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    # "xla" | "pallas" | "ring" — same contract as GPT2Config. pallas/ring
+    # run on full-width K/V (GQA heads repeated first); the no-repeat
+    # grouped path is the default xla einsum.
+    attention_impl: str = "xla"
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    def __post_init__(self):
+        if self.n_embd % self.n_head != 0:
+            raise ValueError(
+                f"n_embd={self.n_embd} not divisible by n_head={self.n_head}")
+        if self.n_head % self.n_kv_head != 0:
+            raise ValueError(f"n_head={self.n_head} not a multiple of "
+                             f"n_kv_head={self.n_kv_head}")
+        if self.attention_impl not in ("xla", "pallas", "ring"):
+            raise ValueError(
+                f"attention_impl={self.attention_impl!r} not xla|pallas|ring")
+
+
+# "llama-124m" is the GPT-2-124M-comparable geometry used by the bench;
+# "llama-tiny" a test/smoke size. Both use GQA (n_kv_head < n_head) so the
+# family's distinguishing feature is always exercised.
+CONFIGS: Dict[str, LlamaConfig] = {
+    "llama-tiny": LlamaConfig(vocab_size=256, n_positions=512, n_embd=32,
+                              n_layer=2, n_head=4, n_kv_head=2,
+                              intermediate_size=64),
+    "llama-124m": LlamaConfig(vocab_size=32000, n_positions=4096, n_embd=768,
+                              n_layer=12, n_head=12, n_kv_head=4,
+                              intermediate_size=2048),
+}
+
+
+def init_params(config: LlamaConfig, key: jax.Array,
+                dtype=jnp.float32) -> Params:
+    """Random-init parameters; stacked ``[n_layer, ...]`` block leaves.
+
+    All matmul weights live under ``.../kernel`` in the ``[in, out]``
+    layout so ``ops.quant.quantize_params`` and the serving int8 path
+    apply unchanged.
+    """
+    d, l = config.n_embd, config.n_layer
+    hd, i = config.head_dim, config.intermediate_size
+    kv = config.n_kv_head * hd
+    std = 0.02
+    keys = jax.random.split(key, 9)
+
+    def normal(k, shape):
+        return (jax.random.normal(k, shape) * std).astype(dtype)
+
+    return {
+        "wte": normal(keys[0], (config.vocab_size, d)),
+        "blocks": {
+            "ln_attn": {"scale": jnp.ones((l, d), dtype)},
+            "attn": {
+                "wq": {"kernel": normal(keys[1], (l, d, d))},
+                "wk": {"kernel": normal(keys[2], (l, d, kv))},
+                "wv": {"kernel": normal(keys[3], (l, d, kv))},
+                "wo": {"kernel": normal(keys[4], (l, d, d))},
+            },
+            "ln_mlp": {"scale": jnp.ones((l, d), dtype)},
+            "mlp": {
+                "gate": {"kernel": normal(keys[5], (l, d, i))},
+                "up": {"kernel": normal(keys[6], (l, d, i))},
+                "down": {"kernel": normal(keys[7], (l, i, d))},
+            },
+        },
+        "ln_f": {"scale": jnp.ones((d,), dtype)},
+        "lm_head": {"kernel": normal(keys[8], (d, config.vocab_size))},
+    }
+
+
+def _block(block_params: Params, h: jnp.ndarray, config: LlamaConfig,
+           cos: jnp.ndarray, sin: jnp.ndarray,
+           cache_k: Optional[jnp.ndarray], cache_v: Optional[jnp.ndarray],
+           offset, k_valid_from: Optional[jnp.ndarray] = None,
+           mesh=None) -> Tuple[jnp.ndarray, Optional[jnp.ndarray],
+                               Optional[jnp.ndarray]]:
+    """One pre-norm llama block; optionally reads/writes a KV cache slice."""
+    a = rms_norm(h, block_params["ln_attn"]["scale"], config.rms_norm_eps)
+    attn = block_params["attn"]
+    q = split_heads(linear(a, attn["wq"]["kernel"]), config.n_head)
+    k = split_heads(linear(a, attn["wk"]["kernel"]), config.n_kv_head)
+    v = split_heads(linear(a, attn["wv"]["kernel"]), config.n_kv_head)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    if cache_k is None:
+        impl = config.attention_impl
+        if impl in ("pallas", "ring") and config.n_kv_head != config.n_head:
+            # those kernels are written for equal q/kv head counts; repeat
+            # (HF repeat_kv ordering) — a training-path materialization,
+            # the cached decode path below never repeats
+            g = config.n_head // config.n_kv_head
+            k = jnp.repeat(k, g, axis=1)
+            v = jnp.repeat(v, g, axis=1)
+        if impl == "pallas":
+            from ..ops.flash_attention import flash_attention
+            attn_out = flash_attention(
+                q, k, v, interpret=jax.default_backend() != "tpu")
+        elif impl == "ring":
+            from ..ops.ring_attention import ring_attention
+            if mesh is None:
+                raise ValueError("attention_impl='ring' needs a mesh with "
+                                 "an 'sp' axis: pass forward(..., mesh=mesh)")
+            if k_valid_from is not None:
+                raise NotImplementedError(
+                    "ring attention does not support ragged batches")
+            attn_out = ring_attention(q, k, v, mesh, axis="sp")
+        else:
+            attn_out = causal_attention(q, k, v, q_offset=offset,
+                                        k_valid_from=k_valid_from)
+        new_ck = new_cv = None
+    else:
+        attn_out, new_ck, new_cv = cached_attention(
+            q, k, v, cache_k, cache_v, offset, k_valid_from)
+    h = h + linear(merge_heads(attn_out), attn["wo"]["kernel"])
+    m = rms_norm(h, block_params["ln_mlp"]["scale"], config.rms_norm_eps)
+    mlp = block_params["mlp"]
+    m = linear(jax.nn.silu(linear(m, mlp["gate"]["kernel"]))
+               * linear(m, mlp["up"]["kernel"]), mlp["down"]["kernel"])
+    return h + m, new_ck, new_cv
+
+
+def _embed(params: Params, input_ids: jnp.ndarray) -> jnp.ndarray:
+    wte = params["wte"]
+    from ..ops.quant import is_quantized
+    if is_quantized(wte):
+        from ..ops.quant import embed_rows
+        return embed_rows(wte, input_ids)
+    return wte[input_ids]
+
+
+def _angles(config: LlamaConfig, seq_len: int, offset,
+            pad: Optional[jnp.ndarray]):
+    """(cos, sin) for positions ``offset + arange(S)`` (per-row shifted
+    down by ``pad`` for left-padded ragged batches; pad columns clip to
+    position 0 — masked as keys, never read as outputs)."""
+    pos = offset + jnp.arange(seq_len)
+    if pad is not None:
+        pos = jnp.maximum(pos[None, :] - pad[:, None], 0)   # [B, S]
+    return rope_angles(pos, config.head_dim, config.rope_theta)
+
+
+def _final(params: Params, h: jnp.ndarray, config: LlamaConfig) -> jnp.ndarray:
+    h = rms_norm(h, params["ln_f"]["scale"], config.rms_norm_eps)
+    from ..ops.quant import is_quantized
+    kernel = params["lm_head"]["kernel"]
+    if is_quantized(kernel):
+        return linear(h, kernel).astype(jnp.float32)
+    return jnp.einsum("bsd,dv->bsv", h, kernel,
+                      preferred_element_type=jnp.float32)
+
+
+def forward(params: Params, input_ids: jnp.ndarray, config: LlamaConfig,
+            remat: bool = False, mesh=None) -> jnp.ndarray:
+    """Full no-cache forward: [B, S] -> [B, S, vocab] float32 logits."""
+    h = _embed(params, input_ids)
+    cos, sin = _angles(config, input_ids.shape[1], 0, None)
+
+    def body(carry, layer_params):
+        out, _, _ = _block(layer_params, carry, config, cos, sin,
+                           None, None, 0, mesh=mesh)
+        return out, None
+
+    if remat:
+        body = jax.checkpoint(body)
+    h, _ = jax.lax.scan(body, h, params["blocks"])
+    return _final(params, h, config)
+
+
+def forward_with_cache(params: Params, input_ids: jnp.ndarray,
+                       config: LlamaConfig, cache: KVCache,
+                       pad: Optional[jnp.ndarray] = None,
+                       ) -> Tuple[jnp.ndarray, KVCache]:
+    """Cached forward (prefill when cache.length==0, decode otherwise).
+
+    Same contract as ``gpt2.forward_with_cache`` — multi-token steps at a
+    dynamic offset work, which is what speculative decoding's verify
+    forward relies on.
+    """
+    h = _embed(params, input_ids)
+    offset = cache.length
+    cos, sin = _angles(config, input_ids.shape[1], offset, pad)
+
+    def body(carry, xs):
+        layer_params, ck, cv = xs
+        out, new_ck, new_cv = _block(layer_params, carry, config, cos, sin,
+                                     ck, cv, offset, k_valid_from=pad)
+        return out, (new_ck, new_cv)
+
+    h, (new_k, new_v) = jax.lax.scan(body, h,
+                                     (params["blocks"], cache.k, cache.v))
+    new_len = cache.length + jnp.asarray(h.shape[1], dtype=jnp.int32)
+    return _final(params, h, config), KVCache(new_k, new_v, new_len)
+
+
+def make_cache(config: LlamaConfig, batch: int, max_seq: int,
+               dtype=jnp.float32) -> KVCache:
+    """KV cache at kv-head width ([L, B, n_kv_head, max_seq, hd]).
+
+    ``n_positions`` bounds ``max_seq`` as a config contract (cache sizing /
+    serving limit), not a table size — raise it in the config and longer
+    contexts work with the same weights (RoPE).
+    """
+    if max_seq > config.n_positions:
+        raise ValueError(
+            f"max_seq={max_seq} exceeds n_positions={config.n_positions} "
+            "(the configured serving/cache bound)")
+    return KVCache.create(config.n_layer, batch, config.n_kv_head, max_seq,
+                          config.head_dim, dtype)

@@ -80,13 +80,28 @@ def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     ``[0, pad_b)``, so passing ``pad`` here makes unequal-length prompts in
     one batch attend only to their own real tokens (the reference hardcodes
     batch=1, server.py:137, and has no mask at all).
+
+    Grouped-query attention (the llama family): ``k``/``v`` may carry
+    fewer heads than ``q`` (``H % Hkv == 0``). Query head ``i`` reads kv
+    head ``i // (H/Hkv)`` — HF's ``repeat_kv`` ordering — via reshaped
+    einsums, never materializing the repeated K/V (the point of GQA: the
+    KV cache and its HBM traffic shrink by H/Hkv).
     """
     b, h, sq, hd = q.shape
-    skv = k.shape[2]
+    h_kv, skv = k.shape[1], k.shape[2]
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, dtype=jnp.float32))
     # [B, H, Sq, Skv] score matrix in float32 for a stable softmax.
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) * scale
+    if h_kv == h:
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * scale
+    else:
+        if h % h_kv:
+            raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
+        g = h // h_kv
+        scores = jnp.einsum("bkgqd,bkud->bkgqu",
+                            q.reshape(b, h_kv, g, sq, hd), k,
+                            preferred_element_type=jnp.float32) * scale
+        scores = scores.reshape(b, h, sq, skv)
     q_pos = q_offset + jnp.arange(sq)[:, None]          # [Sq, 1]
     k_pos = jnp.arange(skv)[None, :]                    # [1, Skv]
     allowed = k_pos <= q_pos                            # causal
@@ -99,8 +114,12 @@ def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    & (k_pos >= k_valid_from[:, None, None]))[:, None, :, :]
     scores = jnp.where(allowed, scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
-    return out
+    if h_kv == h:
+        return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
+    g = h // h_kv
+    out = jnp.einsum("bkgqu,bkud->bkgqd",
+                     weights.astype(v.dtype).reshape(b, h_kv, g, sq, skv), v)
+    return out.reshape(b, h, sq, hd)
 
 
 def cached_attention(q: jnp.ndarray, k_new: jnp.ndarray, v_new: jnp.ndarray,
@@ -110,7 +129,10 @@ def cached_attention(q: jnp.ndarray, k_new: jnp.ndarray, v_new: jnp.ndarray,
                      ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Write new K/V at ``offset`` into the fixed-size cache, then attend.
 
-    q, k_new, v_new: [B, H, S, hd]; cache_k/v: [B, H, max_seq, hd].
+    q: [B, H, S, hd]; k_new, v_new: [B, Hkv, S, hd] and cache_k/v:
+    [B, Hkv, max_seq, hd], where Hkv == H for multi-head attention and
+    Hkv < H for grouped-query (llama family) — the cache stays at kv-head
+    width, which is GQA's whole memory/bandwidth win.
     Returns (attn_out, updated_cache_k, updated_cache_v). The write is a
     ``lax.dynamic_update_slice`` so shapes stay static under jit — this is
     the KV-cache mechanism BASELINE.json config 5 requires, absent from the

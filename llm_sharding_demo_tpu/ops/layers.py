@@ -10,6 +10,7 @@ module state and no dropout path (dropout is inert in the reference too:
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from .quant import QuantizedTensor, quant_matmul
@@ -30,6 +31,20 @@ def layer_norm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
     y = (x32 - mean) / jnp.sqrt(var + eps)
     y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
     return y.astype(orig_dtype)
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray,
+             eps: float = 1e-6) -> jnp.ndarray:
+    """RMSNorm over the trailing axis (the LLaMA-family normalizer).
+
+    Statistics in float32 (like ``layer_norm``); the scale multiply happens
+    AFTER casting back to the activation dtype, matching HF
+    ``LlamaRMSNorm.forward`` exactly so the llama logit-parity oracle
+    stays tight under bf16.
+    """
+    x32 = x.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return y.astype(x.dtype) * scale.astype(x.dtype)
 
 
 def gelu_new(x: jnp.ndarray) -> jnp.ndarray:

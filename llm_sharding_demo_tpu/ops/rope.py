@@ -1,0 +1,57 @@
+"""Rotary position embeddings (RoPE) — the LLaMA family's position scheme.
+
+Unlike GPT-2's learned ``wpe`` table (which hard-caps context at
+``n_positions`` rows — the reference's 1024-token ceiling, reference
+server.py:57,80), RoPE is computed from the position index itself, so the
+same weights serve any context length. This is what makes the llama
+family this framework's genuine long-context path: nothing in the model
+gathers from a position table.
+
+Formulation matches HF ``LlamaRotaryEmbedding`` + ``apply_rotary_pos_emb``
+(the "rotate half" convention, not interleaved):
+
+    inv_freq_j = theta ** -(2j / hd)             j in [0, hd/2)
+    emb        = concat([pos * inv_freq, pos * inv_freq])   # [.., S, hd]
+    x'         = x * cos(emb) + rotate_half(x) * sin(emb)
+
+Angles are computed in float32 regardless of activation dtype (bf16
+angles at position ~8k would quantize to whole radians) and the rotation
+is applied in float32 then cast back, mirroring HF's float32 cos/sin
+buffers so the parity oracle stays exact.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_angles(positions: jnp.ndarray, head_dim: int,
+                theta: float = 10000.0) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Positions ``[...]`` (int) -> (cos, sin) each ``[..., head_dim]``."""
+    j = jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+    inv_freq = theta ** (-j / head_dim)                      # [hd/2]
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq
+    emb = jnp.concatenate([freqs, freqs], axis=-1)           # [..., hd]
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def _rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray,
+               sin: jnp.ndarray) -> jnp.ndarray:
+    """Rotate ``x`` [B, H, S, hd] by per-position angles.
+
+    ``cos``/``sin`` are [S, hd] (uniform positions) or [B, S, hd]
+    (per-row offsets for left-padded ragged batches); the head axis
+    broadcasts.
+    """
+    if cos.ndim == 2:                        # [S, hd] -> [1, 1, S, hd]
+        cos, sin = cos[None, None], sin[None, None]
+    else:                                    # [B, S, hd] -> [B, 1, S, hd]
+        cos, sin = cos[:, None], sin[:, None]
+    x32 = x.astype(jnp.float32)
+    out = x32 * cos + _rotate_half(x32) * sin
+    return out.astype(x.dtype)

@@ -142,6 +142,44 @@ def shard_moe_params(params: Params, mesh: Mesh) -> Params:
     return shard_params(params, mesh, moe_param_pspecs(mesh))
 
 
+def llama_param_pspecs(mesh: Mesh) -> Params:
+    """PartitionSpecs for ``models.llama`` params — Megatron layout.
+
+    Same recipe as ``param_pspecs``: q/k/v and gate/up kernels
+    column-parallel over ``tp`` (output dim sharded), wo/down row-parallel
+    (input dim sharded), norms and embeddings replicated. No biases exist
+    in this family. kv projections shard over tp only when
+    ``n_kv_head`` divides tp cleanly — GSPMD handles uneven tiling but the
+    annotation is still correct either way (it re-tiles at the head
+    reshape, as with the fused GPT-2 qkv).
+    """
+    tp = "tp" if "tp" in mesh.axis_names else None
+
+    def blk(spec_tail: Tuple[Any, ...]) -> P:
+        return P(None, *spec_tail)
+
+    return {
+        "wte": P(),
+        "blocks": {
+            "ln_attn": {"scale": blk((None,))},
+            "attn": {
+                "wq": {"kernel": blk((None, tp))},
+                "wk": {"kernel": blk((None, tp))},
+                "wv": {"kernel": blk((None, tp))},
+                "wo": {"kernel": blk((tp, None))},
+            },
+            "ln_mlp": {"scale": blk((None,))},
+            "mlp": {
+                "gate": {"kernel": blk((None, tp))},
+                "up": {"kernel": blk((None, tp))},
+                "down": {"kernel": blk((tp, None))},
+            },
+        },
+        "ln_f": {"scale": P()},
+        "lm_head": {"kernel": P()},
+    }
+
+
 def batch_pspec(mesh: Mesh) -> P:
     """[B, S] token batches: batch over dp, sequence over sp (if present)."""
     dp = "dp" if "dp" in mesh.axis_names else None

@@ -38,7 +38,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models import gpt2
 from ..models.gpt2 import GPT2Config, Params
 from ..ops.attention import KVCache
 
@@ -257,19 +256,18 @@ class DecodeEngine:
         self.config = config
         self.max_seq = max_seq
         self.dtype = dtype
-        # Model dispatch: any module exposing the (forward_with_cache,
-        # make_cache) pair can be decoded. MoE is the second family; its
-        # blocks aren't partitionable by the dense stage extractor, so
-        # staged mode stays GPT-2-only.
-        from ..models import moe
-        if isinstance(config, moe.MoEConfig):
-            if boundaries is not None:
-                raise NotImplementedError(
-                    "pipeline stage partitioning (boundaries) covers the "
-                    "dense GPT-2 param tree only; MoE decodes unstaged")
-            self._model = moe
-        else:
-            self._model = gpt2
+        # Model dispatch: any family module exposing the
+        # (forward_with_cache, make_cache) pair can be decoded
+        # (models.family_module — gpt2, moe, llama). Only the plain dense
+        # GPT-2 tree is partitionable by the stage extractor, so staged
+        # mode stays GPT-2-only.
+        from ..models import family_module, is_partitionable
+        self._model = family_module(config)
+        if boundaries is not None and not is_partitionable(config):
+            raise NotImplementedError(
+                "pipeline stage partitioning (boundaries) covers the "
+                f"dense GPT-2 param tree only; {type(config).__name__} "
+                "models decode unstaged")
         if boundaries is None:
             self.specs = None
             self.stage_params = None

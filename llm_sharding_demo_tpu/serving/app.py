@@ -118,15 +118,19 @@ def create_app(cfg: Optional[ServingConfig] = None,
     #   /forward_b — the reference's ShardA/ShardB contract
     #   (server.py:51-105) regardless of how many stages /generate uses;
     # - coordinator + remote dispatch: nothing (shards hold the weights).
-    from ..models.moe import MoEConfig
-    is_moe = isinstance(config, MoEConfig)
-    if is_moe and cfg.dispatch == "remote":
+    from ..models import is_partitionable
+    # The stage-shard topology (partitioner, /forward + /forward_b relay)
+    # exists for the dense GPT-2 tree only; MoE and llama models serve
+    # unstaged through /generate.
+    partitionable = is_partitionable(config)
+    if not partitionable and cfg.dispatch == "remote":
         # the remote topology relays hidden states between stage shards
-        # (/forward -> /forward_b), which MoE pods decline — /generate
-        # would die on a KeyError mid-relay; fail at startup instead
+        # (/forward -> /forward_b), which non-GPT-2 pods decline —
+        # /generate would die on a KeyError mid-relay; fail at startup
         raise ValueError(
-            "DISPATCH=remote requires the dense stage-shard topology; "
-            "MoE models serve with DISPATCH=local")
+            "DISPATCH=remote requires the dense GPT-2 stage-shard "
+            f"topology; {type(config).__name__} models serve with "
+            "DISPATCH=local")
     if cfg.inference_dtype != "float32" and not (
             cfg.shard_role == "coordinator" and cfg.dispatch == "local"):
         # only the local decode runner implements the fast dtypes; a
@@ -170,13 +174,14 @@ def create_app(cfg: Optional[ServingConfig] = None,
                                            draft_len=cfg.spec_decode)
             runner = spec_runner.plain
             decode_stages = 1
-        elif is_moe:
-            # MoE blocks aren't partitionable by the dense stage extractor;
-            # the whole model decodes as one program on the pod's devices.
+        elif not partitionable:
+            # MoE/llama blocks aren't partitionable by the dense stage
+            # extractor; the whole model decodes as one program on the
+            # pod's devices (models.family_module dispatch in the engine).
             from ..runtime.engine import DecodeEngine
             runner = DecodeEngine(params, config, max_seq=cfg.max_seq,
                                   dtype=dtype)
-            decode_stages = 1  # MoE decodes unstaged (no dense partition)
+            decode_stages = 1  # unstaged (no dense partition)
         elif cfg.max_batch > 1 or cfg.inference_dtype == "int8":
             # Continuous batching multiplexes concurrent requests onto
             # shared ragged batched decodes (runtime.batcher), riding the
@@ -195,7 +200,7 @@ def create_app(cfg: Optional[ServingConfig] = None,
             from ..runtime.batcher import BatchingEngine
             runner = BatchingEngine(runner, max_batch=cfg.max_batch,
                                     max_wait_ms=cfg.batch_wait_ms)
-    if is_moe:
+    if not partitionable:
         compat_specs = compat_params = None
     else:
         compat_specs = P_.make_stage_specs(n_layer, [cfg.split_at])
@@ -233,9 +238,10 @@ def create_app(cfg: Optional[ServingConfig] = None,
     def forward_a(req: InputIDs):
         if cfg.shard_role != "a":
             return {"error": "This instance is not shard A."}
-        if is_moe:
+        if not partitionable:
             return {"error": "stage endpoints serve dense GPT-2 only; "
-                             "MoE models generate via /generate"}
+                             f"{type(config).__name__} models generate "
+                             "via /generate"}
         ids = jnp.asarray([req.input_ids], dtype=jnp.int32)
         hidden, _ = P_.stage_apply(compat_params["a"], compat_specs[0],
                                    config, ids)
@@ -245,9 +251,10 @@ def create_app(cfg: Optional[ServingConfig] = None,
     def forward_b(req: HiddenStates):
         if cfg.shard_role != "b":
             return {"error": "This instance is not shard B."}
-        if is_moe:
+        if not partitionable:
             return {"error": "stage endpoints serve dense GPT-2 only; "
-                             "MoE models generate via /generate"}
+                             f"{type(config).__name__} models generate "
+                             "via /generate"}
         hidden = jnp.asarray(np.asarray(req.hidden_states, dtype=np.float32))
         logits, _ = P_.stage_apply(compat_params["b"], compat_specs[1],
                                    config, hidden)

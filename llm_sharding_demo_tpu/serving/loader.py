@@ -49,10 +49,11 @@ def resolve_for_role(cfg: ServingConfig,
     if cfg.checkpoint_dir:
         if cfg.shard_role in ("a", "b"):
             config = ckpt.load_config(cfg.checkpoint_dir)
-            from ..models.moe import MoEConfig
-            if isinstance(config, MoEConfig):
-                # MoE stage endpoints decline every request (app.py), so
-                # an MoE shard pod needs no weights at all — config only
+            from ..models import is_partitionable
+            if not is_partitionable(config):
+                # MoE/llama stage endpoints decline every request
+                # (app.py), so such a shard pod needs no weights — config
+                # only
                 return config, None, None
             from ..parallel import partition as P_
             specs = P_.make_stage_specs(config.n_layer, [cfg.split_at])
@@ -60,8 +61,7 @@ def resolve_for_role(cfg: ServingConfig,
             log.info("partial-restoring stage %s (blocks [%d, %d)) "
                      "from %s", cfg.shard_role, specs[idx].start,
                      specs[idx].end, cfg.checkpoint_dir)
-            config, stage = ckpt.load_stage_params(
-                cfg.checkpoint_dir, specs[idx])
+            _, stage = ckpt.load_stage_params(cfg.checkpoint_dir, specs[idx])
             return config, None, stage
         elif cfg.shard_role == "coordinator" and cfg.dispatch == "remote":
             log.info("remote-dispatch coordinator: config only from %s",
@@ -94,12 +94,17 @@ def hub_reachable(timeout: float = 1.0) -> bool:
     finally:
         socket.setdefaulttimeout(prior)
 
-# HF model ids -> architecture configs for the random-init fallback.
-_FALLBACK_CONFIGS = {
-    "sshleifer/tiny-gpt2": gpt2.CONFIGS["tiny-gpt2"],
-    "gpt2": gpt2.CONFIGS["gpt2"],
-    "gpt2-medium": gpt2.CONFIGS["gpt2-medium"],
-}
+def _fallback_configs():
+    # HF model ids / family names -> architecture configs for the
+    # random-init fallback (lazy so importing loader stays light).
+    from ..models import llama
+    return {
+        "sshleifer/tiny-gpt2": gpt2.CONFIGS["tiny-gpt2"],
+        "gpt2": gpt2.CONFIGS["gpt2"],
+        "gpt2-medium": gpt2.CONFIGS["gpt2-medium"],
+        "llama-tiny": llama.CONFIGS["llama-tiny"],
+        "llama-124m": llama.CONFIGS["llama-124m"],
+    }
 
 
 def resolve_model(cfg: ServingConfig) -> Tuple[GPT2Config, Params]:
@@ -113,20 +118,26 @@ def resolve_model(cfg: ServingConfig) -> Tuple[GPT2Config, Params]:
         offline = not hub_reachable()
         from transformers import AutoModelForCausalLM
 
-        from ..models.hf_convert import params_from_hf_model
+        from ..models.hf_convert import (llama_params_from_hf_model,
+                                         params_from_hf_model)
         model = AutoModelForCausalLM.from_pretrained(
             cfg.model_id, local_files_only=offline)
         model.eval()
         log.info("converted HF model %s", cfg.model_id)
+        if getattr(model.config, "model_type", "gpt2") == "llama":
+            return llama_params_from_hf_model(model)
         return params_from_hf_model(model)
-    except Exception as e:  # hub unreachable / not cached / not a GPT-2
-        if cfg.model_id not in _FALLBACK_CONFIGS:
+    except Exception as e:  # hub unreachable / not cached / not convertible
+        fallbacks = _fallback_configs()
+        if cfg.model_id not in fallbacks:
             raise RuntimeError(
                 f"cannot load {cfg.model_id!r}: no checkpoint dir, HF load "
                 f"failed ({e}), and no fallback architecture is registered"
             ) from e
-        config = _FALLBACK_CONFIGS[cfg.model_id]
+        config = fallbacks[cfg.model_id]
         log.warning(
             "HF load of %s failed (%s); using RANDOM-INIT %s weights — "
             "output will be untrained noise", cfg.model_id, e, config)
-        return config, gpt2.init_params(config, jax.random.PRNGKey(0))
+        from ..models import family_module
+        return config, family_module(config).init_params(
+            config, jax.random.PRNGKey(0))

@@ -41,7 +41,11 @@ def lm_loss(params: Params, ids: jnp.ndarray, config: GPT2Config,
     reaches the forward for ``attention_impl="ring"`` (sequence-parallel
     attention over the sp axis).
     """
-    logits = gpt2.forward(params, ids[:, :-1], config, remat=remat, mesh=mesh)
+    # Family dispatch: gpt2 and llama share the forward signature; MoE has
+    # its own loss (router aux term) via MoETrainStep's loss_fn override.
+    from ..models import family_module
+    logits = family_module(config).forward(params, ids[:, :-1], config,
+                                           remat=remat, mesh=mesh)
     losses = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), ids[:, 1:])
     return jnp.mean(losses)
@@ -122,6 +126,15 @@ def moe_lm_loss(params: Params, ids: jnp.ndarray, config,
     ce = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), ids[:, 1:])
     return jnp.mean(ce) + aux_weight * aux
+
+
+def LlamaTrainStep(config, optimizer: optax.GradientTransformation,
+                   mesh: Optional[Mesh] = None,
+                   remat: bool = False) -> TrainStep:
+    """llama-family train step: the shared LM loss (lm_loss dispatches on
+    the config family) with the llama Megatron pspec table bound."""
+    return TrainStep(config, optimizer, mesh=mesh, remat=remat,
+                     pspec_fn=spmd.llama_param_pspecs)
 
 
 def MoETrainStep(config, optimizer: optax.GradientTransformation,

@@ -89,12 +89,18 @@ def _is_per_layer(blocks) -> bool:
 def _config_family(config: GPT2Config) -> str:
     """Model-family tag written next to the config fields.
 
-    ``dataclasses.asdict`` flattens both families to plain dicts; without a
-    tag an MoE checkpoint would restore as a GPT2Config crash (unknown
-    fields) or — worse, if fields ever overlapped — as the wrong model.
+    ``dataclasses.asdict`` flattens every family to a plain dict; without a
+    tag an MoE or llama checkpoint would restore as a GPT2Config crash
+    (unknown fields) or — worse, if fields ever overlapped — as the wrong
+    model.
     """
+    from ..models.llama import LlamaConfig
     from ..models.moe import MoEConfig
-    return "moe" if isinstance(config, MoEConfig) else "gpt2"
+    if isinstance(config, MoEConfig):
+        return "moe"
+    if isinstance(config, LlamaConfig):
+        return "llama"
+    return "gpt2"
 
 
 def save(directory: str, params: Params, config: GPT2Config) -> None:
@@ -118,6 +124,9 @@ def load_config(directory: str) -> GPT2Config:
     if family == "moe":
         from ..models.moe import MoEConfig
         return MoEConfig(**fields)
+    if family == "llama":
+        from ..models.llama import LlamaConfig
+        return LlamaConfig(**fields)
     if family != "gpt2":
         raise ValueError(f"unknown checkpoint model family {family!r}")
     return GPT2Config(**fields)

@@ -1,0 +1,194 @@
+"""LLaMA-family tests: logit/greedy parity vs HF torch, KV-cache and GQA
+correctness, engine/spec-decode/serving integration, checkpoint round
+trip, training, and the long-context property GPT-2 cannot have.
+
+Mirrors the GPT-2 oracle strategy (SURVEY.md §4 item 1): the HF torch
+implementation is ground truth for conversion + forward numerics.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+from transformers import LlamaConfig as HFLlamaConfig
+from transformers import LlamaForCausalLM
+
+from llm_sharding_demo_tpu.models import llama
+from llm_sharding_demo_tpu.models.hf_convert import llama_params_from_hf_model
+from llm_sharding_demo_tpu.runtime.engine import DecodeEngine
+
+
+@pytest.fixture(scope="module")
+def hf_pair():
+    torch.manual_seed(0)
+    cfg = HFLlamaConfig(vocab_size=211, hidden_size=64, num_hidden_layers=3,
+                        num_attention_heads=4, num_key_value_heads=2,
+                        intermediate_size=96, max_position_embeddings=128,
+                        rms_norm_eps=1e-5, initializer_range=0.5)
+    model = LlamaForCausalLM(cfg).eval()
+    config, params = llama_params_from_hf_model(model)
+    return model, config, params
+
+
+def test_logit_parity_vs_hf(hf_pair):
+    """fp32 logits match HF torch within tolerance; GQA (kv=2 < heads=4)
+    and RoPE are therefore pinned end to end."""
+    model, config, params = hf_pair
+    ids = np.random.default_rng(0).integers(0, config.vocab_size, (2, 9))
+    with torch.no_grad():
+        want = model(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(llama.forward(params, jnp.asarray(ids), config))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_greedy_parity_vs_torch(hf_pair):
+    model, config, params = hf_pair
+    engine = DecodeEngine(params, config, max_seq=64)
+    prompt = list(np.random.default_rng(1).integers(0, config.vocab_size, 7))
+    ids = list(prompt)
+    for _ in range(12):
+        with torch.no_grad():
+            logits = model(torch.tensor([ids])).logits[0, -1]
+        ids.append(int(torch.argmax(logits)))
+    got = engine.generate(np.asarray(prompt), max_new_tokens=12)
+    assert list(got.tokens[0]) == ids
+
+
+def test_cached_matches_uncached(hf_pair):
+    """Incremental decode ≡ full re-forward (the KV-cache oracle, at
+    kv-head cache width)."""
+    _, config, params = hf_pair
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, config.vocab_size, (1, 11))
+    full = llama.forward(params, jnp.asarray(ids), config)
+    cache = llama.make_cache(config, 1, 32)
+    assert cache.k.shape == (config.n_layer, 1, config.n_kv_head, 32,
+                             config.head_dim)
+    logits_p, cache = llama.forward_with_cache(
+        params, jnp.asarray(ids[:, :6]), config, cache)
+    np.testing.assert_allclose(np.asarray(logits_p),
+                               np.asarray(full[:, :6]), atol=1e-4, rtol=1e-4)
+    for t in range(6, 11):
+        step, cache = llama.forward_with_cache(
+            params, jnp.asarray(ids[:, t:t + 1]), config, cache)
+        np.testing.assert_allclose(np.asarray(step[:, 0]),
+                                   np.asarray(full[:, t]),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_ragged_batch_matches_single(hf_pair):
+    _, config, params = hf_pair
+    engine = DecodeEngine(params, config, max_seq=64)
+    rng = np.random.default_rng(3)
+    prompts = [list(rng.integers(0, config.vocab_size, size=(n,)))
+               for n in (3, 7, 5)]
+    got = engine.generate(prompts, max_new_tokens=6)
+    for b, prompt in enumerate(prompts):
+        single = engine.generate(np.asarray(prompt), max_new_tokens=6).tokens
+        np.testing.assert_array_equal(single[0], got.row_tokens(b))
+
+
+def test_spec_decode_exact_for_llama(hf_pair):
+    from llm_sharding_demo_tpu.runtime.spec_decode import SpecDecodeEngine
+
+    _, config, params = hf_pair
+    plain = DecodeEngine(params, config, max_seq=128)
+    spec = SpecDecodeEngine(params, config, max_seq=128, draft_len=5)
+    prompt = np.asarray([4, 9, 4, 9, 4, 9, 4, 9], dtype=np.int32)
+    want = plain.generate(prompt, max_new_tokens=20).tokens
+    got = spec.generate(prompt, max_new_tokens=20).tokens
+    np.testing.assert_array_equal(got, want)
+
+
+def test_dtype_paths(hf_pair):
+    """bf16 and weight-only int8 engines decode (quantize_params covers
+    the llama tree: kernels incl. the untied lm_head, plus wte)."""
+    _, config, params = hf_pair
+    prompt = np.arange(8, dtype=np.int32) % config.vocab_size
+    for dt in (jnp.bfloat16, "int8"):
+        engine = DecodeEngine(params, config, max_seq=64, dtype=dt)
+        out = engine.generate(prompt, max_new_tokens=5)
+        assert out.tokens.shape == (1, 13)
+
+
+def test_checkpoint_roundtrip_llama(hf_pair, tmp_path):
+    from llm_sharding_demo_tpu.utils import checkpoint as ckpt
+
+    _, config, params = hf_pair
+    d = str(tmp_path / "llama")
+    ckpt.save(d, params, config)
+    config2, params2 = ckpt.load(d)
+    assert config2 == config and isinstance(config2, llama.LlamaConfig)
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(params2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_serving_llama(hf_pair):
+    """/generate serves the llama family (unstaged; healthz reports it);
+    stage endpoints decline."""
+    from llm_sharding_demo_tpu.serving.app import create_app
+    from llm_sharding_demo_tpu.serving.http import TestClient
+    from llm_sharding_demo_tpu.serving.tokenizer import ByteTokenizer
+    from llm_sharding_demo_tpu.utils.config import ServingConfig
+
+    _, config, params = hf_pair
+    cfg = ServingConfig(model_id="llama-test", max_seq=64)
+    client = TestClient(create_app(cfg, model=(config, params),
+                                   tokenizer=ByteTokenizer()))
+    assert client.get("/healthz").json()["n_stages"] == 1
+    r = client.post("/generate", json={"prompt": "Hi", "max_new_tokens": 4,
+                                       "mode": "greedy"})
+    assert r.status_code == 200 and isinstance(r.json()["generated"], str)
+    a_cfg = ServingConfig(model_id="llama-test", shard_role="a", max_seq=64)
+    a = TestClient(create_app(a_cfg, model=(config, params),
+                              tokenizer=ByteTokenizer()))
+    assert "dense GPT-2 only" in a.post(
+        "/forward", json={"input_ids": [1, 2]}).json()["error"]
+    with pytest.raises(ValueError, match="DISPATCH=local"):
+        create_app(ServingConfig(model_id="llama-test", dispatch="remote"),
+                   model=(config, params), tokenizer=ByteTokenizer())
+
+
+def test_train_step_and_tp_parity(hf_pair):
+    """One train step runs (finite decreasing-ish loss) and a dp×tp-sharded
+    step matches the unsharded one — the llama pspec table is real."""
+    from llm_sharding_demo_tpu.parallel import spmd
+    from llm_sharding_demo_tpu.training import train
+
+    _, config, params = hf_pair
+    ids = np.random.default_rng(5).integers(0, config.vocab_size, (4, 12))
+
+    step = train.LlamaTrainStep(config, train.adamw(1e-3))
+    p, s = step.init(params)
+    p, s, loss0 = step(p, s, jnp.asarray(ids))
+    p, s, loss1 = step(p, s, jnp.asarray(ids))
+    assert np.isfinite(loss0) and np.isfinite(loss1) and loss1 < loss0
+
+    mesh = spmd.make_mesh({"dp": 2, "tp": 4}, jax.devices())
+    mstep = train.LlamaTrainStep(config, train.adamw(1e-3), mesh=mesh)
+    mp, ms = mstep.init(params)
+    mp, ms, mloss0 = mstep(mp, ms, mstep.shard_batch(ids))
+    np.testing.assert_allclose(float(mloss0), float(loss0),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_long_context_beyond_gpt2_ceiling(hf_pair):
+    """Decode continues past position 1024 — impossible for GPT-2, whose
+    learned wpe table ends there (the reference's hard ceiling,
+    server.py:57). RoPE positions are computed, so only the configured
+    cache bound limits context."""
+    _, config, params = hf_pair
+    long_cfg = dataclasses.replace(config, n_positions=1200)
+    engine = DecodeEngine(params, long_cfg, max_seq=1200)
+    prompt = (np.arange(1150, dtype=np.int32) * 31) % config.vocab_size
+    out = engine.generate(prompt, max_new_tokens=30)
+    assert out.tokens.shape == (1, 1180)
+    # the model must actually be attending across the long window: the
+    # cached decode at depth ~1150 equals the uncached full re-forward
+    full = llama.forward(params, jnp.asarray(out.tokens[:, :-1]), long_cfg)
+    want = int(jnp.argmax(full[0, -1]))
+    assert int(out.tokens[0, -1]) == want

@@ -1,4 +1,4 @@
-"""CLI: HuggingFace GPT-2 checkpoint -> Orbax checkpoint directory.
+"""CLI: HuggingFace GPT-2/LLaMA checkpoint -> Orbax checkpoint directory.
 
 One-time conversion so serving/training pods never need the HF hub or
 torch (the reference instead downloads full HF weights into every pod at
@@ -28,14 +28,18 @@ def main() -> int:
     import jax.numpy as jnp
     from transformers import AutoModelForCausalLM
 
-    from llm_sharding_demo_tpu.models.hf_convert import params_from_hf_model
+    from llm_sharding_demo_tpu.models.hf_convert import (
+        llama_params_from_hf_model, params_from_hf_model)
     from llm_sharding_demo_tpu.utils import checkpoint as ckpt
 
     dtype = jnp.float32 if args.dtype == "float32" else jnp.bfloat16
     print(f"loading HF model {args.model_id} ...", flush=True)
     model = AutoModelForCausalLM.from_pretrained(args.model_id)
     model.eval()
-    config, params = params_from_hf_model(model, dtype=dtype)
+    if getattr(model.config, "model_type", "gpt2") == "llama":
+        config, params = llama_params_from_hf_model(model, dtype=dtype)
+    else:
+        config, params = params_from_hf_model(model, dtype=dtype)
     print(f"converted: {config}", flush=True)
     ckpt.save(args.out_dir, params, config)
     print(f"wrote Orbax checkpoint to {args.out_dir}")
