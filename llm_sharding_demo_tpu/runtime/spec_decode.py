@@ -6,7 +6,10 @@ this module emits up to ``draft_len + 1`` tokens per forward). Greedy
 speculative decoding is *provably token-exact*: a draft token is kept only
 when it equals the model's own argmax at that position, so the emitted
 stream is byte-identical to plain greedy decode — the parity test pins
-this (tests/test_spec_decode.py).
+this (tests/test_spec_decode.py). Sample mode is *distribution-exact* via
+rejection sampling against the point-mass draft (see ``_loop_impl``),
+reproducing the reference's temperature/top-k sampler distribution
+(reference server.py:187-205) token for token — pinned by a pmf test.
 
 Why it pays on TPU: single-stream decode is HBM-bandwidth-bound — every
 step streams all weights to produce ONE token's worth of MXU work. A
@@ -49,11 +52,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.gpt2 import GPT2Config, Params
-from .engine import DecodeEngine, GenerateResult, SamplingConfig, prepare_generate
+from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
+                     prepare_generate, select_token)
 
 
 class SpecDecodeEngine:
-    """Greedy-only speculative decode engine (single stream).
+    """Speculative decode engine (single stream; greedy + sample modes).
 
     Composes a ``DecodeEngine`` for parameter preparation (dtype cast /
     int8 quantization / model-family dispatch) and its jitted prefill;
@@ -78,7 +82,8 @@ class SpecDecodeEngine:
         self._eng = DecodeEngine(params, config, max_seq, dtype=dtype)
         self.config = config
         self.max_seq = max_seq
-        self._loop = jax.jit(self._loop_impl, static_argnames=("max_new",),
+        self._loop = jax.jit(self._loop_impl,
+                             static_argnames=("max_new", "sampling"),
                              donate_argnums=(2,))
 
     @property
@@ -89,14 +94,26 @@ class SpecDecodeEngine:
 
     # -- compiled verify loop ------------------------------------------------
 
-    def _loop_impl(self, params, first_token, cache, buf, total, *,
-                   max_new: int):
+    def _loop_impl(self, params, first_token, cache, buf, total, key, *,
+                   max_new: int, sampling: SamplingConfig):
         """(buf, total, cache) after prefill -> (buf, verify_steps).
 
         Invariant at loop entry: ``buf[:total]`` holds prompt + emitted
         tokens, ``cache.length == total - 1`` (the last emitted token has
         not been forwarded yet), ``emitted`` counts new tokens so far.
-        """
+
+        Greedy acceptance compares drafts against the model argmax —
+        token-exact by construction. Sample mode is *distribution-exact*
+        rejection sampling against the point-mass draft: draft ``d_j`` is
+        accepted with probability ``p_j(d_j)`` under the reference
+        sampler's temperature/top-k pmf; the first rejection's bonus token
+        is drawn from the residual ``p_j`` with ``d_j`` zeroed and
+        renormalized (for a point-mass proposal the Leviathan residual
+        ``max(0, p - q)/Z`` reduces to exactly that), and a fully-accepted
+        window draws the bonus from ``p_K`` unmodified. Each emitted token
+        is therefore distributed exactly as the plain sampler's — only the
+        RNG consumption pattern differs, so seeded streams differ while
+        the distribution does not (pinned by the pmf test)."""
         K, ngram = self.draft_len, self.ngram
         buflen = buf.shape[0]
         j_arr = jnp.arange(buflen, dtype=jnp.int32)
@@ -117,36 +134,67 @@ class SpecDecodeEngine:
             # fallback: repeat the last token (catches token-loop output)
             return jnp.where(found, got, jnp.full((K,), t_last, jnp.int32))
 
+        def accept_and_patch(logits, drafts, step_key):
+            """[K+1, V] verify logits -> (n_accept, patch_tokens [K+1]).
+
+            ``patch_tokens[j]`` is meaningful for ``j <= n_accept``:
+            accepted drafts then the bonus token.
+            """
+            if sampling.mode == "greedy":
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                hits = (drafts == greedy[:K]).astype(jnp.int32)
+                # greedy[j] is the token after x[j]; the bonus at the first
+                # mismatch position is greedy itself, so patch == greedy
+                return jnp.cumprod(hits).sum(), greedy
+            scaled = logits.astype(jnp.float32) / sampling.temperature
+            top_vals, top_idx = jax.lax.top_k(scaled, sampling.top_k)
+            probs = jax.nn.softmax(top_vals, axis=-1)        # [K+1, k]
+            k_acc, k_res = jax.random.split(step_key)
+            in_topk = top_idx[:K] == drafts[:, None]         # [K, k]
+            p_d = (probs[:K] * in_topk).sum(-1)              # [K]
+            u = jax.random.uniform(k_acc, (K,))
+            n_accept = jnp.cumprod((u < p_d).astype(jnp.int32)).sum()
+            # bonus from row n_accept: the residual when a rejection
+            # happened there, the plain pmf when every draft was accepted
+            row_p, row_i = probs[n_accept], top_idx[n_accept]
+            d_rej = drafts[jnp.minimum(n_accept, K - 1)]
+            zero_d = (n_accept < K) & (row_i == d_rej)
+            resid = jnp.where(zero_d, 0.0, row_p)
+            choice = jax.random.categorical(k_res, jnp.log(resid))
+            bonus = row_i[choice].astype(jnp.int32)
+            dr_ext = jnp.concatenate([drafts, jnp.zeros((1,), jnp.int32)])
+            return n_accept, jnp.where(jnp.arange(K + 1) < n_accept,
+                                       dr_ext, bonus)
+
         def body(carry):
-            buf, total, cache, emitted, steps = carry
+            buf, total, cache, emitted, steps, key = carry
+            key, step_key = jax.random.split(key)
             t_last = buf[total - 1]
             drafts = draft(buf, total, t_last)
             x = jnp.concatenate([t_last[None], drafts])[None, :]  # [1, K+1]
             logits, cache = self._eng._forward_cached(params, x, cache, None)
-            greedy = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)  # [K+1]
-            # greedy[j] is the model's token after x[j]; drafts[j] == x[j+1]
-            hits = (drafts == greedy[:K]).astype(jnp.int32)
-            n_accept = jnp.cumprod(hits).sum()           # leading matches
+            n_accept, patch_tokens = accept_and_patch(logits[0], drafts,
+                                                      step_key)
             n_emit = jnp.minimum(n_accept + 1, max_new - emitted)
-            # splice the emitted prefix of `greedy` into buf at `total`
-            # (greedy[:n_accept] == drafts[:n_accept], then one bonus token)
+            # splice the emitted tokens into buf at `total`
             old = jax.lax.dynamic_slice(buf, (total,), (K + 1,))
-            patch = jnp.where(jnp.arange(K + 1) < n_emit, greedy, old)
+            patch = jnp.where(jnp.arange(K + 1) < n_emit, patch_tokens, old)
             buf = jax.lax.dynamic_update_slice(buf, patch, (total,))
             # rewind: forwarded-and-kept = t_last + the accepted prefix;
             # slots beyond are stale and masked by kv_length until the
             # next verify overwrites them at the rewound offset
             cache = cache._replace(
                 length=(total - 1 + n_emit).astype(jnp.int32))
-            return (buf, total + n_emit, cache, emitted + n_emit, steps + 1)
+            return (buf, total + n_emit, cache, emitted + n_emit,
+                    steps + 1, key)
 
         def cond(carry):
             return carry[3] < max_new
 
         first = first_token.reshape(()).astype(jnp.int32)
         buf = jax.lax.dynamic_update_slice(buf, first[None], (total,))
-        carry = (buf, total + 1, cache, jnp.int32(1), jnp.int32(0))
-        buf, _, cache, _, steps = jax.lax.while_loop(cond, body, carry)
+        carry = (buf, total + 1, cache, jnp.int32(1), jnp.int32(0), key)
+        buf, _, cache, _, steps, _ = jax.lax.while_loop(cond, body, carry)
         return buf, steps, cache
 
     # -- public API ----------------------------------------------------------
@@ -154,17 +202,11 @@ class SpecDecodeEngine:
     def generate(self, prompt_ids, max_new_tokens: int,
                  sampling: SamplingConfig = SamplingConfig(),
                  key: Optional[jax.Array] = None) -> GenerateResult:
-        """Greedy generate, token-exact vs ``DecodeEngine.generate``.
-
-        Rejects batches (speculation is single-stream) and sample mode
-        (draft acceptance under sampling needs rejection-sampling to stay
-        distribution-exact; greedy is the BASELINE.json parity mode).
+        """Speculative generate: token-exact vs ``DecodeEngine.generate``
+        in greedy mode, distribution-exact (rejection sampling, see
+        ``_loop_impl``) in sample mode. Single-stream only (batches go
+        through DecodeEngine / runtime.batcher).
         """
-        if sampling.mode != "greedy":
-            raise NotImplementedError(
-                "speculative decoding is greedy-only: acceptance compares "
-                "drafts against the model argmax; distribution-exact "
-                "sampled speculation (rejection sampling) is not built")
         ids, batch, prompt_len, key, pad = prepare_generate(
             prompt_ids, max_new_tokens, self.max_seq, sampling, key,
             allow_ragged=False)
@@ -189,16 +231,17 @@ class SpecDecodeEngine:
         run_params = self._eng._run_params()
 
         t0 = time.perf_counter()
+        prefill_key, loop_key = jax.random.split(key)
         last_logits, cache = self._eng._prefill(run_params, ids_j, None)
-        first = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+        first = select_token(last_logits, sampling, prefill_key)
         first.block_until_ready()
         t1 = time.perf_counter()
 
         buf = jnp.zeros((self.max_seq + self.draft_len + 1,), jnp.int32)
         buf = jax.lax.dynamic_update_slice(buf, ids_j[0], (0,))
         buf, steps, _ = self._loop(run_params, first[0], cache, buf,
-                                   jnp.int32(prompt_len),
-                                   max_new=max_new_tokens)
+                                   jnp.int32(prompt_len), loop_key,
+                                   max_new=max_new_tokens, sampling=sampling)
         buf = np.asarray(jax.block_until_ready(buf))
         t2 = time.perf_counter()
 

@@ -77,6 +77,14 @@ class GenerateReq(BaseModel):
     mode: str = "sample"
     temperature: float = REF_TEMPERATURE
     top_k: int = REF_TOP_K
+    # Seed reproducibility contract: the same (prompt, params, seed) on
+    # the SAME server configuration replays the same stream. Across
+    # configurations the stream may legitimately differ while the
+    # distribution does not: SPEC_DECODE>0 routes sample-mode requests
+    # through the rejection-sampled speculative engine, whose RNG
+    # consumption pattern differs from the plain scan's (and from the
+    # reference's unseeded torch sampler, SURVEY.md §7(d)). Don't key
+    # golden outputs on seeds across serving-config changes.
     seed: Optional[int] = None
 
 
@@ -162,10 +170,11 @@ def create_app(cfg: Optional[ServingConfig] = None,
         # accept dtype strings and the engine branches on "int8" itself
         dtype = cfg.inference_dtype
         if cfg.spec_decode > 0:
-            # prompt-lookup speculation (runtime.spec_decode): greedy
+            # prompt-lookup speculation (runtime.spec_decode):
             # single-stream requests emit up to draft_len+1 tokens per
-            # forward, token-exact; sample-mode requests fall through to
-            # the wrapped plain engine (same weights, no duplication).
+            # forward — token-exact for greedy, distribution-exact for
+            # sample mode; requests that don't fit speculation's guards
+            # fall through to the wrapped plain engine (same weights).
             # The spec engine decodes unstaged (one program, one device
             # group) — reflected in decode_stages below.
             from ..runtime.spec_decode import SpecDecodeEngine
@@ -268,11 +277,12 @@ def create_app(cfg: Optional[ServingConfig] = None,
         seed = req.seed if req.seed is not None else int(
             np.random.default_rng().integers(2 ** 31))
         # Speculation serves only the requests it is exact and safe for:
-        # greedy mode, prompt at least ngram long, and draft_len slots of
-        # cache headroom left. Everything else uses the plain engine —
-        # same weights, same tokens, just one token per forward.
+        # prompt at least ngram long and draft_len slots of cache headroom
+        # left (greedy is token-exact, sample distribution-exact via
+        # rejection sampling). Everything else uses the plain engine —
+        # same weights, just one token per forward.
         eng = runner
-        if (spec_runner is not None and sampling.mode == "greedy"
+        if (spec_runner is not None
                 and len(prompt_ids) >= spec_runner.ngram
                 and (len(prompt_ids) + req.max_new_tokens
                      + spec_runner.draft_len) <= cfg.max_seq):

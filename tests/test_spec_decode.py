@@ -83,9 +83,8 @@ def test_spec_bf16_matches_bf16_plain(params):
 def test_spec_guards(params):
     spec = SpecDecodeEngine(params, CFG, max_seq=64, draft_len=4)
     prompt = np.arange(8, dtype=np.int32)
-    with pytest.raises(NotImplementedError, match="greedy-only"):
-        spec.generate(prompt, 5, sampling=SamplingConfig(mode="sample"),
-                      key=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="PRNG key"):
+        spec.generate(prompt, 5, sampling=SamplingConfig(mode="sample"))
     with pytest.raises(ValueError, match="single-stream"):
         spec.generate(np.stack([prompt, prompt]), 5)
     with pytest.raises(ValueError, match="headroom"):
@@ -93,3 +92,61 @@ def test_spec_guards(params):
     with pytest.raises(ValueError, match="shorter than ngram"):
         SpecDecodeEngine(params, CFG, max_seq=64, ngram=3).generate(
             np.arange(2, dtype=np.int32), 5)
+
+
+def test_spec_sample_topk1_equals_greedy(params, plain):
+    """Degenerate sampling (top_k=1) makes rejection deterministic: the
+    sampled speculative stream must equal the greedy stream exactly."""
+    spec = SpecDecodeEngine(params, CFG, max_seq=128, draft_len=5)
+    prompt = np.asarray([7, 3, 7, 3, 7, 3, 7], dtype=np.int32)
+    want = plain.generate(prompt, max_new_tokens=18).tokens
+    got = spec.generate(prompt, max_new_tokens=18,
+                        sampling=SamplingConfig(mode="sample",
+                                                temperature=0.6, top_k=1),
+                        key=jax.random.PRNGKey(11))
+    np.testing.assert_array_equal(got.tokens, want)
+
+
+def test_spec_sample_distribution_exact(params):
+    """The rejection-sampled token's law equals the reference sampler pmf.
+
+    Drives the verify loop directly with a FIXED prefix (prompt + first
+    token) so the first loop-emitted token is conditionally distributed;
+    its marginal must be softmax(top_k(logits/T)) of the model at that
+    prefix — accept-draft mass plus residual mass must recompose p
+    exactly. ~2.5k trials, tolerance ~4 sigma of a binomial frequency.
+    """
+    temp, top_k, n_trials = 0.8, 12, 2500
+    sampling = SamplingConfig(mode="sample", temperature=temp, top_k=top_k)
+    spec = SpecDecodeEngine(params, CFG, max_seq=64, draft_len=4)
+    prompt = np.asarray([5, 9, 5, 9, 5, 9, 5], dtype=np.int32)
+    t0 = 5  # fixed first token => fixed conditioning prefix
+    prefix = np.concatenate([prompt, [t0]])[None, :]
+
+    # analytic pmf of the reference sampler at the prefix
+    logits = np.asarray(gpt2.forward(
+        jax.tree.map(jnp.asarray, params), jnp.asarray(prefix), CFG))[0, -1]
+    vals, idx = jax.lax.top_k(jnp.asarray(logits) / temp, top_k)
+    pmf = np.zeros(CFG.vocab_size)
+    pmf[np.asarray(idx)] = np.asarray(jax.nn.softmax(vals))
+
+    run_params = spec._eng._run_params()
+    ids_j = jnp.asarray(prompt[None, :], dtype=jnp.int32)
+    counts = np.zeros(CFG.vocab_size, dtype=np.int64)
+    for i in range(n_trials):
+        # fresh prefill each trial: the loop donates its cache input
+        _, cache = spec._eng._prefill(run_params, ids_j, None)
+        buf = jnp.zeros((64 + 4 + 1,), jnp.int32)
+        buf = jax.lax.dynamic_update_slice(buf, ids_j[0], (0,))
+        buf, _, _ = spec._loop(run_params, jnp.int32(t0), cache, buf,
+                               jnp.int32(len(prompt)),
+                               jax.random.PRNGKey(1000 + i),
+                               max_new=2, sampling=sampling)
+        counts[int(buf[len(prompt) + 1])] += 1
+
+    freq = counts / n_trials
+    # every sampled token must come from the top-k support
+    assert counts[pmf == 0].sum() == 0
+    tol = 4 * np.sqrt(pmf * (1 - pmf) / n_trials) + 1e-3
+    assert (np.abs(freq - pmf) <= tol).all(), (
+        f"max dev {np.abs(freq - pmf).max():.4f} vs tol {tol.max():.4f}")
