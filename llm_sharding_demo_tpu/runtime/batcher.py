@@ -231,7 +231,11 @@ class BatchingEngine:
         REGISTRY.inc("batched_requests_total", value=len(batch))
         REGISTRY.inc("batched_rows_padded_total", value=b - len(batch))
         for i, req in enumerate(batch):
-            row = result.tokens[i, int(pad[i]):]          # strip left pad
+            # row_tokens strips the engine-reported pad — OUR bucket pad
+            # plus any chunk-alignment pad the engine added on top
+            # (DecodeEngine prefill_chunk); slicing by the local ``pad``
+            # would leak chunk-pad zeros into responses
+            row = result.row_tokens(i)
             req.result = row[:len(req.prompt) + req.max_new_tokens]
             req.timing = result
             req.done.set()

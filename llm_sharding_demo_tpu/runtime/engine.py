@@ -223,7 +223,8 @@ class DecodeEngine:
     """
 
     def __init__(self, params: Params, config: GPT2Config, max_seq: int,
-                 dtype=jnp.float32, boundaries=None):
+                 dtype=jnp.float32, boundaries=None,
+                 prefill_chunk: Optional[int] = None):
         """``dtype`` is the inference compute dtype: float params are cast
         once here and the KV cache allocates in it. bfloat16 halves weight
         and cache HBM traffic (the decode bottleneck — each token streams
@@ -237,7 +238,19 @@ class DecodeEngine:
         (ops.quant), activations and KV cache in bfloat16 — halves weight
         HBM traffic again over bf16. Tokens may diverge from the bf16
         stream within quantization error; fp32/bf16 remain the parity
-        modes."""
+        modes.
+
+        ``prefill_chunk=C`` bounds the compile count under XLA's
+        static-shape rule: a monolithic prefill compiles one program PER
+        PROMPT LENGTH (a first-compile stall — tens of seconds on TPU —
+        every time serving sees a new length), while chunked prefill
+        left-pads the prompt to a multiple of ``C`` and scans one C-wide
+        cached forward over the chunks, so the compiled-program space is
+        the ~``max_seq/C`` distinct chunk COUNTS (each sharing the single
+        scanned body) instead of every length. Numerically identical to
+        monolithic prefill: the chunk padding rides the ragged-batch
+        machinery (per-row position offsets + ``k_valid_from`` masking),
+        token streams are byte-equal."""
         if max_seq > config.n_positions:
             raise ValueError(
                 f"max_seq={max_seq} exceeds n_positions={config.n_positions}")
@@ -279,12 +292,16 @@ class DecodeEngine:
             # the monolithic pytree keeps one set of weights resident, not
             # two (the slices are new buffers).
             self.params = None
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk={prefill_chunk} must be >= 1")
+        self.prefill_chunk = prefill_chunk
         # Prefill allocates its cache *inside* the program (zeros are free
         # under XLA and the layout matches the decode program exactly);
         # decode donates the prefill-produced cache so the two
         # [L, B, H, max_seq, hd] buffers update in place instead of
         # doubling.
         self._prefill = jax.jit(self._prefill_impl)
+        self._prefill_chunked = jax.jit(self._prefill_chunked_impl)
         # static args: number of decode steps and the sampling policy (both
         # change the traced program).
         self._decode = jax.jit(self._decode_impl, donate_argnums=(2,),
@@ -321,6 +338,43 @@ class DecodeEngine:
         cache = self._fresh_cache(ids.shape[0])
         logits, cache = self._forward_cached(params, ids, cache, pad)
         return logits[:, -1], cache
+
+    def _prefill_chunked_impl(self, params: Params, chunks: jnp.ndarray,
+                              pad: jnp.ndarray,
+                              ) -> Tuple[jnp.ndarray, KVCache]:
+        """``chunks`` [n, B, C] (left-pad-aligned); ``pad`` [B] includes
+        the alignment pad. One C-wide cached forward scanned over the
+        chunk axis — the compiled body is shared by every chunk, so the
+        program space is per chunk COUNT, not per prompt length."""
+        cache = self._fresh_cache(chunks.shape[1])
+
+        def body(cache, chunk):
+            logits, cache = self._forward_cached(params, chunk, cache, pad)
+            return cache, logits[:, -1]
+
+        cache, last = jax.lax.scan(body, cache, chunks)
+        return last[-1], cache
+
+    def _align_chunks(self, ids: np.ndarray, pad: np.ndarray,
+                      prompt_len: int, reserve: int):
+        """Left-pad ``ids`` to a multiple of ``prefill_chunk`` when chunked
+        prefill applies. Returns ``(ids, pad, prompt_len, chunk_or_None)``;
+        ``chunk=None`` means use the monolithic prefill (chunking off,
+        prompt fits in one chunk, or no cache headroom for the alignment
+        pad given ``reserve`` upcoming tokens). Correctness never depends
+        on which path is taken."""
+        chunk = self.prefill_chunk
+        if not chunk or prompt_len <= chunk:
+            return ids, pad, prompt_len, None
+        n_chunks = -(-prompt_len // chunk)
+        if n_chunks * chunk + reserve > self.max_seq:
+            return ids, pad, prompt_len, None
+        extra = n_chunks * chunk - prompt_len
+        if extra:
+            ids = np.concatenate(
+                [np.zeros((ids.shape[0], extra), np.int32), ids], axis=1)
+            pad = pad + extra
+        return ids, pad, n_chunks * chunk, chunk
 
     def _decode_impl(self, params: Params, first_token: jnp.ndarray,
                      cache: KVCache, pad: Optional[jnp.ndarray],
@@ -369,6 +423,9 @@ class DecodeEngine:
         ids, batch, prompt_len, key, pad = prepare_generate(
             prompt_ids, max_new_tokens, self.max_seq, sampling, key, pad=pad)
 
+        ids, pad, prompt_len, chunk = self._align_chunks(
+            ids, pad, prompt_len, reserve=max_new_tokens)
+
         ids_j = jnp.asarray(ids, dtype=jnp.int32)
         # Rectangular batches keep pad=None: the compiled programs then skip
         # the per-row mask entirely (same numerics, no [B,Sq,Skv] mask
@@ -378,7 +435,15 @@ class DecodeEngine:
         t0 = time.perf_counter()
         prefill_key, decode_key = jax.random.split(key)
         run_params = self._run_params()
-        last_logits, cache = self._prefill(run_params, ids_j, pad_j)
+        if chunk:
+            n_chunks = ids_j.shape[1] // chunk
+            chunks = ids_j.reshape(batch, n_chunks, chunk).transpose(1, 0, 2)
+            last_logits, cache = self._prefill_chunked(
+                run_params, chunks,
+                pad_j if pad_j is not None
+                else jnp.zeros((batch,), jnp.int32))
+        else:
+            last_logits, cache = self._prefill(run_params, ids_j, pad_j)
         first = select_token(last_logits, sampling, prefill_key)
         first.block_until_ready()
         t1 = time.perf_counter()

@@ -70,16 +70,19 @@ class SpecDecodeEngine:
     """
 
     def __init__(self, params: Params, config: GPT2Config, max_seq: int,
-                 dtype=jnp.float32, draft_len: int = 6, ngram: int = 2):
+                 dtype=jnp.float32, draft_len: int = 6, ngram: int = 2,
+                 prefill_chunk: Optional[int] = None):
         if draft_len < 1:
             raise ValueError("draft_len must be >= 1")
         if ngram < 1:
             raise ValueError("ngram must be >= 1")
         self.draft_len = draft_len
         self.ngram = ngram
-        # The engine owns params/cache sizing; its overflow guard also
-        # covers ours (we re-check with draft headroom in generate()).
-        self._eng = DecodeEngine(params, config, max_seq, dtype=dtype)
+        # The engine owns params/cache sizing (and chunked prefill); its
+        # overflow guard also covers ours (we re-check with draft headroom
+        # in generate()).
+        self._eng = DecodeEngine(params, config, max_seq, dtype=dtype,
+                                 prefill_chunk=prefill_chunk)
         self.config = config
         self.max_seq = max_seq
         self._loop = jax.jit(self._loop_impl,
@@ -94,9 +97,14 @@ class SpecDecodeEngine:
 
     # -- compiled verify loop ------------------------------------------------
 
-    def _loop_impl(self, params, first_token, cache, buf, total, key, *,
+    def _loop_impl(self, params, first_token, cache, buf, total, key, pad, *,
                    max_new: int, sampling: SamplingConfig):
         """(buf, total, cache) after prefill -> (buf, verify_steps).
+
+        ``pad`` is ``None`` or a ``[1]`` int32 array: the left-pad prefix
+        the chunk-aligned prefill placed in ``buf``/cache slots ``[0,
+        pad)`` — masked as attention keys and excluded from the n-gram
+        draft search (chunk padding must never become draft material).
 
         Invariant at loop entry: ``buf[:total]`` holds prompt + emitted
         tokens, ``cache.length == total - 1`` (the last emitted token has
@@ -118,14 +126,17 @@ class SpecDecodeEngine:
         buflen = buf.shape[0]
         j_arr = jnp.arange(buflen, dtype=jnp.int32)
 
+        low = jnp.int32(0) if pad is None else pad[0]
+
         def draft(buf, total, t_last):
             """Propose K tokens via most-recent n-gram match."""
             last = jax.lax.dynamic_slice(buf, (total - ngram,), (ngram,))
             match = jnp.ones((buflen,), dtype=bool)
             for t in range(ngram):
                 match = match & (jnp.roll(buf, -t) == last[t])
-            # exclude the current occurrence itself and anything past it
-            match = match & (j_arr < total - ngram)
+            # exclude the current occurrence itself, anything past it,
+            # and the left-pad prefix
+            match = match & (j_arr < total - ngram) & (j_arr >= low)
             cand = jnp.where(match, j_arr, -1)
             best = cand.max()
             found = best >= 0
@@ -172,7 +183,7 @@ class SpecDecodeEngine:
             t_last = buf[total - 1]
             drafts = draft(buf, total, t_last)
             x = jnp.concatenate([t_last[None], drafts])[None, :]  # [1, K+1]
-            logits, cache = self._eng._forward_cached(params, x, cache, None)
+            logits, cache = self._eng._forward_cached(params, x, cache, pad)
             n_accept, patch_tokens = accept_and_patch(logits[0], drafts,
                                                       step_key)
             n_emit = jnp.minimum(n_accept + 1, max_new - emitted)
@@ -227,12 +238,25 @@ class SpecDecodeEngine:
                 f"exceeds max_seq={self.max_seq}; verify writes need "
                 "draft_len slots of headroom")
 
+        # Chunk-align through the inner engine's shared helper; reserve
+        # covers upcoming tokens AND the verify write headroom.
+        ids, pad, prompt_len, chunk = self._eng._align_chunks(
+            ids, pad, prompt_len, reserve=max_new_tokens + self.draft_len)
+
         ids_j = jnp.asarray(ids, dtype=jnp.int32)
+        pad_j = jnp.asarray(pad) if pad.any() else None
         run_params = self._eng._run_params()
 
         t0 = time.perf_counter()
         prefill_key, loop_key = jax.random.split(key)
-        last_logits, cache = self._eng._prefill(run_params, ids_j, None)
+        if chunk:
+            n_chunks = ids_j.shape[1] // chunk
+            chunks = ids_j.reshape(1, n_chunks, chunk).transpose(1, 0, 2)
+            last_logits, cache = self._eng._prefill_chunked(
+                run_params, chunks,
+                pad_j if pad_j is not None else jnp.zeros((1,), jnp.int32))
+        else:
+            last_logits, cache = self._eng._prefill(run_params, ids_j, pad_j)
         first = select_token(last_logits, sampling, prefill_key)
         first.block_until_ready()
         t1 = time.perf_counter()
@@ -240,7 +264,7 @@ class SpecDecodeEngine:
         buf = jnp.zeros((self.max_seq + self.draft_len + 1,), jnp.int32)
         buf = jax.lax.dynamic_update_slice(buf, ids_j[0], (0,))
         buf, steps, _ = self._loop(run_params, first[0], cache, buf,
-                                   jnp.int32(prompt_len), loop_key,
+                                   jnp.int32(prompt_len), loop_key, pad_j,
                                    max_new=max_new_tokens, sampling=sampling)
         buf = np.asarray(jax.block_until_ready(buf))
         t2 = time.perf_counter()
@@ -251,4 +275,5 @@ class SpecDecodeEngine:
                               decode_seconds=t2 - t1,
                               new_tokens=max_new_tokens,
                               decode_steps=max_new_tokens - 1,
-                              verify_steps=int(steps))
+                              verify_steps=int(steps),
+                              pad=pad if pad.any() else None)

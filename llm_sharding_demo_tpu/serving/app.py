@@ -169,6 +169,8 @@ def create_app(cfg: Optional[ServingConfig] = None,
         # the validated dtype name passes straight through: astype/zeros
         # accept dtype strings and the engine branches on "int8" itself
         dtype = cfg.inference_dtype
+        # chunked prefill bounds compile count per prompt length; 0 -> off
+        pchunk = cfg.prefill_chunk or None
         if cfg.spec_decode > 0:
             # prompt-lookup speculation (runtime.spec_decode):
             # single-stream requests emit up to draft_len+1 tokens per
@@ -180,7 +182,8 @@ def create_app(cfg: Optional[ServingConfig] = None,
             from ..runtime.spec_decode import SpecDecodeEngine
             spec_runner = SpecDecodeEngine(params, config,
                                            max_seq=cfg.max_seq, dtype=dtype,
-                                           draft_len=cfg.spec_decode)
+                                           draft_len=cfg.spec_decode,
+                                           prefill_chunk=pchunk)
             runner = spec_runner.plain
             decode_stages = 1
         elif not partitionable:
@@ -189,19 +192,20 @@ def create_app(cfg: Optional[ServingConfig] = None,
             # pod's devices (models.family_module dispatch in the engine).
             from ..runtime.engine import DecodeEngine
             runner = DecodeEngine(params, config, max_seq=cfg.max_seq,
-                                  dtype=dtype)
+                                  dtype=dtype, prefill_chunk=pchunk)
             decode_stages = 1  # unstaged (no dense partition)
-        elif cfg.max_batch > 1 or cfg.inference_dtype == "int8":
+        elif cfg.max_batch > 1 or cfg.inference_dtype == "int8" or pchunk:
             # Continuous batching multiplexes concurrent requests onto
             # shared ragged batched decodes (runtime.batcher), riding the
             # staged DecodeEngine (single program per phase, ragged +
-            # int8 support); int8 also needs the engine (the per-device
-            # PipelineRunner casts float dtypes but doesn't quantize).
+            # int8 + chunked-prefill support); int8 and PREFILL_CHUNK
+            # also need the engine (the per-device PipelineRunner casts
+            # float dtypes but neither quantizes nor chunks its prefill).
             # The PipelineRunner stays the plain single-stream path.
             from ..runtime.engine import DecodeEngine
             runner = DecodeEngine(params, config, max_seq=cfg.max_seq,
                                   boundaries=list(cfg.boundaries),
-                                  dtype=dtype)
+                                  dtype=dtype, prefill_chunk=pchunk)
         else:
             runner = PipelineRunner(params, config, list(cfg.boundaries),
                                     max_seq=cfg.max_seq, dtype=dtype)
@@ -240,6 +244,7 @@ def create_app(cfg: Optional[ServingConfig] = None,
             "max_batch": cfg.max_batch,
             "inference_dtype": cfg.inference_dtype,
             "spec_decode": cfg.spec_decode,
+            "prefill_chunk": cfg.prefill_chunk,
             "devices": [str(d) for d in jax.devices()],
         }
 
@@ -291,7 +296,9 @@ def create_app(cfg: Optional[ServingConfig] = None,
                               max_new_tokens=req.max_new_tokens,
                               sampling=sampling,
                               key=jax.random.PRNGKey(seed))
-        return [int(t) for t in result.tokens[0]]
+        # row_tokens strips any left pad the engine introduced (chunked
+        # prefill alignment); plain runs return the row unchanged
+        return [int(t) for t in result.row_tokens(0)]
 
     def _relay(shard: str, url: str, payload: dict, key: str):
         """One shard hop with a single retry and typed failure.

@@ -49,10 +49,16 @@ class ServingConfig:
     # generations may diverge from fp32 within quantization error).
     inference_dtype: str = "float32"
     # Speculative decoding (runtime.spec_decode): >0 enables prompt-lookup
-    # speculation with this draft depth for single-stream greedy /generate
-    # requests (token-exact; sample-mode requests use the plain engine).
-    # 0 = off.
+    # speculation with this draft depth for single-stream /generate
+    # requests — token-exact in greedy mode, distribution-exact
+    # (rejection-sampled; seeded streams differ from the plain engine's,
+    # see GenerateReq.seed) in sample mode. 0 = off.
     spec_decode: int = 0
+    # Chunked prefill (runtime.engine): >0 prefills prompts in C-token
+    # chunks so the compiled-program space is bounded by chunk COUNT
+    # instead of one program per distinct prompt length (each new length
+    # otherwise pays a fresh multi-second XLA compile). 0 = off.
+    prefill_chunk: int = 0
 
     def __post_init__(self):
         if self.shard_role not in VALID_ROLES:
@@ -82,6 +88,10 @@ class ServingConfig:
             raise ValueError(
                 f"SPEC_DECODE={self.spec_decode} must be >= 0 "
                 "(0 disables, >0 is the speculation draft depth)")
+        if self.prefill_chunk < 0:
+            raise ValueError(
+                f"PREFILL_CHUNK={self.prefill_chunk} must be >= 0 "
+                "(0 disables, >0 is the chunk width in tokens)")
 
     @property
     def split_at(self) -> int:
@@ -144,4 +154,5 @@ def from_env() -> ServingConfig:
         batch_wait_ms=float(os.environ.get("BATCH_WAIT_MS", "5.0")),
         inference_dtype=os.environ.get("INFERENCE_DTYPE", "float32"),
         spec_decode=_env_int("SPEC_DECODE", 0),
+        prefill_chunk=_env_int("PREFILL_CHUNK", 0),
     )

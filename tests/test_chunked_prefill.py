@@ -1,0 +1,138 @@
+"""Chunked-prefill tests: byte-exact equivalence with monolithic prefill
+across alignment cases, engines (plain / staged / speculative / llama),
+ragged batches, and the headroom fallback.
+
+The feature bounds XLA's compile count (one program per chunk COUNT
+instead of per prompt length); correctness must never depend on which
+path runs — every test is an exact-equality oracle against the
+unchunked engine.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_sharding_demo_tpu.models import gpt2
+from llm_sharding_demo_tpu.runtime.engine import DecodeEngine, SamplingConfig
+from llm_sharding_demo_tpu.runtime.spec_decode import SpecDecodeEngine
+
+CFG = gpt2.GPT2Config(vocab_size=131, n_positions=256, n_embd=32,
+                      n_layer=2, n_head=4)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt2.init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def plain(params):
+    return DecodeEngine(params, CFG, max_seq=128)
+
+
+def test_align_chunks_paths(params):
+    eng = DecodeEngine(params, CFG, max_seq=64, prefill_chunk=8)
+    ids = np.arange(10, dtype=np.int32)[None, :]
+    pad0 = np.zeros((1,), np.int32)
+    # short prompt: monolithic
+    a_ids, _, a_len, a_chunk = eng._align_chunks(ids[:, :6], pad0, 6, 4)
+    assert a_chunk is None and a_len == 6 and a_ids.shape == (1, 6)
+    # unaligned prompt: padded up, chunk on
+    b_ids, b_pad, b_len, b_chunk = eng._align_chunks(ids, pad0, 10, 4)
+    assert b_chunk == 8 and b_len == 16 and list(b_pad) == [6]
+    assert b_ids.shape == (1, 16) and (b_ids[0, :6] == 0).all()
+    # no headroom for the alignment pad: fall back
+    c_ids, _, c_len, c_chunk = eng._align_chunks(ids, pad0, 10, 52)
+    assert c_chunk is None and c_len == 10
+
+
+@pytest.mark.parametrize("prompt_len", [9, 16, 23, 5])
+def test_chunked_greedy_equals_monolithic(params, plain, prompt_len):
+    """Every alignment case (unaligned, exact multiple, short-circuit)
+    emits the identical greedy stream."""
+    chunked = DecodeEngine(params, CFG, max_seq=128, prefill_chunk=8)
+    prompt = (np.arange(prompt_len, dtype=np.int32) * 13) % CFG.vocab_size
+    want = plain.generate(prompt, max_new_tokens=12)
+    got = chunked.generate(prompt, max_new_tokens=12)
+    np.testing.assert_array_equal(got.row_tokens(0), want.row_tokens(0))
+
+
+def test_chunked_sampled_equals_monolithic_seeded(params, plain):
+    """Chunk padding must not perturb the RNG path: the seeded sampled
+    stream is identical with and without chunking (same logits, same key
+    consumption)."""
+    chunked = DecodeEngine(params, CFG, max_seq=128, prefill_chunk=8)
+    prompt = (np.arange(11, dtype=np.int32) * 7) % CFG.vocab_size
+    s = SamplingConfig(mode="sample", temperature=0.8, top_k=9)
+    want = plain.generate(prompt, 10, sampling=s, key=jax.random.PRNGKey(3))
+    got = chunked.generate(prompt, 10, sampling=s, key=jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(got.row_tokens(0), want.row_tokens(0))
+
+
+def test_chunked_ragged_batch(params, plain):
+    """Chunk-alignment pad stacks on top of ragged left-padding."""
+    chunked = DecodeEngine(params, CFG, max_seq=128, prefill_chunk=8)
+    rng = np.random.default_rng(4)
+    prompts = [list(rng.integers(0, CFG.vocab_size, size=(n,)))
+               for n in (9, 14, 11)]
+    got = chunked.generate(prompts, max_new_tokens=7)
+    for b, prompt in enumerate(prompts):
+        want = plain.generate(np.asarray(prompt), max_new_tokens=7)
+        np.testing.assert_array_equal(got.row_tokens(b), want.row_tokens(0))
+
+
+def test_chunked_staged_engine(params, plain):
+    chunked = DecodeEngine(params, CFG, max_seq=128, prefill_chunk=8,
+                           boundaries=[1])
+    prompt = (np.arange(13, dtype=np.int32) * 5) % CFG.vocab_size
+    want = plain.generate(prompt, max_new_tokens=9)
+    got = chunked.generate(prompt, max_new_tokens=9)
+    np.testing.assert_array_equal(got.row_tokens(0), want.row_tokens(0))
+
+
+def test_chunked_spec_decode(params, plain):
+    """Speculation over a chunk-aligned cache: pad slots masked, draft
+    search excludes the pad region, stream stays token-exact."""
+    spec = SpecDecodeEngine(params, CFG, max_seq=128, draft_len=5,
+                            prefill_chunk=8)
+    prompt = np.asarray([3, 8, 3, 8, 3, 8, 3, 8, 3], dtype=np.int32)
+    want = plain.generate(prompt, max_new_tokens=20)
+    got = spec.generate(prompt, max_new_tokens=20)
+    np.testing.assert_array_equal(got.row_tokens(0), want.row_tokens(0))
+
+
+def test_chunked_llama(plain):
+    from llm_sharding_demo_tpu.models import llama
+
+    lcfg = llama.CONFIGS["llama-tiny"]
+    lparams = llama.init_params(lcfg, jax.random.PRNGKey(1))
+    mono = DecodeEngine(lparams, lcfg, max_seq=128)
+    chunked = DecodeEngine(lparams, lcfg, max_seq=128, prefill_chunk=8)
+    prompt = (np.arange(19, dtype=np.int32) * 3) % lcfg.vocab_size
+    want = mono.generate(prompt, max_new_tokens=8)
+    got = chunked.generate(prompt, max_new_tokens=8)
+    np.testing.assert_array_equal(got.row_tokens(0), want.row_tokens(0))
+
+
+def test_serving_prefill_chunk_knob(params):
+    from llm_sharding_demo_tpu.serving.app import create_app
+    from llm_sharding_demo_tpu.serving.http import TestClient
+    from llm_sharding_demo_tpu.serving.tokenizer import ByteTokenizer
+    from llm_sharding_demo_tpu.utils.config import ServingConfig
+
+    body = {"prompt": "Hello chunked prefill world", "max_new_tokens": 6,
+            "mode": "greedy"}
+    outs = []
+    for pc in (0, 8):
+        cfg = ServingConfig(model_id="t", max_seq=64, prefill_chunk=pc,
+                            boundaries=(1,))
+        client = TestClient(create_app(cfg, model=(CFG, params),
+                                       tokenizer=ByteTokenizer()))
+        assert client.get("/healthz").json()["prefill_chunk"] == pc
+        r = client.post("/generate", json=body)
+        assert r.status_code == 200
+        outs.append(r.json()["generated"])
+    assert outs[0] == outs[1]
+    with pytest.raises(ValueError, match="PREFILL_CHUNK"):
+        ServingConfig(model_id="t", prefill_chunk=-1)

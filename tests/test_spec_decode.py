@@ -140,7 +140,7 @@ def test_spec_sample_distribution_exact(params):
         buf = jax.lax.dynamic_update_slice(buf, ids_j[0], (0,))
         buf, _, _ = spec._loop(run_params, jnp.int32(t0), cache, buf,
                                jnp.int32(len(prompt)),
-                               jax.random.PRNGKey(1000 + i),
+                               jax.random.PRNGKey(1000 + i), None,
                                max_new=2, sampling=sampling)
         counts[int(buf[len(prompt) + 1])] += 1
 
