@@ -447,6 +447,19 @@ class DecodeEngine:
         first = select_token(last_logits, sampling, prefill_key)
         first.block_until_ready()
         t1 = time.perf_counter()
+        return self._decode_and_pack(run_params, ids, pad, pad_j, first,
+                                     cache, decode_key, max_new_tokens,
+                                     sampling, prompt_len, t1 - t0)
+
+    def _decode_and_pack(self, run_params, ids, pad, pad_j, first, cache,
+                         decode_key, max_new_tokens: int,
+                         sampling: SamplingConfig, prompt_len: int,
+                         prefill_seconds: float) -> GenerateResult:
+        """Run the compiled decode scan off a prepared (first token, cache)
+        state and assemble the GenerateResult — shared by ``generate`` and
+        the prefix-cache front end (runtime.prefix_cache), which prepares
+        the prefill state its own way. Donates ``cache``."""
+        t1 = time.perf_counter()
         new, final_cache = self._decode(run_params, first, cache, pad_j,
                                         decode_key,
                                         steps=max_new_tokens, sampling=sampling)
@@ -456,7 +469,8 @@ class DecodeEngine:
 
         tokens = np.concatenate([ids, new], axis=1)
         return GenerateResult(tokens=tokens, prompt_len=prompt_len,
-                              prefill_seconds=t1 - t0, decode_seconds=t2 - t1,
+                              prefill_seconds=prefill_seconds,
+                              decode_seconds=t2 - t1,
                               new_tokens=max_new_tokens,
                               decode_steps=max_new_tokens - 1,
                               pad=pad if pad.any() else None)

@@ -158,6 +158,16 @@ def create_app(cfg: Optional[ServingConfig] = None,
             "SPEC_DECODE and MAX_BATCH>1 are mutually exclusive: "
             "speculation is a single-stream latency feature, continuous "
             "batching a multi-stream throughput one")
+    if cfg.prefix_cache > 0:
+        if not (cfg.shard_role == "coordinator" and cfg.dispatch == "local"):
+            raise ValueError(
+                f"PREFIX_CACHE={cfg.prefix_cache} applies to the "
+                "coordinator's local decode path only")
+        if cfg.max_batch > 1 or cfg.spec_decode > 0:
+            raise ValueError(
+                "PREFIX_CACHE is a single-stream plain-engine feature; "
+                "it is mutually exclusive with MAX_BATCH>1 and "
+                "SPEC_DECODE (each owns the prefill differently)")
     runner = None
     spec_runner = None
     # What /healthz reports as n_stages: the decode topology actually
@@ -194,13 +204,15 @@ def create_app(cfg: Optional[ServingConfig] = None,
             runner = DecodeEngine(params, config, max_seq=cfg.max_seq,
                                   dtype=dtype, prefill_chunk=pchunk)
             decode_stages = 1  # unstaged (no dense partition)
-        elif cfg.max_batch > 1 or cfg.inference_dtype == "int8" or pchunk:
+        elif (cfg.max_batch > 1 or cfg.inference_dtype == "int8" or pchunk
+              or cfg.prefix_cache > 0):
             # Continuous batching multiplexes concurrent requests onto
             # shared ragged batched decodes (runtime.batcher), riding the
             # staged DecodeEngine (single program per phase, ragged +
-            # int8 + chunked-prefill support); int8 and PREFILL_CHUNK
-            # also need the engine (the per-device PipelineRunner casts
-            # float dtypes but neither quantizes nor chunks its prefill).
+            # int8 + chunked-prefill support); int8, PREFILL_CHUNK, and
+            # PREFIX_CACHE also need the engine (the per-device
+            # PipelineRunner casts float dtypes but neither quantizes,
+            # chunks its prefill, nor holds reusable KV state).
             # The PipelineRunner stays the plain single-stream path.
             from ..runtime.engine import DecodeEngine
             runner = DecodeEngine(params, config, max_seq=cfg.max_seq,
@@ -209,6 +221,13 @@ def create_app(cfg: Optional[ServingConfig] = None,
         else:
             runner = PipelineRunner(params, config, list(cfg.boundaries),
                                     max_seq=cfg.max_seq, dtype=dtype)
+        if cfg.prefix_cache > 0:
+            # cross-request KV reuse (runtime.prefix_cache): wraps the
+            # plain single-stream engine built above
+            from ..runtime.prefix_cache import PrefixCachingEngine
+            runner = PrefixCachingEngine(
+                runner, capacity=cfg.prefix_cache,
+                chunk=cfg.prefill_chunk or 64)
         if cfg.max_batch > 1:
             from ..runtime.batcher import BatchingEngine
             runner = BatchingEngine(runner, max_batch=cfg.max_batch,
@@ -235,7 +254,11 @@ def create_app(cfg: Optional[ServingConfig] = None,
 
     @app.get("/healthz")
     def healthz():
+        live = {}
+        if hasattr(runner, "stats"):  # prefix cache: live hit/miss/entries
+            live["prefix_cache_stats"] = runner.stats()
         return {
+            **live,
             "status": "ok",
             "role": cfg.shard_role,
             "model": cfg.model_id,
@@ -245,6 +268,7 @@ def create_app(cfg: Optional[ServingConfig] = None,
             "inference_dtype": cfg.inference_dtype,
             "spec_decode": cfg.spec_decode,
             "prefill_chunk": cfg.prefill_chunk,
+            "prefix_cache": cfg.prefix_cache,
             "devices": [str(d) for d in jax.devices()],
         }
 

@@ -59,6 +59,11 @@ class ServingConfig:
     # instead of one program per distinct prompt length (each new length
     # otherwise pays a fresh multi-second XLA compile). 0 = off.
     prefill_chunk: int = 0
+    # Prefix caching (runtime.prefix_cache): >0 keeps up to this many KV
+    # states of previously seen prompt prefixes resident and prefills
+    # only the unseen suffix on a hit (system-prompt / chat-history
+    # reuse). Single-stream; token-exact. 0 = off.
+    prefix_cache: int = 0
 
     def __post_init__(self):
         if self.shard_role not in VALID_ROLES:
@@ -92,6 +97,10 @@ class ServingConfig:
             raise ValueError(
                 f"PREFILL_CHUNK={self.prefill_chunk} must be >= 0 "
                 "(0 disables, >0 is the chunk width in tokens)")
+        if self.prefix_cache < 0:
+            raise ValueError(
+                f"PREFIX_CACHE={self.prefix_cache} must be >= 0 "
+                "(0 disables, >0 is the resident-entry capacity)")
 
     @property
     def split_at(self) -> int:
@@ -155,4 +164,5 @@ def from_env() -> ServingConfig:
         inference_dtype=os.environ.get("INFERENCE_DTYPE", "float32"),
         spec_decode=_env_int("SPEC_DECODE", 0),
         prefill_chunk=_env_int("PREFILL_CHUNK", 0),
+        prefix_cache=_env_int("PREFIX_CACHE", 0),
     )
