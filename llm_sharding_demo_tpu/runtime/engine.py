@@ -48,11 +48,18 @@ REF_TOP_K = 40
 
 @dataclasses.dataclass(frozen=True)
 class SamplingConfig:
-    """Token-selection policy for one generate call."""
+    """Token-selection policy for one generate call.
+
+    ``top_p`` (nucleus sampling, an extension beyond the reference's
+    fixed top-k) further restricts the top-k survivors to the smallest
+    prefix whose cumulative probability reaches ``top_p``; 1.0 disables
+    it, reproducing the reference's math exactly.
+    """
 
     mode: str = "greedy"  # "greedy" | "sample"
     temperature: float = REF_TEMPERATURE
     top_k: int = REF_TOP_K
+    top_p: float = 1.0
 
     def __post_init__(self):
         if self.mode not in ("greedy", "sample"):
@@ -62,24 +69,46 @@ class SamplingConfig:
                 raise ValueError("temperature must be > 0 for sampling")
             if self.top_k < 1:
                 raise ValueError("top_k must be >= 1")
+            if not 0.0 < self.top_p <= 1.0:
+                raise ValueError("top_p must be in (0, 1]")
+
+
+def sampler_pmf(logits: jnp.ndarray, sampling: SamplingConfig,
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[..., vocab] logits -> ``(probs, idx)`` each ``[..., k]``: the
+    sampler's distribution over the top-k survivors, descending.
+
+    THE single definition of the sampling distribution — ``select_token``
+    draws from it and speculative decoding's rejection sampler accepts
+    against it, so the two paths cannot drift apart. Temperature + top-k
+    mirror the reference (server.py:187-205); ``top_p`` then zeroes
+    survivors outside the smallest prefix with cumulative mass >= top_p
+    (the first survivor always stays) and renormalizes.
+    """
+    scaled = logits.astype(jnp.float32) / sampling.temperature
+    top_vals, top_idx = jax.lax.top_k(scaled, sampling.top_k)
+    probs = jax.nn.softmax(top_vals, axis=-1)          # descending
+    if sampling.top_p < 1.0:
+        cum_before = jnp.cumsum(probs, axis=-1) - probs
+        keep = cum_before < sampling.top_p             # keeps index 0 always
+        probs = jnp.where(keep, probs, 0.0)
+        probs = probs / probs.sum(axis=-1, keepdims=True)
+    return probs, top_idx
 
 
 def select_token(logits: jnp.ndarray, sampling: SamplingConfig,
                  key: Optional[jax.Array]) -> jnp.ndarray:
     """[B, vocab] last-position logits -> [B] int32 next tokens, on device.
 
-    Greedy is plain argmax. Sample mode reproduces the reference's math
-    (scale by 1/temperature, keep top-k, softmax over the k survivors,
-    multinomial — server.py:187-205) as one fused device computation:
-    ``lax.top_k`` + categorical over the k logits, mapped back through the
-    top-k indices.
+    Greedy is plain argmax. Sample mode draws from ``sampler_pmf`` — the
+    reference's temperature/top-k math (server.py:187-205) plus optional
+    nucleus filtering — as one fused device computation (categorical over
+    the k survivors, mapped back through the top-k indices).
     """
     if sampling.mode == "greedy":
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    scaled = logits.astype(jnp.float32) / sampling.temperature
-    top_vals, top_idx = jax.lax.top_k(scaled, sampling.top_k)  # [B, k] each
-    # categorical over the k survivors == softmax + multinomial(1)
-    choice = jax.random.categorical(key, top_vals, axis=-1)     # [B]
+    probs, top_idx = sampler_pmf(logits, sampling)
+    choice = jax.random.categorical(key, jnp.log(probs), axis=-1)   # [B]
     return jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
 
 

@@ -53,7 +53,7 @@ import numpy as np
 
 from ..models.gpt2 import GPT2Config, Params
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
-                     prepare_generate, select_token)
+                     prepare_generate, sampler_pmf, select_token)
 
 
 class SpecDecodeEngine:
@@ -157,9 +157,10 @@ class SpecDecodeEngine:
                 # greedy[j] is the token after x[j]; the bonus at the first
                 # mismatch position is greedy itself, so patch == greedy
                 return jnp.cumprod(hits).sum(), greedy
-            scaled = logits.astype(jnp.float32) / sampling.temperature
-            top_vals, top_idx = jax.lax.top_k(scaled, sampling.top_k)
-            probs = jax.nn.softmax(top_vals, axis=-1)        # [K+1, k]
+            # THE sampler distribution (engine.sampler_pmf: temperature +
+            # top-k + optional nucleus) — shared with select_token so
+            # acceptance probabilities and the plain sampler cannot drift
+            probs, top_idx = sampler_pmf(logits, sampling)   # [K+1, k]
             k_acc, k_res = jax.random.split(step_key)
             in_topk = top_idx[:K] == drafts[:, None]         # [K, k]
             p_d = (probs[:K] * in_topk).sum(-1)              # [K]

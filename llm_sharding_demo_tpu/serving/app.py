@@ -77,6 +77,15 @@ class GenerateReq(BaseModel):
     mode: str = "sample"
     temperature: float = REF_TEMPERATURE
     top_k: int = REF_TOP_K
+    # nucleus sampling within the top-k survivors; 1.0 = off (pure
+    # reference math)
+    top_p: float = 1.0
+    # stop early (truncate) at the tokenizer's EOS token, or at an
+    # explicit ``eos_token_id``. Off by default: the reference always
+    # emits exactly max_new_tokens (server.py:169), so parity mode does
+    # too.
+    stop_at_eos: bool = False
+    eos_token_id: Optional[int] = None
     # Seed reproducibility contract: the same (prompt, params, seed) on
     # the SAME server configuration replays the same stream. Across
     # configurations the stream may legitimately differ while the
@@ -302,7 +311,8 @@ def create_app(cfg: Optional[ServingConfig] = None,
         sampling = (SamplingConfig(mode="greedy") if req.mode == "greedy"
                     else SamplingConfig(mode="sample",
                                         temperature=req.temperature,
-                                        top_k=req.top_k))
+                                        top_k=req.top_k,
+                                        top_p=req.top_p))
         seed = req.seed if req.seed is not None else int(
             np.random.default_rng().integers(2 ** 31))
         # Speculation serves only the requests it is exact and safe for:
@@ -375,10 +385,20 @@ def create_app(cfg: Optional[ServingConfig] = None,
             if req.mode == "greedy":
                 ids.append(int(np.argmax(logits)))
             else:
+                # same distribution as engine.sampler_pmf: temperature ->
+                # top-k -> optional nucleus cutoff over the descending
+                # survivors -> renormalize (numpy mirror for the
+                # reference-topology path)
                 scaled = logits / req.temperature
                 top_idx = np.argpartition(scaled, -req.top_k)[-req.top_k:]
+                order = np.argsort(scaled[top_idx])[::-1]
+                top_idx = top_idx[order]
                 probs = np.exp(scaled[top_idx] - scaled[top_idx].max())
                 probs /= probs.sum()
+                if req.top_p < 1.0:
+                    keep = (np.cumsum(probs) - probs) < req.top_p
+                    probs = np.where(keep, probs, 0.0)
+                    probs /= probs.sum()
                 ids.append(int(rng.choice(top_idx, p=probs)))
         return ids
 
@@ -402,6 +422,18 @@ def create_app(cfg: Optional[ServingConfig] = None,
                 return {"error": "temperature must be > 0"}
             if not 1 <= req.top_k <= config.vocab_size:
                 return {"error": f"top_k must be in [1, {config.vocab_size}]"}
+            if not 0.0 < req.top_p <= 1.0:
+                return {"error": "top_p must be in (0, 1]"}
+        eos_id = None
+        if req.stop_at_eos or req.eos_token_id is not None:
+            eos_id = (req.eos_token_id if req.eos_token_id is not None
+                      else getattr(tokenizer, "eos_token_id", None))
+            if eos_id is None:
+                return {"error": "stop_at_eos requested but the tokenizer "
+                                 "has no eos_token_id; pass eos_token_id "
+                                 "explicitly"}
+            if not 0 <= eos_id < config.vocab_size:
+                return {"error": f"eos_token_id {eos_id} out of vocab range"}
         with timed("generate_request_seconds", mode=req.mode,
                    dispatch=cfg.dispatch):
             if cfg.dispatch == "remote":
@@ -417,16 +449,31 @@ def create_app(cfg: Optional[ServingConfig] = None,
                                  "detail": e.detail}
             else:
                 ids = _generate_local(req, prompt_ids)
+        finish_reason = "length"
+        if eos_id is not None:
+            # truncate at the first EOS among the NEW tokens (the decode
+            # scan is fixed-length on device; stopping is a host-side
+            # truncation, the standard serving semantics)
+            new = ids[len(prompt_ids):]
+            if eos_id in new:
+                ids = ids[:len(prompt_ids) + new.index(eos_id)]
+                finish_reason = "stop"
         REGISTRY.inc("generate_requests_total", mode=req.mode)
-        REGISTRY.inc("generated_tokens_total", value=req.max_new_tokens)
+        REGISTRY.inc("generated_tokens_total",
+                     value=len(ids) - len(prompt_ids))
         log.info('{"event": "generate", "mode": "%s", "prompt_tokens": %d, '
-                 '"new_tokens": %d}', req.mode, len(prompt_ids),
-                 req.max_new_tokens)
+                 '"new_tokens": %d, "finish_reason": "%s"}', req.mode,
+                 len(prompt_ids), len(ids) - len(prompt_ids), finish_reason)
         try:
             text = tokenizer.decode(ids, skip_special_tokens=True)
         except TypeError:  # ByteTokenizer takes no HF kwargs
             text = tokenizer.decode(ids)
-        return {"generated": text}
+        out = {"generated": text}
+        if eos_id is not None:
+            # extension field, absent in parity mode so the reference's
+            # wire shape ({"generated": ...}, server.py:210) is untouched
+            out["finish_reason"] = finish_reason
+        return out
 
     return app
 

@@ -206,3 +206,54 @@ def test_sampled_generation_deterministic_given_key(hf_engine):
     c = engine.generate(prompt, 6, sampling=s, key=jax.random.PRNGKey(8))
     np.testing.assert_array_equal(a.tokens, b.tokens)
     assert a.tokens.shape == c.tokens.shape == (1, 9)
+
+
+def test_sampler_pmf_top_p_cutoff():
+    """Nucleus filter semantics: keep the smallest descending prefix whose
+    cumulative mass reaches top_p (first survivor always kept), zero the
+    rest, renormalize; top_p=1.0 is exactly the reference top-k pmf."""
+    import jax.numpy as jnp
+
+    from llm_sharding_demo_tpu.runtime.engine import SamplingConfig, sampler_pmf
+
+    # logits chosen so top-4 softmax is ~[0.6439, 0.2369, 0.0871, 0.0320]
+    logits = jnp.log(jnp.asarray([0.644, 0.237, 0.087, 0.032]))
+    base = SamplingConfig(mode="sample", temperature=1.0, top_k=4)
+    p_all, idx = sampler_pmf(logits, base)
+    np.testing.assert_allclose(np.asarray(p_all).sum(), 1.0, atol=1e-6)
+
+    # top_p=0.8: cum-before = [0, .644, .881, .968] -> keep first two
+    p_cut, _ = sampler_pmf(logits, SamplingConfig(
+        mode="sample", temperature=1.0, top_k=4, top_p=0.8))
+    p_cut = np.asarray(p_cut)
+    assert p_cut[2] == 0 and p_cut[3] == 0
+    np.testing.assert_allclose(p_cut[:2], np.asarray(p_all)[:2]
+                               / np.asarray(p_all)[:2].sum(), atol=1e-6)
+
+    # top_p below the top token's mass still keeps exactly one survivor
+    p_one, _ = sampler_pmf(logits, SamplingConfig(
+        mode="sample", temperature=1.0, top_k=4, top_p=0.1))
+    np.testing.assert_allclose(np.asarray(p_one), [1, 0, 0, 0], atol=1e-6)
+
+
+def test_empirical_top_p_sampler_matches_pmf():
+    """select_token with top_p draws from sampler_pmf's distribution."""
+    from llm_sharding_demo_tpu.runtime.engine import (SamplingConfig,
+                                                      sampler_pmf,
+                                                      select_token)
+
+    rng = np.random.default_rng(2)
+    vocab, n = 64, 4000
+    logits = rng.normal(scale=2.0, size=(vocab,)).astype(np.float32)
+    s = SamplingConfig(mode="sample", temperature=0.7, top_k=10, top_p=0.8)
+    probs, idx = sampler_pmf(jnp.asarray(logits), s)
+    pmf = np.zeros(vocab)
+    pmf[np.asarray(idx)] = np.asarray(probs)
+
+    batched = jnp.tile(jnp.asarray(logits)[None, :], (n, 1))
+    toks = np.asarray(select_token(batched, s, jax.random.PRNGKey(0)))
+    counts = np.bincount(toks, minlength=vocab)
+    assert counts[pmf == 0].sum() == 0
+    freq = counts / n
+    tol = 4 * np.sqrt(pmf * (1 - pmf) / n) + 1e-3
+    assert (np.abs(freq - pmf) <= tol).all()

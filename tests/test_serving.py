@@ -213,3 +213,35 @@ def test_shard_pod_partial_restores_from_checkpoint(model, tmp_path):
                              config, np.asarray([[5, 17, 33]]))
     np.testing.assert_allclose(hidden, np.asarray(want),
                                atol=1e-4, rtol=1e-3)
+
+
+def test_top_p_and_eos_stop(model):
+    """Nucleus sampling knob validates + works; stop_at_eos truncates at
+    the first EOS among new tokens and reports finish_reason (extension
+    fields absent in parity mode)."""
+    client = make_client(model, "coordinator")
+    r = client.post("/generate", json={"prompt": "abc", "max_new_tokens": 4,
+                                       "seed": 5, "top_p": 0.9})
+    assert r.status_code == 200 and "finish_reason" not in r.json()
+    r = client.post("/generate", json={"prompt": "abc", "top_p": 1.5})
+    assert "top_p" in r.json()["error"]
+    # ByteTokenizer has no eos_token_id -> explicit id required
+    r = client.post("/generate", json={"prompt": "abc", "stop_at_eos": True})
+    assert "eos_token_id" in r.json()["error"]
+    # greedy with an explicit EOS id: pick the token the model actually
+    # emits first so truncation fires deterministically
+    full = client.post("/generate", json={"prompt": "abc",
+                                          "max_new_tokens": 6,
+                                          "mode": "greedy"})
+    config, params = model
+    from llm_sharding_demo_tpu.runtime.engine import DecodeEngine
+    eng = DecodeEngine(params, config, max_seq=64)
+    toks = eng.generate(np.asarray([ord(c) for c in "abc"]),
+                        max_new_tokens=6).tokens[0]
+    eos = int(toks[3 + 2])  # make the 3rd new token the "EOS"
+    r = client.post("/generate", json={"prompt": "abc", "max_new_tokens": 6,
+                                       "mode": "greedy",
+                                       "eos_token_id": eos})
+    body = r.json()
+    assert body["finish_reason"] == "stop"
+    assert len(body["generated"]) < len(full.json()["generated"])

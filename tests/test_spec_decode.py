@@ -107,28 +107,34 @@ def test_spec_sample_topk1_equals_greedy(params, plain):
     np.testing.assert_array_equal(got.tokens, want)
 
 
-def test_spec_sample_distribution_exact(params):
-    """The rejection-sampled token's law equals the reference sampler pmf.
+@pytest.mark.parametrize("top_p", [1.0, 0.75])
+def test_spec_sample_distribution_exact(params, top_p):
+    """The rejection-sampled token's law equals the reference sampler pmf
+    — in the default top-k configuration (top_p=1.0, the reference's
+    math) AND under the nucleus cutoff.
 
     Drives the verify loop directly with a FIXED prefix (prompt + first
     token) so the first loop-emitted token is conditionally distributed;
-    its marginal must be softmax(top_k(logits/T)) of the model at that
-    prefix — accept-draft mass plus residual mass must recompose p
-    exactly. ~2.5k trials, tolerance ~4 sigma of a binomial frequency.
+    its marginal must recompose the sampler pmf at that prefix exactly
+    (accept-draft mass + residual mass). ~2k trials per config,
+    tolerance ~4 sigma of a binomial frequency.
     """
-    temp, top_k, n_trials = 0.8, 12, 2500
-    sampling = SamplingConfig(mode="sample", temperature=temp, top_k=top_k)
+    temp, top_k, n_trials = 0.8, 12, 2000
+    sampling = SamplingConfig(mode="sample", temperature=temp, top_k=top_k,
+                              top_p=top_p)
     spec = SpecDecodeEngine(params, CFG, max_seq=64, draft_len=4)
     prompt = np.asarray([5, 9, 5, 9, 5, 9, 5], dtype=np.int32)
     t0 = 5  # fixed first token => fixed conditioning prefix
     prefix = np.concatenate([prompt, [t0]])[None, :]
 
-    # analytic pmf of the reference sampler at the prefix
+    # analytic pmf of the sampler at the prefix (engine.sampler_pmf is
+    # itself pinned by tests/test_engine.py, incl. the nucleus cutoff)
+    from llm_sharding_demo_tpu.runtime.engine import sampler_pmf
     logits = np.asarray(gpt2.forward(
         jax.tree.map(jnp.asarray, params), jnp.asarray(prefix), CFG))[0, -1]
-    vals, idx = jax.lax.top_k(jnp.asarray(logits) / temp, top_k)
+    probs, idx = sampler_pmf(jnp.asarray(logits), sampling)
     pmf = np.zeros(CFG.vocab_size)
-    pmf[np.asarray(idx)] = np.asarray(jax.nn.softmax(vals))
+    pmf[np.asarray(idx)] = np.asarray(probs)
 
     run_params = spec._eng._run_params()
     ids_j = jnp.asarray(prompt[None, :], dtype=jnp.int32)
