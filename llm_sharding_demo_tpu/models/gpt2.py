@@ -151,13 +151,21 @@ def _block(block_params: Params, h: jnp.ndarray, n_head: int, eps: float,
            cache_k: Optional[jnp.ndarray], cache_v: Optional[jnp.ndarray],
            offset, attn_impl: str = "xla",
            k_valid_from: Optional[jnp.ndarray] = None, mesh=None,
-           mlp_fn=None,
+           mlp_fn=None, flash_prefill: bool = False,
            ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
     """One pre-LN transformer block; optionally reads/writes a KV cache slice.
 
     ``mlp_fn(block_params, m) -> mlp_out`` swaps the dense MLP for another
     feed-forward (``models.moe`` passes its routed expert MLP here), so the
     attention half — the part every family shares — exists exactly once.
+
+    ``flash_prefill`` (static) routes the CACHED path's attention through
+    the Pallas flash kernel. Callers may set it only for a fresh-cache
+    prefill (offset 0, no pad, S == full window): there the cached
+    attention is exactly plain causal attention over the new K/V, so the
+    cache write and the attention decouple — the kernel never touches the
+    cache buffers and the O(S^2) score materialization disappears at
+    long context (the engine derives the flag, runtime.engine._prefill).
     """
     a = layer_norm(h, block_params["ln_1"]["scale"], block_params["ln_1"]["bias"], eps)
     qkv = linear(a, block_params["attn"]["c_attn"]["kernel"],
@@ -184,6 +192,14 @@ def _block(block_params: Params, h: jnp.ndarray, n_head: int, eps: float,
             attn_out = causal_attention(q, k, v, q_offset=offset,
                                         k_valid_from=k_valid_from)
         new_ck = new_cv = None
+    elif flash_prefill:
+        from ..ops.flash_attention import flash_attention  # lazy import
+        new_ck = jax.lax.dynamic_update_slice(
+            cache_k, k.astype(cache_k.dtype), (0, 0, offset, 0))
+        new_cv = jax.lax.dynamic_update_slice(
+            cache_v, v.astype(cache_v.dtype), (0, 0, offset, 0))
+        attn_out = flash_attention(
+            q, k, v, interpret=jax.default_backend() != "tpu")
     else:
         attn_out, new_ck, new_cv = cached_attention(q, k, v, cache_k, cache_v,
                                                     offset, k_valid_from)
@@ -206,6 +222,7 @@ def apply_blocks(blocks: Params, h: jnp.ndarray, config: GPT2Config,
                  cache: Optional[KVCache] = None, remat: bool = False,
                  k_valid_from: Optional[jnp.ndarray] = None, mesh=None,
                  valid: Optional[jnp.ndarray] = None,
+                 flash_prefill: bool = False,
                  ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Run a stack of blocks (leading layer axis) via ``lax.scan``.
 
@@ -259,7 +276,8 @@ def apply_blocks(blocks: Params, h: jnp.ndarray, config: GPT2Config,
     def body(carry, xs):
         layer_params, ck, cv = xs
         out, new_ck, new_cv = _block(layer_params, carry, n_head, eps, ck, cv,
-                                     offset, k_valid_from=k_valid_from)
+                                     offset, k_valid_from=k_valid_from,
+                                     flash_prefill=flash_prefill)
         return out, (new_ck, new_cv)
 
     h, (new_k, new_v) = jax.lax.scan(body, h, (blocks, cache.k, cache.v))
@@ -304,6 +322,7 @@ def forward(params: Params, input_ids: jnp.ndarray,
 def forward_with_cache(params: Params, input_ids: jnp.ndarray,
                        config: GPT2Config, cache: KVCache,
                        pad: Optional[jnp.ndarray] = None,
+                       flash_prefill: bool = False,
                        ) -> Tuple[jnp.ndarray, KVCache]:
     """Cached forward (prefill when cache.length==0, decode step otherwise).
 
@@ -319,7 +338,8 @@ def forward_with_cache(params: Params, input_ids: jnp.ndarray,
     """
     if pad is None:
         h = embed(params, input_ids, cache.length)
-        h, cache = apply_blocks(params["blocks"], h, config, cache)
+        h, cache = apply_blocks(params["blocks"], h, config, cache,
+                                flash_prefill=flash_prefill)
     else:
         h = embed(params, input_ids, cache.length - pad[:, None])
         h, cache = apply_blocks(params["blocks"], h, config, cache,

@@ -132,8 +132,9 @@ def _block(block_params: Params, h: jnp.ndarray, config: LlamaConfig,
            cos: jnp.ndarray, sin: jnp.ndarray,
            cache_k: Optional[jnp.ndarray], cache_v: Optional[jnp.ndarray],
            offset, k_valid_from: Optional[jnp.ndarray] = None,
-           mesh=None) -> Tuple[jnp.ndarray, Optional[jnp.ndarray],
-                               Optional[jnp.ndarray]]:
+           mesh=None, flash_prefill: bool = False,
+           ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray],
+                      Optional[jnp.ndarray]]:
     """One pre-norm llama block; optionally reads/writes a KV cache slice."""
     a = rms_norm(h, block_params["ln_attn"]["scale"], config.rms_norm_eps)
     attn = block_params["attn"]
@@ -168,6 +169,22 @@ def _block(block_params: Params, h: jnp.ndarray, config: LlamaConfig,
             attn_out = causal_attention(q, k, v, q_offset=offset,
                                         k_valid_from=k_valid_from)
         new_ck = new_cv = None
+    elif flash_prefill:
+        # fresh-cache prefill (offset 0, no pad): cached attention is
+        # plain causal attention over the new K/V — write the cache at
+        # kv-head width, run the flash kernel on repeated heads (the
+        # kernel wants equal q/kv head counts; a one-off prefill
+        # materialization, decode still reads the narrow cache)
+        from ..ops.flash_attention import flash_attention
+        new_ck = jax.lax.dynamic_update_slice(
+            cache_k, k.astype(cache_k.dtype), (0, 0, offset, 0))
+        new_cv = jax.lax.dynamic_update_slice(
+            cache_v, v.astype(cache_v.dtype), (0, 0, offset, 0))
+        g = config.n_head // config.n_kv_head
+        kf = jnp.repeat(k, g, axis=1) if g > 1 else k
+        vf = jnp.repeat(v, g, axis=1) if g > 1 else v
+        attn_out = flash_attention(
+            q, kf, vf, interpret=jax.default_backend() != "tpu")
     else:
         attn_out, new_ck, new_cv = cached_attention(
             q, k, v, cache_k, cache_v, offset, k_valid_from)
@@ -229,6 +246,7 @@ def forward(params: Params, input_ids: jnp.ndarray, config: LlamaConfig,
 def forward_with_cache(params: Params, input_ids: jnp.ndarray,
                        config: LlamaConfig, cache: KVCache,
                        pad: Optional[jnp.ndarray] = None,
+                       flash_prefill: bool = False,
                        ) -> Tuple[jnp.ndarray, KVCache]:
     """Cached forward (prefill when cache.length==0, decode otherwise).
 
@@ -239,11 +257,15 @@ def forward_with_cache(params: Params, input_ids: jnp.ndarray,
     h = _embed(params, input_ids)
     offset = cache.length
     cos, sin = _angles(config, input_ids.shape[1], offset, pad)
+    # structural guard (mirrors gpt2): the flash branch has no pad mask,
+    # so ragged batches always take the masked cached-attention path
+    flash_prefill = flash_prefill and pad is None
 
     def body(carry, xs):
         layer_params, ck, cv = xs
         out, new_ck, new_cv = _block(layer_params, carry, config, cos, sin,
-                                     ck, cv, offset, k_valid_from=pad)
+                                     ck, cv, offset, k_valid_from=pad,
+                                     flash_prefill=flash_prefill)
         return out, (new_ck, new_cv)
 
     h, (new_k, new_v) = jax.lax.scan(body, h,

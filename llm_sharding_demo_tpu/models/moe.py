@@ -249,6 +249,7 @@ def forward(params: Params, input_ids: jnp.ndarray, config: MoEConfig,
 def forward_with_cache(params: Params, input_ids: jnp.ndarray,
                        config: MoEConfig, cache: KVCache,
                        pad: Optional[jnp.ndarray] = None,
+                       flash_prefill: bool = False,
                        ) -> Tuple[jnp.ndarray, KVCache]:
     """Cached MoE forward (prefill / incremental decode), engine-compatible.
 
@@ -266,6 +267,13 @@ def forward_with_cache(params: Params, input_ids: jnp.ndarray,
     ``capacity_factor >= n_experts / expert_top_k``); with binding capacity
     decode is the *better-quality* path (no drops), not a divergence bug.
     """
+    if flash_prefill:
+        # engine-API uniformity only: MoEConfig enforces attention_impl
+        # 'xla' (its routed MLP is the novelty, not the attention), so the
+        # engine can never derive a True flag for this family
+        raise NotImplementedError(
+            "flash prefill covers the dense families; MoEConfig enforces "
+            "attention_impl='xla'")
     if pad is None:
         h = embed(params, input_ids, cache.length)
         k_valid_from = None

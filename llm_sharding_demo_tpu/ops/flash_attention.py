@@ -45,9 +45,27 @@ NEG_INF = -1e9
 
 def _pick_block(s: int, block: int) -> int:
     block = min(block, s)
+    while s % block and block > 128:
+        block //= 2  # try halved tiles before giving up on tiling
     if s % block:
-        block = s  # ragged seq: single block (rare; GPT-2 seqs are 2^k)
+        block = s  # truly ragged (not a multiple of 128): single block
     return block
+
+
+def flash_eligible(s: int, block_q: int = 512, block_k: int = 1024) -> bool:
+    """True when the kernel tiles ``s`` without degrading to one
+    full-sequence block beyond the configured tile sizes.
+
+    The degraded fallback materializes an [s, s] fp32 score tile in VMEM —
+    fine for short sequences (the pre-flash design handled 1024) but a
+    VMEM blowup at long ragged lengths. Callers that route *arbitrary*
+    user lengths here (runtime.engine's flash prefill) must gate on this;
+    fixed benchmark/training shapes are powers of two and always pass.
+    """
+    if s <= block_k:
+        return True
+    return (_pick_block(s, block_q) <= block_q
+            and _pick_block(s, block_k) <= block_k)
 
 
 # ---------------------------------------------------------------------------

@@ -346,11 +346,17 @@ class DecodeEngine:
         return [P.make_stage_cache(s, self.config, batch, self.max_seq,
                                    self.dtype) for s in self.specs]
 
-    def _forward_cached(self, params, x, cache, pad):
-        """One cached forward — plain (fused model) or staged composition."""
+    def _forward_cached(self, params, x, cache, pad, flash_prefill=False):
+        """One cached forward — plain (fused model) or staged composition.
+
+        ``flash_prefill`` is the static fresh-cache-prefill flag (see
+        ``_prefill_impl``); the staged path ignores it (stage prefills
+        are short at current scales).
+        """
         if self.specs is None:
             return self._model.forward_with_cache(params, x, self.config,
-                                                  cache, pad)
+                                                  cache, pad,
+                                                  flash_prefill=flash_prefill)
         from ..parallel import partition as P
         new_caches = []
         for sp, spec, c in zip(params, self.specs, cache):
@@ -365,7 +371,19 @@ class DecodeEngine:
                       pad: Optional[jnp.ndarray],
                       ) -> Tuple[jnp.ndarray, KVCache]:
         cache = self._fresh_cache(ids.shape[0])
-        logits, cache = self._forward_cached(params, ids, cache, pad)
+        # Fresh-cache prefill at offset 0 with no pad mask is plain causal
+        # attention — route it through the Pallas flash kernel when the
+        # config asks for it (attention_impl="pallas"): no O(S^2) score
+        # materialization at long context. All conditions are static at
+        # trace time; flash_eligible keeps ragged user lengths the kernel
+        # cannot tile (it would fall back to one full-S VMEM block) on
+        # the XLA path.
+        from ..ops.flash_attention import flash_eligible
+        flash = (self.config.attention_impl == "pallas" and pad is None
+                 and ids.shape[1] > 1 and self.specs is None
+                 and flash_eligible(ids.shape[1]))
+        logits, cache = self._forward_cached(params, ids, cache, pad,
+                                             flash_prefill=flash)
         return logits[:, -1], cache
 
     def _prefill_chunked_impl(self, params: Params, chunks: jnp.ndarray,

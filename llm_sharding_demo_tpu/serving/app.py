@@ -369,13 +369,25 @@ def create_app(cfg: Optional[ServingConfig] = None,
                 last = e
         raise UpstreamError(shard, url, f"{type(last).__name__}: {last}")
 
-    def _generate_remote(req: GenerateReq, prompt_ids: List[int]) -> List[int]:
+    def _generate_remote(req: GenerateReq, prompt_ids: List[int],
+                         eos_id: Optional[int] = None) -> List[int]:
         """Reference-topology decode: per token, POST the full sequence to
         shard A, relay hidden states to shard B, sample host-side
         (reference server.py:169-206). O(n²) and JSON-lossy by design —
-        it exists for wire-level drop-in compatibility, not speed."""
+        it exists for wire-level drop-in compatibility, not speed.
+
+        Sampling goes through ``engine.sampler_pmf`` — THE sampler
+        definition — with a host-side ``rng.choice`` draw (seed contract:
+        one numpy draw per token, as before). Unlike the fixed-length
+        device scan, this Python loop CAN stop at EOS, saving the
+        remaining per-token HTTP round trips."""
+        from ..runtime.engine import sampler_pmf
         ids = list(prompt_ids)
         rng = np.random.default_rng(req.seed)
+        sampling = (None if req.mode == "greedy" else
+                    SamplingConfig(mode="sample",
+                                   temperature=req.temperature,
+                                   top_k=req.top_k, top_p=req.top_p))
         for _ in range(req.max_new_tokens):
             hidden = _relay("a", f"{cfg.shard_a_url}/forward",
                             {"input_ids": ids}, "hidden_states")
@@ -385,21 +397,12 @@ def create_app(cfg: Optional[ServingConfig] = None,
             if req.mode == "greedy":
                 ids.append(int(np.argmax(logits)))
             else:
-                # same distribution as engine.sampler_pmf: temperature ->
-                # top-k -> optional nucleus cutoff over the descending
-                # survivors -> renormalize (numpy mirror for the
-                # reference-topology path)
-                scaled = logits / req.temperature
-                top_idx = np.argpartition(scaled, -req.top_k)[-req.top_k:]
-                order = np.argsort(scaled[top_idx])[::-1]
-                top_idx = top_idx[order]
-                probs = np.exp(scaled[top_idx] - scaled[top_idx].max())
-                probs /= probs.sum()
-                if req.top_p < 1.0:
-                    keep = (np.cumsum(probs) - probs) < req.top_p
-                    probs = np.where(keep, probs, 0.0)
-                    probs /= probs.sum()
-                ids.append(int(rng.choice(top_idx, p=probs)))
+                probs, top_idx = sampler_pmf(jnp.asarray(logits), sampling)
+                probs = np.asarray(probs, dtype=np.float64)
+                ids.append(int(rng.choice(np.asarray(top_idx),
+                                          p=probs / probs.sum())))
+            if eos_id is not None and ids[-1] == eos_id:
+                break
         return ids
 
     @app.post("/generate")
@@ -438,7 +441,7 @@ def create_app(cfg: Optional[ServingConfig] = None,
                    dispatch=cfg.dispatch):
             if cfg.dispatch == "remote":
                 try:
-                    ids = _generate_remote(req, prompt_ids)
+                    ids = _generate_remote(req, prompt_ids, eos_id=eos_id)
                 except UpstreamError as e:
                     # typed upstream failure (the reference propagates a
                     # raw exception -> opaque 500, server.py:173-180)
