@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import (KVCache, cached_attention, causal_attention,
-                             merge_heads, split_heads)
+                             merge_heads, split_heads, write_kv)
 from ..ops.layers import gelu_new, layer_norm, linear
 
 Params = Dict[str, Any]
@@ -194,10 +194,7 @@ def _block(block_params: Params, h: jnp.ndarray, n_head: int, eps: float,
         new_ck = new_cv = None
     elif flash_prefill:
         from ..ops.flash_attention import flash_attention  # lazy import
-        new_ck = jax.lax.dynamic_update_slice(
-            cache_k, k.astype(cache_k.dtype), (0, 0, offset, 0))
-        new_cv = jax.lax.dynamic_update_slice(
-            cache_v, v.astype(cache_v.dtype), (0, 0, offset, 0))
+        new_ck, new_cv = write_kv(cache_k, cache_v, k, v, offset)
         attn_out = flash_attention(
             q, k, v, interpret=jax.default_backend() != "tpu")
     else:

@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import (KVCache, cached_attention, causal_attention,
-                             merge_heads, split_heads)
+                             merge_heads, split_heads, write_kv)
 from ..ops.layers import linear, rms_norm
 from ..ops.rope import apply_rope, rope_angles
 
@@ -176,10 +176,7 @@ def _block(block_params: Params, h: jnp.ndarray, config: LlamaConfig,
         # kernel wants equal q/kv head counts; a one-off prefill
         # materialization, decode still reads the narrow cache)
         from ..ops.flash_attention import flash_attention
-        new_ck = jax.lax.dynamic_update_slice(
-            cache_k, k.astype(cache_k.dtype), (0, 0, offset, 0))
-        new_cv = jax.lax.dynamic_update_slice(
-            cache_v, v.astype(cache_v.dtype), (0, 0, offset, 0))
+        new_ck, new_cv = write_kv(cache_k, cache_v, k, v, offset)
         g = config.n_head // config.n_kv_head
         kf = jnp.repeat(k, g, axis=1) if g > 1 else k
         vf = jnp.repeat(v, g, axis=1) if g > 1 else v

@@ -122,6 +122,21 @@ def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return out.reshape(b, h, sq, hd)
 
 
+def write_kv(cache_k: jnp.ndarray, cache_v: jnp.ndarray,
+             k_new: jnp.ndarray, v_new: jnp.ndarray, offset,
+             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """THE cache-write: new K/V [B, Hkv, S, hd] into the fixed buffers at
+    ``offset`` (cast to the cache dtype first). One definition so the
+    cached-attention path and the flash-prefill paths (which decouple the
+    write from the attention) cannot drift on index layout or dtype
+    handling."""
+    start = (0, 0, offset, 0)
+    return (jax.lax.dynamic_update_slice(cache_k,
+                                         k_new.astype(cache_k.dtype), start),
+            jax.lax.dynamic_update_slice(cache_v,
+                                         v_new.astype(cache_v.dtype), start))
+
+
 def cached_attention(q: jnp.ndarray, k_new: jnp.ndarray, v_new: jnp.ndarray,
                      cache_k: jnp.ndarray, cache_v: jnp.ndarray,
                      offset: jnp.ndarray,
@@ -141,9 +156,7 @@ def cached_attention(q: jnp.ndarray, k_new: jnp.ndarray, v_new: jnp.ndarray,
     ``causal_attention``).
     """
     s = k_new.shape[2]
-    start = (0, 0, offset, 0)
-    cache_k = jax.lax.dynamic_update_slice(cache_k, k_new.astype(cache_k.dtype), start)
-    cache_v = jax.lax.dynamic_update_slice(cache_v, v_new.astype(cache_v.dtype), start)
+    cache_k, cache_v = write_kv(cache_k, cache_v, k_new, v_new, offset)
     out = causal_attention(q, cache_k, cache_v, q_offset=offset,
                            kv_length=offset + s, k_valid_from=k_valid_from)
     return out, cache_k, cache_v
