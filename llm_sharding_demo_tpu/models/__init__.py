@@ -28,7 +28,18 @@ def family_module(config):
 
 
 def is_partitionable(config) -> bool:
-    """True when the dense GPT-2 stage partitioner applies to ``config``."""
+    """True when the reference's GPT-2 stage-shard WIRE topology applies
+    to ``config`` (/forward + /forward_b compat endpoints, remote
+    dispatch, shard-pod partial restore) — the wire-parity surface stays
+    GPT-2-only by design."""
     from . import gpt2, moe
     return (isinstance(config, gpt2.GPT2Config)
             and not isinstance(config, moe.MoEConfig))
+
+
+def is_stage_partitionable(config) -> bool:
+    """True when ``parallel.partition`` can stage this family's tree —
+    THE single staging predicate (engine and serving both consult it).
+    Dense GPT-2 and llama stage; MoE's expert tree decodes unstaged."""
+    from . import llama
+    return is_partitionable(config) or isinstance(config, llama.LlamaConfig)

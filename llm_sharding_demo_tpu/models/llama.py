@@ -223,20 +223,50 @@ def _final(params: Params, h: jnp.ndarray, config: LlamaConfig) -> jnp.ndarray:
                       preferred_element_type=jnp.float32)
 
 
+def apply_blocks(blocks: Params, h: jnp.ndarray, config: LlamaConfig,
+                 cos: jnp.ndarray, sin: jnp.ndarray,
+                 cache: Optional[KVCache] = None, remat: bool = False,
+                 k_valid_from: Optional[jnp.ndarray] = None, mesh=None,
+                 flash_prefill: bool = False,
+                 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
+    """Run a stack of llama blocks (leading layer axis) via ``lax.scan`` —
+    the llama sibling of ``gpt2.apply_blocks``, factored out so the
+    pipeline partitioner (parallel.partition) can run a STAGE's block
+    slice with its stage-local cache."""
+    if cache is None:
+        def body(carry, layer_params):
+            out, _, _ = _block(layer_params, carry, config, cos, sin,
+                               None, None, 0, k_valid_from=k_valid_from,
+                               mesh=mesh)
+            return out, None
+
+        if remat:
+            body = jax.checkpoint(body)
+        h, _ = jax.lax.scan(body, h, blocks)
+        return h, None
+
+    offset = cache.length
+
+    def body(carry, xs):
+        layer_params, ck, cv = xs
+        out, new_ck, new_cv = _block(layer_params, carry, config, cos, sin,
+                                     ck, cv, offset,
+                                     k_valid_from=k_valid_from,
+                                     flash_prefill=flash_prefill)
+        return out, (new_ck, new_cv)
+
+    h, (new_k, new_v) = jax.lax.scan(body, h, (blocks, cache.k, cache.v))
+    new_len = cache.length + jnp.asarray(h.shape[1], dtype=jnp.int32)
+    return h, KVCache(new_k, new_v, new_len)
+
+
 def forward(params: Params, input_ids: jnp.ndarray, config: LlamaConfig,
             remat: bool = False, mesh=None) -> jnp.ndarray:
     """Full no-cache forward: [B, S] -> [B, S, vocab] float32 logits."""
     h = _embed(params, input_ids)
     cos, sin = _angles(config, input_ids.shape[1], 0, None)
-
-    def body(carry, layer_params):
-        out, _, _ = _block(layer_params, carry, config, cos, sin,
-                           None, None, 0, mesh=mesh)
-        return out, None
-
-    if remat:
-        body = jax.checkpoint(body)
-    h, _ = jax.lax.scan(body, h, params["blocks"])
+    h, _ = apply_blocks(params["blocks"], h, config, cos, sin,
+                        remat=remat, mesh=mesh)
     return _final(params, h, config)
 
 
@@ -257,18 +287,9 @@ def forward_with_cache(params: Params, input_ids: jnp.ndarray,
     # structural guard (mirrors gpt2): the flash branch has no pad mask,
     # so ragged batches always take the masked cached-attention path
     flash_prefill = flash_prefill and pad is None
-
-    def body(carry, xs):
-        layer_params, ck, cv = xs
-        out, new_ck, new_cv = _block(layer_params, carry, config, cos, sin,
-                                     ck, cv, offset, k_valid_from=pad,
-                                     flash_prefill=flash_prefill)
-        return out, (new_ck, new_cv)
-
-    h, (new_k, new_v) = jax.lax.scan(body, h,
-                                     (params["blocks"], cache.k, cache.v))
-    new_len = cache.length + jnp.asarray(h.shape[1], dtype=jnp.int32)
-    return _final(params, h, config), KVCache(new_k, new_v, new_len)
+    h, cache = apply_blocks(params["blocks"], h, config, cos, sin, cache,
+                            k_valid_from=pad, flash_prefill=flash_prefill)
+    return _final(params, h, config), cache
 
 
 def make_cache(config: LlamaConfig, batch: int, max_seq: int,

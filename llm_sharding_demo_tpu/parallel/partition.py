@@ -126,18 +126,27 @@ def _slice_blocks(blocks: Params, start: int, end: int) -> Params:
 def extract_stage_params(params: Params, spec: StageSpec) -> Params:
     """The parameter subset one stage actually needs (and nothing more).
 
-    First stage: embeddings + its blocks. Last stage: its blocks + ln_f +
-    the tied head (``wte``). Middle stages: blocks only. Contrast with the
+    First stage: embeddings + its blocks. Last stage: its blocks + the
+    final norm and head. Middle stages: blocks only. Contrast with the
     reference, where every pod loads and keeps the full model
     (server.py:40-42, 108-110).
+
+    Family is detected structurally: the llama tree carries an untied
+    ``lm_head`` (and no ``wpe``); the GPT-2/MoE tree ties its head to
+    ``wte``.
     """
     out: Params = {"blocks": _slice_blocks(params["blocks"], spec.start, spec.end)}
+    llama_tree = "lm_head" in params
     if spec.is_first:
         out["wte"] = params["wte"]
-        out["wpe"] = params["wpe"]
+        if not llama_tree:
+            out["wpe"] = params["wpe"]
     if spec.is_last:
         out["ln_f"] = params["ln_f"]
-        out["wte_out"] = params["wte"]  # tied LM head
+        if llama_tree:
+            out["lm_head"] = params["lm_head"]
+        else:
+            out["wte_out"] = params["wte"]  # tied LM head
     return out
 
 
@@ -166,6 +175,9 @@ def stage_apply(stage_params: Params, spec: StageSpec, config: GPT2Config,
     models.gpt2.forward_with_cache): it shifts positions down per row and
     masks each row's pad prefix as keys.
     """
+    from ..models.llama import LlamaConfig
+    if isinstance(config, LlamaConfig):
+        return _stage_apply_llama(stage_params, spec, config, x, cache, pad)
     position_offset = cache.length if cache is not None else 0
     if pad is not None:
         position_offset = position_offset - pad[:, None]
@@ -178,13 +190,33 @@ def stage_apply(stage_params: Params, spec: StageSpec, config: GPT2Config,
     return h, cache
 
 
+def _stage_apply_llama(stage_params: Params, spec: StageSpec, config,
+                       x: jnp.ndarray, cache: Optional[KVCache],
+                       pad: Optional[jnp.ndarray],
+                       ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
+    """llama stage: RoPE angles derive from the stage cache's length (the
+    same same-for-all-stages offset the dense path derives), embedding on
+    the first stage, RMSNorm + untied head on the last."""
+    from ..models import llama
+    offset = cache.length if cache is not None else 0
+    cos, sin = llama._angles(config, x.shape[1], offset, pad)
+    h = llama._embed(stage_params, x) if spec.is_first else x
+    h, cache = llama.apply_blocks(stage_params["blocks"], h, config,
+                                  cos, sin, cache, k_valid_from=pad)
+    if spec.is_last:
+        h = llama._final(stage_params, h, config)
+    return h, cache
+
+
 def make_stage_cache(spec: StageSpec, config: GPT2Config, batch: int,
                      max_seq: int, dtype=jnp.float32) -> KVCache:
-    """A KV cache sized for one stage's block count."""
+    """A KV cache sized for one stage's block count (kv-head width for
+    GQA families — ``n_kv_head`` when the config defines it)."""
     if max_seq > config.n_positions:
         raise ValueError(
             f"max_seq={max_seq} exceeds n_positions={config.n_positions}")
-    return KVCache.create(spec.n_blocks, batch, config.n_head, max_seq,
+    heads = getattr(config, "n_kv_head", config.n_head)
+    return KVCache.create(spec.n_blocks, batch, heads, max_seq,
                           config.head_dim, dtype)
 
 

@@ -300,16 +300,16 @@ class DecodeEngine:
         self.dtype = dtype
         # Model dispatch: any family module exposing the
         # (forward_with_cache, make_cache) pair can be decoded
-        # (models.family_module — gpt2, moe, llama). Only the plain dense
-        # GPT-2 tree is partitionable by the stage extractor, so staged
-        # mode stays GPT-2-only.
-        from ..models import family_module, is_partitionable
+        # (models.family_module — gpt2, moe, llama). Stage partitioning
+        # covers the dense families (GPT-2 and llama — parallel.partition
+        # dispatches structurally); MoE's expert tree decodes unstaged.
+        from ..models import family_module, is_stage_partitionable
         self._model = family_module(config)
-        if boundaries is not None and not is_partitionable(config):
+        if boundaries is not None and not is_stage_partitionable(config):
             raise NotImplementedError(
                 "pipeline stage partitioning (boundaries) covers the "
-                f"dense GPT-2 param tree only; {type(config).__name__} "
-                "models decode unstaged")
+                f"dense GPT-2 and llama param trees; "
+                f"{type(config).__name__} models decode unstaged")
         if boundaries is None:
             self.specs = None
             self.stage_params = None

@@ -85,6 +85,11 @@ class SpecDecodeEngine:
                                  prefill_chunk=prefill_chunk)
         self.config = config
         self.max_seq = max_seq
+        import threading
+        self._stats_lock = threading.Lock()  # ThreadingHTTPServer callers
+        self._requests = 0
+        self._verifies = 0
+        self._emitted = 0
         self._loop = jax.jit(self._loop_impl,
                              static_argnames=("max_new", "sampling"),
                              donate_argnums=(2,))
@@ -92,8 +97,18 @@ class SpecDecodeEngine:
     @property
     def plain(self) -> DecodeEngine:
         """The wrapped plain engine (shared weights/compilations) — the
-        serving layer routes sample-mode and batched requests here."""
+        serving layer routes ineligible requests here."""
         return self._eng
+
+    def stats(self) -> dict:
+        """Cumulative speculation effectiveness (served at /healthz)."""
+        with self._stats_lock:
+            return {"requests": self._requests,
+                    "verify_steps": self._verifies,
+                    "emitted_tokens": self._emitted,
+                    "draft_len": self.draft_len,
+                    "tokens_per_verify": round(self._emitted
+                                               / max(self._verifies, 1), 2)}
 
     # -- compiled verify loop ------------------------------------------------
 
@@ -270,11 +285,20 @@ class SpecDecodeEngine:
         buf = np.asarray(jax.block_until_ready(buf))
         t2 = time.perf_counter()
 
+        steps_i = int(steps)
+        with self._stats_lock:
+            self._requests += 1
+            self._verifies += steps_i
+            self._emitted += max_new_tokens
+        from ..utils.metrics import REGISTRY
+        REGISTRY.inc("spec_verify_steps_total", value=steps_i)
+        REGISTRY.inc("spec_emitted_tokens_total", value=max_new_tokens)
+
         tokens = buf[None, :prompt_len + max_new_tokens]
         return GenerateResult(tokens=tokens, prompt_len=prompt_len,
                               prefill_seconds=t1 - t0,
                               decode_seconds=t2 - t1,
                               new_tokens=max_new_tokens,
                               decode_steps=max_new_tokens - 1,
-                              verify_steps=int(steps),
+                              verify_steps=steps_i,
                               pad=pad if pad.any() else None)

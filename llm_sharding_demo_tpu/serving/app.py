@@ -135,11 +135,14 @@ def create_app(cfg: Optional[ServingConfig] = None,
     #   /forward_b — the reference's ShardA/ShardB contract
     #   (server.py:51-105) regardless of how many stages /generate uses;
     # - coordinator + remote dispatch: nothing (shards hold the weights).
-    from ..models import is_partitionable
-    # The stage-shard topology (partitioner, /forward + /forward_b relay)
-    # exists for the dense GPT-2 tree only; MoE and llama models serve
-    # unstaged through /generate.
+    from ..models import is_partitionable, is_stage_partitionable
+    # Two distinct notions: ``partitionable`` is the reference's GPT-2
+    # WIRE topology (/forward + /forward_b relay, remote dispatch) —
+    # GPT-2-only by design; ``stageable`` is whether the decode engine
+    # can stage the family at all (GPT-2 and llama; MoE decodes
+    # unstaged).
     partitionable = is_partitionable(config)
+    stageable = is_stage_partitionable(config)
     if not partitionable and cfg.dispatch == "remote":
         # the remote topology relays hidden states between stage shards
         # (/forward -> /forward_b), which non-GPT-2 pods decline —
@@ -205,10 +208,10 @@ def create_app(cfg: Optional[ServingConfig] = None,
                                            prefill_chunk=pchunk)
             runner = spec_runner.plain
             decode_stages = 1
-        elif not partitionable:
-            # MoE/llama blocks aren't partitionable by the dense stage
-            # extractor; the whole model decodes as one program on the
-            # pod's devices (models.family_module dispatch in the engine).
+        elif not stageable:
+            # MoE's expert tree isn't stage-partitionable; the whole
+            # model decodes as one program on the pod's devices
+            # (models.family_module dispatch in the engine).
             from ..runtime.engine import DecodeEngine
             runner = DecodeEngine(params, config, max_seq=cfg.max_seq,
                                   dtype=dtype, prefill_chunk=pchunk)
@@ -266,6 +269,8 @@ def create_app(cfg: Optional[ServingConfig] = None,
         live = {}
         if hasattr(runner, "stats"):  # prefix cache: live hit/miss/entries
             live["prefix_cache_stats"] = runner.stats()
+        if spec_runner is not None:  # speculation: live acceptance stats
+            live["spec_decode_stats"] = spec_runner.stats()
         return {
             **live,
             "status": "ok",

@@ -128,8 +128,9 @@ def test_checkpoint_roundtrip_llama(hf_pair, tmp_path):
 
 
 def test_serving_llama(hf_pair):
-    """/generate serves the llama family (unstaged; healthz reports it);
-    stage endpoints decline."""
+    """/generate serves the llama family — staged like GPT-2 now that the
+    partitioner dispatches on the tree (default SPLIT_AT=1 -> 2 stages);
+    the GPT-2 wire-compat stage endpoints still decline."""
     from llm_sharding_demo_tpu.serving.app import create_app
     from llm_sharding_demo_tpu.serving.http import TestClient
     from llm_sharding_demo_tpu.serving.tokenizer import ByteTokenizer
@@ -139,7 +140,7 @@ def test_serving_llama(hf_pair):
     cfg = ServingConfig(model_id="llama-test", max_seq=64)
     client = TestClient(create_app(cfg, model=(config, params),
                                    tokenizer=ByteTokenizer()))
-    assert client.get("/healthz").json()["n_stages"] == 1
+    assert client.get("/healthz").json()["n_stages"] == 2
     r = client.post("/generate", json={"prompt": "Hi", "max_new_tokens": 4,
                                        "mode": "greedy"})
     assert r.status_code == 200 and isinstance(r.json()["generated"], str)
@@ -216,3 +217,62 @@ def test_llama_pallas_and_ring_attention_impls(hf_pair):
     got_r = llama.forward(params, jnp.asarray(ids_r), ring_cfg, mesh=mesh)
     np.testing.assert_allclose(np.asarray(got_r), np.asarray(want_r),
                                atol=2e-4, rtol=2e-4)
+
+
+def test_llama_staged_engine_matches_unstaged(hf_pair):
+    """Pipeline stage partitioning covers the llama tree (structural
+    dispatch in parallel.partition): staged greedy decode is byte-equal
+    to the unstaged engine, stage composition equals the full forward,
+    and stage caches allocate at kv-head width."""
+    from llm_sharding_demo_tpu.parallel import partition as P_
+
+    _, config, params = hf_pair
+    plain = DecodeEngine(params, config, max_seq=64)
+    staged = DecodeEngine(params, config, max_seq=64, boundaries=[1])
+    prompt = (np.arange(9, dtype=np.int32) * 13) % config.vocab_size
+    want = plain.generate(prompt, max_new_tokens=10)
+    got = staged.generate(prompt, max_new_tokens=10)
+    np.testing.assert_array_equal(got.tokens, want.tokens)
+
+    specs = P_.make_stage_specs(config.n_layer, [1, 2])
+    stage_params = P_.partition_params(params, specs)
+    assert set(stage_params[0]) == {"blocks", "wte"}
+    assert set(stage_params[1]) == {"blocks"}
+    assert set(stage_params[2]) == {"blocks", "ln_f", "lm_head"}
+    cache = P_.make_stage_cache(specs[0], config, 1, 32)
+    assert cache.k.shape[2] == config.n_kv_head  # GQA width
+
+    ids = np.asarray([[5, 17, 33, 2]])
+    x = jnp.asarray(ids)
+    for spec, sp in zip(specs, stage_params):
+        x, _ = P_.stage_apply(sp, spec, config, x)
+    full = llama.forward(params, jnp.asarray(ids), config)
+    np.testing.assert_allclose(np.asarray(x), np.asarray(full),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_serving_llama_staged_boundaries(hf_pair):
+    """BOUNDARIES now reaches the llama family through serving: a staged
+    coordinator reports its real stage count and matches the unstaged
+    server's greedy output."""
+    from llm_sharding_demo_tpu.serving.app import create_app
+    from llm_sharding_demo_tpu.serving.http import TestClient
+    from llm_sharding_demo_tpu.serving.tokenizer import ByteTokenizer
+    from llm_sharding_demo_tpu.utils.config import ServingConfig
+
+    _, config, params = hf_pair
+    body = {"prompt": "Hi", "max_new_tokens": 5, "mode": "greedy"}
+    flat = TestClient(create_app(
+        ServingConfig(model_id="lt", max_seq=64),
+        model=(config, params), tokenizer=ByteTokenizer()))
+    staged = TestClient(create_app(
+        ServingConfig(model_id="lt", max_seq=64, boundaries=(1, 2),
+                      inference_dtype="bfloat16"),
+        model=(config, params), tokenizer=ByteTokenizer()))
+    assert staged.get("/healthz").json()["n_stages"] == 3
+    r1 = flat.post("/generate", json=body)
+    r2 = staged.post("/generate", json=body)
+    assert r1.status_code == r2.status_code == 200
+    # bf16 staged vs fp32 flat may legitimately differ in tokens; assert
+    # the staged path answers; exact parity is pinned at the engine level
+    assert isinstance(r2.json()["generated"], str)

@@ -245,3 +245,38 @@ def test_top_p_and_eos_stop(model):
     body = r.json()
     assert body["finish_reason"] == "stop"
     assert len(body["generated"]) < len(full.json()["generated"])
+
+
+def test_concurrent_requests_under_prefix_cache(model):
+    """ThreadingHTTPServer serves requests concurrently; the prefix
+    cache's lock must keep the store coherent and every response correct
+    under parallel identical+distinct greedy requests."""
+    import concurrent.futures as cf
+
+    client = make_client(model, "coordinator", prefix_cache=2)
+    plain = make_client(model, "coordinator")
+    prompts = ["shared preamble A", "shared preamble B",
+               "shared preamble A tail", "shared preamble B tail"] * 3
+    want = {p: plain.post("/generate", json={
+        "prompt": p, "max_new_tokens": 5, "mode": "greedy"}).json()
+        for p in set(prompts)}
+
+    def ask(p):
+        return p, client.post("/generate", json={
+            "prompt": p, "max_new_tokens": 5, "mode": "greedy"}).json()
+
+    with cf.ThreadPoolExecutor(max_workers=6) as ex:
+        for p, got in ex.map(ask, prompts):
+            assert got == want[p], (p, got, want[p])
+    stats = client.get("/healthz").json()["prefix_cache_stats"]
+    assert stats["hits"] + stats["misses"] == len(prompts)
+
+
+def test_spec_stats_surface(model):
+    """SPEC_DECODE serving exposes live acceptance stats on /healthz."""
+    client = make_client(model, "coordinator", spec_decode=4)
+    client.post("/generate", json={"prompt": "Hi, Hi, Hi, ",
+                                   "max_new_tokens": 8, "mode": "greedy"})
+    s = client.get("/healthz").json()["spec_decode_stats"]
+    assert s["requests"] == 1 and s["verify_steps"] >= 1
+    assert s["emitted_tokens"] == 8
