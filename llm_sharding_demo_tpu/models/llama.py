@@ -228,22 +228,41 @@ def apply_blocks(blocks: Params, h: jnp.ndarray, config: LlamaConfig,
                  cache: Optional[KVCache] = None, remat: bool = False,
                  k_valid_from: Optional[jnp.ndarray] = None, mesh=None,
                  flash_prefill: bool = False,
+                 valid: Optional[jnp.ndarray] = None,
                  ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Run a stack of llama blocks (leading layer axis) via ``lax.scan`` —
     the llama sibling of ``gpt2.apply_blocks``, factored out so the
-    pipeline partitioner (parallel.partition) can run a STAGE's block
-    slice with its stage-local cache."""
+    pipeline partitioner (parallel.partition) and the GPipe schedule
+    (parallel.gpipe) can run a STAGE's block slice.
+
+    ``valid`` ([L] bool, no-cache path only) masks padding layers to
+    identity — the uneven-pipeline-stage mechanism, exactly as in
+    ``gpt2.apply_blocks``."""
     if cache is None:
-        def body(carry, layer_params):
-            out, _, _ = _block(layer_params, carry, config, cos, sin,
-                               None, None, 0, k_valid_from=k_valid_from,
-                               mesh=mesh)
-            return out, None
+        if valid is None:
+            def body(carry, layer_params):
+                out, _, _ = _block(layer_params, carry, config, cos, sin,
+                                   None, None, 0, k_valid_from=k_valid_from,
+                                   mesh=mesh)
+                return out, None
+        else:
+            blocks = (blocks, valid)
+
+            def body(carry, xs):
+                layer_params, valid_l = xs
+                out, _, _ = _block(layer_params, carry, config, cos, sin,
+                                   None, None, 0, k_valid_from=k_valid_from,
+                                   mesh=mesh)
+                return jnp.where(valid_l, out, carry), None
 
         if remat:
             body = jax.checkpoint(body)
         h, _ = jax.lax.scan(body, h, blocks)
         return h, None
+    if valid is not None:
+        raise NotImplementedError("valid masking is a no-cache (pipeline "
+                                  "training) feature; cached decode stages "
+                                  "are never padded")
 
     offset = cache.length
 

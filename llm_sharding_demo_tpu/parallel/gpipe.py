@@ -103,6 +103,21 @@ def _compiled_pipeline(mesh: Mesh, config: GPT2Config, pp_axis: str,
     """
     n_stages = mesh.shape[pp_axis]
     n_ticks = n_micro + n_stages - 1
+    # Family dispatch (static: config is in this function's cache key).
+    # llama blocks need RoPE angles; positions are 0..S-1 for the whole
+    # (no-cache) training forward, identical on every stage and tick.
+    from ..models.llama import LlamaConfig
+    is_llama = isinstance(config, LlamaConfig)
+
+    def run_blocks(blocks_local, x, valid_row):
+        if is_llama:
+            from ..models import llama
+            # same helper forward() uses: positions 0..S-1, no pad
+            cos, sin = llama._angles(config, x.shape[1], 0, None)
+            return llama.apply_blocks(blocks_local, x, config, cos, sin,
+                                      remat=remat, valid=valid_row)[0]
+        return apply_blocks(blocks_local, x, config, remat=remat,
+                            valid=valid_row)[0]
     # Bubble ticks can skip the block FLOPs via a per-core lax.cond — but
     # only when the block computation contains no cross-device collectives:
     # tp/sp shard the matmuls/sequence and XLA's partitioner inserts
@@ -141,13 +156,11 @@ def _compiled_pipeline(mesh: Mesh, config: GPT2Config, pp_axis: str,
                 active = (t >= stage) & (t < stage + n_micro)
                 y = jax.lax.cond(
                     active,
-                    lambda x: apply_blocks(blocks_local, x, config,
-                                           remat=remat, valid=valid_row)[0],
+                    lambda x: run_blocks(blocks_local, x, valid_row),
                     lambda x: x,
                     x)
             else:
-                y, _ = apply_blocks(blocks_local, x, config, remat=remat,
-                                    valid=valid_row)
+                y = run_blocks(blocks_local, x, valid_row)
             # hop to the next stage over the ICI ring; stage 0 receives
             # zeros (it is fed from h_all, never from a predecessor)
             incoming = jax.lax.ppermute(
@@ -174,15 +187,33 @@ def _compiled_pipeline(mesh: Mesh, config: GPT2Config, pp_axis: str,
         axis_names={pp_axis}))
 
 
-def stacked_block_pspecs(mesh: Mesh, pp_axis: str = "pp") -> Params:
+def stacked_block_pspecs(mesh: Mesh, pp_axis: str = "pp",
+                         llama: bool = False) -> Params:
     """PartitionSpecs for stage-major stacked blocks: stage axis on ``pp``,
     plus the Megatron tp layout (shifted one axis right of
-    ``spmd.param_pspecs`` because of the extra leading stage axis)."""
+    ``spmd.param_pspecs`` / ``spmd.llama_param_pspecs`` because of the
+    extra leading stage axis)."""
     tp = "tp" if "tp" in mesh.axis_names else None
 
     def s(*tail):
         return P(pp_axis, None, *tail)
 
+    if llama:
+        return {
+            "ln_attn": {"scale": s(None)},
+            "attn": {
+                "wq": {"kernel": s(None, tp)},
+                "wk": {"kernel": s(None, tp)},
+                "wv": {"kernel": s(None, tp)},
+                "wo": {"kernel": s(tp, None)},
+            },
+            "ln_mlp": {"scale": s(None)},
+            "mlp": {
+                "gate": {"kernel": s(None, tp)},
+                "up": {"kernel": s(None, tp)},
+                "down": {"kernel": s(tp, None)},
+            },
+        }
     return {
         "ln_1": {"scale": s(None), "bias": s(None)},
         "attn": {
@@ -197,9 +228,18 @@ def stacked_block_pspecs(mesh: Mesh, pp_axis: str = "pp") -> Params:
     }
 
 
-def shard_stacked_blocks(stacked: Params, mesh: Mesh,
-                         pp_axis: str = "pp") -> Params:
-    specs = stacked_block_pspecs(mesh, pp_axis)
+def shard_stacked_blocks(stacked: Params, mesh: Mesh, pp_axis: str = "pp",
+                         config=None) -> Params:
+    """Place stage-major stacked blocks on the mesh. Family comes from
+    ``config`` when given (the registry's dispatch object, preferred);
+    structural fallback (the llama block tree has no ``ln_1``) keeps
+    blocks-only callers working."""
+    if config is not None:
+        from ..models.llama import LlamaConfig
+        is_llama = isinstance(config, LlamaConfig)
+    else:
+        is_llama = "ln_attn" in stacked
+    specs = stacked_block_pspecs(mesh, pp_axis, llama=is_llama)
     return jax.tree_util.tree_map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         stacked, specs)

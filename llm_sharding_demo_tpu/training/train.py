@@ -156,20 +156,31 @@ def gpipe_lm_loss(params: Params, ids: jnp.ndarray, config: GPT2Config,
                   valid: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """LM loss with the blocks run pipeline-parallel (``parallel.gpipe``).
 
-    ``params`` uses the gpipe layout: ``wte``/``wpe``/``ln_f`` as usual
-    plus ``stacked_blocks`` (stage-major, sharded over ``pp``). Embed and
-    head run under plain GSPMD outside the manual pipeline program.
-    ``valid`` is the padding mask for unequal stage sizes (see
+    ``params`` uses the gpipe layout: the family's embed/head leaves
+    (GPT-2: ``wte``/``wpe``/``ln_f`` with the tied head; llama: ``wte``/
+    ``ln_f``/untied ``lm_head``) plus ``stacked_blocks`` (stage-major,
+    sharded over ``pp``) — exactly what ``GPipeTrainStep.init`` builds.
+    Embed and head run under plain GSPMD outside the manual pipeline
+    program. ``valid`` is the padding mask for unequal stage sizes (see
     ``parallel.partition.stack_stage_params_padded``).
     """
+    from ..models.llama import LlamaConfig
     from ..parallel import gpipe  # local import: avoids a cycle at package init
 
-    h = gpt2.embed(params, ids[:, :-1], 0)
+    is_llama = isinstance(config, LlamaConfig)
+    if is_llama:
+        from ..models import llama
+        h = llama._embed(params, ids[:, :-1])
+    else:
+        h = gpt2.embed(params, ids[:, :-1], 0)
     hm = gpipe.microbatch(h, n_microbatches)
     hm = gpipe.gpipe_apply_blocks(params["stacked_blocks"], hm, config, mesh,
                                   remat=remat, valid=valid)
     h = gpipe.unmicrobatch(hm)
-    logits = gpt2.final_logits(params, h, config.layer_norm_epsilon)
+    if is_llama:
+        logits = llama._final(params, h, config)
+    else:
+        logits = gpt2.final_logits(params, h, config.layer_norm_epsilon)
     losses = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), ids[:, 1:])
     return jnp.mean(losses)
@@ -236,12 +247,16 @@ class GPipeTrainStep:
             stacked = P_.stack_stage_params(params, self._specs)
         else:
             stacked, _ = P_.stack_stage_params_padded(params, self._specs)
+        # embed/head params run under plain GSPMD outside the manual
+        # program; which ones exist depends on the family tree (llama:
+        # untied lm_head, no wpe)
+        rep = spmd.replicated(self.mesh)
         gp_params: Params = {
-            "wte": jax.device_put(params["wte"], spmd.replicated(self.mesh)),
-            "wpe": jax.device_put(params["wpe"], spmd.replicated(self.mesh)),
-            "ln_f": jax.device_put(params["ln_f"], spmd.replicated(self.mesh)),
-            "stacked_blocks": gpipe.shard_stacked_blocks(stacked, self.mesh),
+            k: jax.device_put(params[k], rep)
+            for k in ("wte", "wpe", "ln_f", "lm_head") if k in params
         }
+        gp_params["stacked_blocks"] = gpipe.shard_stacked_blocks(
+            stacked, self.mesh, config=self.config)
         opt_state = self.optimizer.init(gp_params)
         return gp_params, opt_state
 
