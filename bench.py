@@ -198,13 +198,13 @@ def measure_pipeline(config, n_stages: int, prompt_len: int,
     import jax
     import jax.numpy as jnp
 
-    from llm_sharding_demo_tpu.models import gpt2
+    from llm_sharding_demo_tpu.models import family_module
     from llm_sharding_demo_tpu.parallel.ppdecode import PipelinedDecoder
     from llm_sharding_demo_tpu.parallel.spmd import make_mesh
     from llm_sharding_demo_tpu.runtime.engine import DecodeEngine
 
     dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype_name]
-    params = gpt2.init_params(config, jax.random.PRNGKey(0))
+    params = family_module(config).init_params(config, jax.random.PRNGKey(0))
     per = config.n_layer // n_stages
     boundaries = [per * i for i in range(1, n_stages)]
     max_seq = prompt_len + (STEPS_B if two_point else new_tokens)

@@ -230,16 +230,12 @@ def stacked_block_pspecs(mesh: Mesh, pp_axis: str = "pp",
 
 def shard_stacked_blocks(stacked: Params, mesh: Mesh, pp_axis: str = "pp",
                          config=None) -> Params:
-    """Place stage-major stacked blocks on the mesh. Family comes from
-    ``config`` when given (the registry's dispatch object, preferred);
-    structural fallback (the llama block tree has no ``ln_1``) keeps
-    blocks-only callers working."""
-    if config is not None:
-        from ..models.llama import LlamaConfig
-        is_llama = isinstance(config, LlamaConfig)
-    else:
-        is_llama = "ln_attn" in stacked
-    specs = stacked_block_pspecs(mesh, pp_axis, llama=is_llama)
+    """Place stage-major stacked blocks on the mesh; the family's pspec
+    table is chosen from ``config`` (GPT-2 layout when None, for
+    pre-llama callers)."""
+    from ..models.llama import LlamaConfig
+    specs = stacked_block_pspecs(mesh, pp_axis,
+                                 llama=isinstance(config, LlamaConfig))
     return jax.tree_util.tree_map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         stacked, specs)

@@ -73,6 +73,16 @@ class PipelinedDecoder:
 
         from ..ops.quant import reject_raw_int8
         reject_raw_int8(dtype)
+        # family dispatch through the registry's staging predicate: dense
+        # GPT-2 and llama pipeline; MoE (whose expert tree has no stage
+        # form) fails HERE with a clear error instead of deep in the scan
+        from ..models import is_stage_partitionable
+        from ..models.llama import LlamaConfig
+        if not is_stage_partitionable(config):
+            raise NotImplementedError(
+                f"PipelinedDecoder covers the dense GPT-2 and llama "
+                f"families; {type(config).__name__} decodes unstaged")
+        self._llama = isinstance(config, LlamaConfig)
         cast = lambda x: (x.astype(dtype)
                           if jnp.issubdtype(x.dtype, jnp.floating) else x)
         params = jax.tree.map(cast, params)
@@ -85,9 +95,8 @@ class PipelinedDecoder:
             stacked)
         rep = NamedSharding(mesh, P())
         self.shared = {
-            "wte": jax.device_put(params["wte"], rep),
-            "wpe": jax.device_put(params["wpe"], rep),
-            "ln_f": jax.device_put(params["ln_f"], rep),
+            k: jax.device_put(params[k], rep)
+            for k in ("wte", "wpe", "ln_f", "lm_head") if k in params
         }
 
         self._prefill = jax.jit(self._prefill_impl)
@@ -116,7 +125,15 @@ class PipelinedDecoder:
                 def run(args):
                     h_in, ck, cv = args
                     cache = KVCache(k=ck, v=cv, length=length)
-                    y, new_cache = apply_blocks(blocks_l, h_in, config, cache)
+                    if self._llama:
+                        from ..models import llama
+                        cos, sin = llama._angles(config, h_in.shape[1],
+                                                 length, None)
+                        y, new_cache = llama.apply_blocks(
+                            blocks_l, h_in, config, cos, sin, cache)
+                    else:
+                        y, new_cache = apply_blocks(blocks_l, h_in, config,
+                                                    cache)
                     return y, new_cache.k, new_cache.v
 
                 y, ck, cv = jax.lax.cond(stage == t, run, lambda a: a,
@@ -143,23 +160,32 @@ class PipelinedDecoder:
     # -- compiled programs ---------------------------------------------------
 
     def _fresh_cache(self, batch: int):
-        shape = (self.n_stages, self.per_stage, batch, self.config.n_head,
+        heads = getattr(self.config, "n_kv_head", self.config.n_head)
+        shape = (self.n_stages, self.per_stage, batch, heads,
                  self.max_seq, self.config.head_dim)
         sh = NamedSharding(self.mesh, P(self.pp_axis))
         return (jax.lax.with_sharding_constraint(jnp.zeros(shape, self.dtype), sh),
                 jax.lax.with_sharding_constraint(jnp.zeros(shape, self.dtype), sh))
 
-    def _head(self, h):
-        return final_logits({"ln_f": self.shared["ln_f"],
-                             "wte": self.shared["wte"]},
+    def _embed(self, shared, ids, length):
+        if self._llama:
+            from ..models import llama
+            return llama._embed(shared, ids)   # RoPE: positions in attention
+        return embed(shared, ids, length)
+
+    def _head(self, shared, h):
+        if self._llama:
+            from ..models import llama
+            return llama._final(shared, h, self.config)
+        return final_logits({"ln_f": shared["ln_f"], "wte": shared["wte"]},
                             h, self.config.layer_norm_epsilon)
 
     def _prefill_impl(self, shared, blocks, ids):
         ck, cv = self._fresh_cache(ids.shape[0])
         length = jnp.zeros((), jnp.int32)
-        h = embed(shared, ids, length)
+        h = self._embed(shared, ids, length)
         h, ck, cv = self._pp_blocks(blocks, ck, cv, h, length)
-        return self._head(h)[:, -1], ck, cv
+        return self._head(shared, h)[:, -1], ck, cv
 
     def _decode_impl(self, shared, blocks, ck, cv, first_token, length0, key,
                      *, steps: int, sampling: SamplingConfig):
@@ -168,9 +194,10 @@ class PipelinedDecoder:
 
         def body(carry, step_key):
             token, ck, cv, length = carry
-            h = embed(shared, token[:, None], length)
+            h = self._embed(shared, token[:, None], length)
             h, ck, cv = self._pp_blocks(blocks, ck, cv, h, length)
-            nxt = select_token(self._head(h)[:, -1], sampling, step_key)
+            nxt = select_token(self._head(shared, h)[:, -1], sampling,
+                               step_key)
             return (nxt, ck, cv, length + 1), nxt
 
         keys = jax.random.split(key, steps - 1)

@@ -212,8 +212,14 @@ class GPipeTrainStep:
     boundaries: Optional[Any] = None
 
     def __post_init__(self):
+        from ..models import is_stage_partitionable
         from ..parallel import partition as P_
 
+        if not is_stage_partitionable(self.config):
+            raise NotImplementedError(
+                f"GPipe covers the dense GPT-2 and llama families; "
+                f"{type(self.config).__name__} trains via its GSPMD step "
+                "(MoETrainStep)")
         if "pp" not in self.mesh.axis_names:
             raise ValueError(f"mesh {self.mesh.axis_names} has no 'pp' axis")
         pp = self.mesh.shape["pp"]
