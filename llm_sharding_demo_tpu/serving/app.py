@@ -180,6 +180,39 @@ def create_app(cfg: Optional[ServingConfig] = None,
                 "PREFIX_CACHE is a single-stream plain-engine feature; "
                 "it is mutually exclusive with MAX_BATCH>1 and "
                 "SPEC_DECODE (each owns the prefill differently)")
+    if cfg.pp_decode:
+        if not (cfg.shard_role == "coordinator" and cfg.dispatch == "local"):
+            raise ValueError("PP_DECODE applies to the coordinator's local "
+                             "decode path only")
+        if not stageable:
+            raise ValueError(
+                f"PP_DECODE requires a stage-partitionable family; "
+                f"{type(config).__name__} models decode unstaged")
+        if (cfg.max_batch > 1 or cfg.spec_decode > 0 or cfg.prefix_cache > 0
+                or cfg.inference_dtype == "int8" or cfg.prefill_chunk > 0):
+            raise ValueError(
+                "PP_DECODE is the plain multi-device decoder; it is "
+                "mutually exclusive with MAX_BATCH>1, SPEC_DECODE, "
+                "PREFIX_CACHE, INFERENCE_DTYPE=int8, and PREFILL_CHUNK "
+                "(those features own the single-device engine's programs)")
+        n_stages_cfg = len(cfg.boundaries) + 1
+        if len(jax.devices()) < n_stages_cfg:
+            raise ValueError(
+                f"PP_DECODE needs >= {n_stages_cfg} devices (one per "
+                f"stage); this pod sees {len(jax.devices())}")
+        if config.n_layer % n_stages_cfg:
+            raise ValueError(
+                f"PP_DECODE uses equal stage-major stacking: "
+                f"n_layer={config.n_layer} must divide by "
+                f"{n_stages_cfg} stages")
+        from ..parallel.partition import balanced_boundaries
+        if list(cfg.boundaries) != balanced_boundaries(
+                config.n_layer, n_stages_cfg):
+            raise ValueError(
+                f"PP_DECODE uses equal stage-major stacking: BOUNDARIES "
+                f"{list(cfg.boundaries)} must be the equal split "
+                f"{balanced_boundaries(config.n_layer, n_stages_cfg)} "
+                f"for n_layer={config.n_layer}")
     runner = None
     spec_runner = None
     # What /healthz reports as n_stages: the decode topology actually
@@ -230,6 +263,17 @@ def create_app(cfg: Optional[ServingConfig] = None,
             runner = DecodeEngine(params, config, max_seq=cfg.max_seq,
                                   boundaries=list(cfg.boundaries),
                                   dtype=dtype, prefill_chunk=pchunk)
+        elif cfg.pp_decode:
+            # one stage per device, activations hop the ICI ring inside
+            # a single compiled program per phase (parallel.ppdecode) —
+            # the TPU-native endgame of the reference's per-token HTTP
+            # topology (zero host dispatches per token)
+            from ..parallel.ppdecode import PipelinedDecoder
+            from ..parallel.spmd import make_mesh
+            n_st = len(cfg.boundaries) + 1
+            mesh = make_mesh({"pp": n_st}, jax.devices()[:n_st])
+            runner = PipelinedDecoder(params, config, mesh,
+                                      max_seq=cfg.max_seq, dtype=dtype)
         else:
             runner = PipelineRunner(params, config, list(cfg.boundaries),
                                     max_seq=cfg.max_seq, dtype=dtype)
@@ -283,6 +327,7 @@ def create_app(cfg: Optional[ServingConfig] = None,
             "spec_decode": cfg.spec_decode,
             "prefill_chunk": cfg.prefill_chunk,
             "prefix_cache": cfg.prefix_cache,
+            "pp_decode": cfg.pp_decode,
             "devices": [str(d) for d in jax.devices()],
         }
 

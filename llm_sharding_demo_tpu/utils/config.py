@@ -64,6 +64,13 @@ class ServingConfig:
     # only the unseen suffix on a hit (system-prompt / chat-history
     # reuse). Single-stream; token-exact. 0 = off.
     prefix_cache: int = 0
+    # Single-program pipelined decode (parallel.ppdecode): with >= n_stages
+    # devices visible, run each stage on its own chip and hop activations
+    # over the ICI ring inside ONE compiled program per phase — zero host
+    # dispatches per token. Requires a pod that owns the devices; off by
+    # default (the host-driven PipelineRunner / staged engine serve the
+    # single-chip case).
+    pp_decode: bool = False
 
     def __post_init__(self):
         if self.shard_role not in VALID_ROLES:
@@ -122,6 +129,18 @@ class ServingConfig:
         return self._service_url(self.shard_b_service)
 
 
+def _env_bool(name: str) -> bool:
+    """Strict boolean env parsing: unknown spellings raise at startup
+    instead of silently disabling the knob (the module's whole point)."""
+    raw = os.environ.get(name, "").strip().lower()
+    if raw in ("", "0", "false", "no", "off"):
+        return False
+    if raw in ("1", "true", "yes", "on"):
+        return True
+    raise ValueError(f"{name}={os.environ[name]!r} is not a boolean "
+                     "(use 1/0, true/false, yes/no, on/off)")
+
+
 def _env_int(name: str, default: int) -> int:
     raw = os.environ.get(name)
     if raw is None or raw == "":
@@ -165,4 +184,5 @@ def from_env() -> ServingConfig:
         spec_decode=_env_int("SPEC_DECODE", 0),
         prefill_chunk=_env_int("PREFILL_CHUNK", 0),
         prefix_cache=_env_int("PREFIX_CACHE", 0),
+        pp_decode=_env_bool("PP_DECODE"),
     )

@@ -109,3 +109,43 @@ def test_llama_pipelined_decoder_matches_engine():
     want = eng.generate(prompt, max_new_tokens=10)
     got = dec.generate(prompt, max_new_tokens=10)
     np.testing.assert_array_equal(got.tokens, want.tokens)
+
+
+def test_serving_pp_decode_knob():
+    """PP_DECODE=1 serves /generate through the shard_map+ppermute decoder
+    (one stage per device on the 8-device test mesh), byte-equal to the
+    default runner; misconfigurations refuse at startup."""
+    import jax
+    import numpy as np
+    import pytest
+
+    from llm_sharding_demo_tpu.models import gpt2
+    from llm_sharding_demo_tpu.serving.app import create_app
+    from llm_sharding_demo_tpu.serving.http import TestClient
+    from llm_sharding_demo_tpu.serving.tokenizer import ByteTokenizer
+    from llm_sharding_demo_tpu.utils.config import ServingConfig
+
+    config = gpt2.GPT2Config(vocab_size=256, n_positions=64, n_embd=32,
+                             n_layer=4, n_head=4)
+    params = gpt2.init_params(config, jax.random.PRNGKey(0))
+    body = {"prompt": "Hi, ", "max_new_tokens": 6, "mode": "greedy"}
+
+    pp = TestClient(create_app(
+        ServingConfig(model_id="t", max_seq=64, boundaries=(2,),
+                      pp_decode=True),
+        model=(config, params), tokenizer=ByteTokenizer()))
+    assert pp.get("/healthz").json()["pp_decode"] is True
+    plain = TestClient(create_app(
+        ServingConfig(model_id="t", max_seq=64, boundaries=(2,)),
+        model=(config, params), tokenizer=ByteTokenizer()))
+    assert pp.post("/generate", json=body).json() == \
+        plain.post("/generate", json=body).json()
+
+    with pytest.raises(ValueError, match="equal split"):
+        create_app(ServingConfig(model_id="t", boundaries=(1,),
+                                 pp_decode=True),
+                   model=(config, params), tokenizer=ByteTokenizer())
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        create_app(ServingConfig(model_id="t", pp_decode=True, max_batch=4,
+                                 boundaries=(2,)),
+                   model=(config, params), tokenizer=ByteTokenizer())
