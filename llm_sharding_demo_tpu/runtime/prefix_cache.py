@@ -67,12 +67,21 @@ class PrefixCachingEngine:
     """
 
     def __init__(self, engine: DecodeEngine, capacity: int = 4,
-                 chunk: int = 64):
+                 chunk: int = 64, spec=None):
+        """``spec`` (optional ``SpecDecodeEngine`` wrapping THIS
+        ``engine``) composes speculation with prefix reuse: the prefix
+        path builds the cache, the verify loop decodes off it. Requests
+        speculation can't serve (short prompts, no draft headroom) fall
+        back to the plain decode scan."""
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         if chunk < 1:
             raise ValueError("chunk must be >= 1")
+        if spec is not None and spec.plain is not engine:
+            raise ValueError("spec must wrap the same DecodeEngine (shared "
+                             "weights/programs), got a different instance")
         self._eng = engine
+        self._spec = spec
         self.capacity = capacity
         self.chunk = chunk
         self._store: "OrderedDict[Tuple[int, ...], object]" = OrderedDict()
@@ -186,9 +195,22 @@ class PrefixCachingEngine:
             first.block_until_ready()
             prefill_seconds = time.perf_counter() - t0
 
-            result = self._eng._decode_and_pack(
-                run_params, ids, pad, None, first, cache, decode_key,
-                max_new_tokens, sampling, prompt_len, prefill_seconds)
+            spec = self._spec
+            if spec is not None and spec.eligible(prompt_len,
+                                                  max_new_tokens):
+                # the prefix path's cache is right-aligned (no pad, true
+                # positions, length == prompt_len) — exactly the state the
+                # verify loop expects; it donates the cache, which is
+                # always a fresh _extend output here (stored entries were
+                # snapshotted by copy)
+                result = spec.run_loop(
+                    run_params, prompt, first, cache, prompt_len,
+                    decode_key, max_new_tokens, sampling,
+                    prefill_seconds=prefill_seconds)
+            else:
+                result = self._eng._decode_and_pack(
+                    run_params, ids, pad, None, first, cache, decode_key,
+                    max_new_tokens, sampling, prompt_len, prefill_seconds)
         return result
 
     def stats(self) -> dict:

@@ -100,6 +100,15 @@ class SpecDecodeEngine:
         serving layer routes ineligible requests here."""
         return self._eng
 
+    def eligible(self, prompt_len: int, max_new_tokens: int) -> bool:
+        """THE speculation-eligibility predicate: prompt long enough for
+        an n-gram and ``draft_len`` slots of cache headroom for verify
+        writes. The serving router and the prefix-cache front end both
+        consult this (a request that fails it decodes plain)."""
+        return (prompt_len >= self.ngram
+                and prompt_len + max_new_tokens + self.draft_len
+                <= self.max_seq)
+
     def stats(self) -> dict:
         """Cumulative speculation effectiveness (served at /healthz)."""
         with self._stats_lock:
@@ -277,8 +286,24 @@ class SpecDecodeEngine:
         first.block_until_ready()
         t1 = time.perf_counter()
 
+        return self.run_loop(run_params, ids_j[0], first, cache, prompt_len,
+                             loop_key, max_new_tokens, sampling,
+                             pad_j=pad_j, prefill_seconds=t1 - t0,
+                             pad=pad if pad.any() else None)
+
+    def run_loop(self, run_params, prompt_row, first, cache,
+                 prompt_len: int, loop_key, max_new_tokens: int,
+                 sampling: SamplingConfig, pad_j=None,
+                 prefill_seconds: float = 0.0,
+                 pad=None) -> GenerateResult:
+        """Run the compiled verify loop off a prepared prefill state and
+        assemble the result — shared by ``generate`` and the prefix-cache
+        front end (runtime.prefix_cache), which produces (first, cache)
+        its own way. Donates ``cache``; updates speculation stats."""
+        t1 = time.perf_counter()
         buf = jnp.zeros((self.max_seq + self.draft_len + 1,), jnp.int32)
-        buf = jax.lax.dynamic_update_slice(buf, ids_j[0], (0,))
+        buf = jax.lax.dynamic_update_slice(
+            buf, jnp.asarray(prompt_row, dtype=jnp.int32), (0,))
         buf, steps, _ = self._loop(run_params, first[0], cache, buf,
                                    jnp.int32(prompt_len), loop_key, pad_j,
                                    max_new=max_new_tokens, sampling=sampling)
@@ -296,9 +321,8 @@ class SpecDecodeEngine:
 
         tokens = buf[None, :prompt_len + max_new_tokens]
         return GenerateResult(tokens=tokens, prompt_len=prompt_len,
-                              prefill_seconds=t1 - t0,
+                              prefill_seconds=prefill_seconds,
                               decode_seconds=t2 - t1,
                               new_tokens=max_new_tokens,
                               decode_steps=max_new_tokens - 1,
-                              verify_steps=steps_i,
-                              pad=pad if pad.any() else None)
+                              verify_steps=steps_i, pad=pad)

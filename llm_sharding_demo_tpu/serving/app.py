@@ -175,11 +175,12 @@ def create_app(cfg: Optional[ServingConfig] = None,
             raise ValueError(
                 f"PREFIX_CACHE={cfg.prefix_cache} applies to the "
                 "coordinator's local decode path only")
-        if cfg.max_batch > 1 or cfg.spec_decode > 0:
+        if cfg.max_batch > 1:
             raise ValueError(
-                "PREFIX_CACHE is a single-stream plain-engine feature; "
-                "it is mutually exclusive with MAX_BATCH>1 and "
-                "SPEC_DECODE (each owns the prefill differently)")
+                "PREFIX_CACHE is a single-stream feature; it is mutually "
+                "exclusive with MAX_BATCH>1 (the batcher owns its own "
+                "prefill shapes). SPEC_DECODE composes: the prefix path "
+                "prefills, the verify loop decodes.")
     if cfg.pp_decode:
         if not (cfg.shard_role == "coordinator" and cfg.dispatch == "local"):
             raise ValueError("PP_DECODE applies to the coordinator's local "
@@ -279,11 +280,12 @@ def create_app(cfg: Optional[ServingConfig] = None,
                                     max_seq=cfg.max_seq, dtype=dtype)
         if cfg.prefix_cache > 0:
             # cross-request KV reuse (runtime.prefix_cache): wraps the
-            # plain single-stream engine built above
+            # plain single-stream engine built above; with SPEC_DECODE
+            # also on, the verify loop decodes off the prefix-built cache
             from ..runtime.prefix_cache import PrefixCachingEngine
             runner = PrefixCachingEngine(
                 runner, capacity=cfg.prefix_cache,
-                chunk=cfg.prefill_chunk or 64)
+                chunk=cfg.prefill_chunk or 64, spec=spec_runner)
         if cfg.max_batch > 1:
             from ..runtime.batcher import BatchingEngine
             runner = BatchingEngine(runner, max_batch=cfg.max_batch,
@@ -369,12 +371,13 @@ def create_app(cfg: Optional[ServingConfig] = None,
         # prompt at least ngram long and draft_len slots of cache headroom
         # left (greedy is token-exact, sample distribution-exact via
         # rejection sampling). Everything else uses the plain engine —
-        # same weights, just one token per forward.
+        # same weights, just one token per forward. With PREFIX_CACHE on,
+        # the prefix engine IS the entry point and applies the same spec
+        # eligibility internally (runtime.prefix_cache.generate).
         eng = runner
-        if (spec_runner is not None
-                and len(prompt_ids) >= spec_runner.ngram
-                and (len(prompt_ids) + req.max_new_tokens
-                     + spec_runner.draft_len) <= cfg.max_seq):
+        if (spec_runner is not None and cfg.prefix_cache == 0
+                and spec_runner.eligible(len(prompt_ids),
+                                         req.max_new_tokens)):
             eng = spec_runner
         result = eng.generate(np.asarray(prompt_ids),
                               max_new_tokens=req.max_new_tokens,

@@ -178,3 +178,33 @@ def test_serving_integration_with_batching():
         assert results == want
     finally:
         server.shutdown()
+
+
+def test_batching_composes_with_chunked_prefill():
+    """Bucket left-pad + chunk-alignment pad stack: batched requests
+    through a PREFILL_CHUNK engine still match solo runs exactly."""
+    config = gpt2.GPT2Config(vocab_size=211, n_positions=128, n_embd=32,
+                             n_layer=2, n_head=4)
+    params = gpt2.init_params(config, jax.random.PRNGKey(0))
+    plain = DecodeEngine(params, config, max_seq=96)
+    chunked = DecodeEngine(params, config, max_seq=96, prefill_chunk=8)
+    batcher = BatchingEngine(chunked, max_batch=4, max_wait_ms=200.0)
+
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, 211, size=(n,)) for n in (9, 13, 11, 17)]
+    want = [plain.generate(p[None, :], 6).tokens[0] for p in prompts]
+
+    results = [None] * len(prompts)
+
+    def worker(i):
+        results[i] = batcher.generate(prompts[i], 6).tokens[0]
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    for i, (got, ref) in enumerate(zip(results, want)):
+        assert got is not None, f"request {i} never completed"
+        np.testing.assert_array_equal(got, ref, err_msg=f"request {i}")

@@ -123,3 +123,59 @@ def test_serving_prefix_cache_knob(params):
         create_app(ServingConfig(model_id="t", prefix_cache=2,
                                  shard_role="a"),
                    model=(CFG, params), tokenizer=ByteTokenizer())
+
+
+def test_prefix_cache_composes_with_speculation(params, plain):
+    """Spec verify loop decoding off the prefix-built cache: greedy
+    streams byte-equal to the plain engine across cold/hit requests, and
+    BOTH subsystems actually engage (cache hits AND verify acceptance)."""
+    from llm_sharding_demo_tpu.runtime.spec_decode import SpecDecodeEngine
+
+    spec = SpecDecodeEngine(params, CFG, max_seq=192, draft_len=5)
+    pce = PrefixCachingEngine(spec.plain, capacity=2, chunk=16, spec=spec)
+
+    system = np.asarray([4, 9] * 20, dtype=np.int32)  # repetitive: spec food
+    for n_user in (6, 11, 3):
+        prompt = np.concatenate(
+            [system, np.asarray([4, 9] * n_user, dtype=np.int32)])
+        want = plain.generate(prompt, max_new_tokens=15)
+        got = pce.generate(prompt, max_new_tokens=15)
+        np.testing.assert_array_equal(got.tokens, want.tokens)
+        assert got.verify_steps is not None and got.verify_steps < 14
+    assert pce.stats()["hits"] >= 1
+    assert spec.stats()["requests"] == 3
+
+
+def test_prefix_cache_spec_mismatched_engine_rejected(params):
+    from llm_sharding_demo_tpu.runtime.spec_decode import SpecDecodeEngine
+
+    other = DecodeEngine(params, CFG, max_seq=192)
+    spec = SpecDecodeEngine(params, CFG, max_seq=192)
+    with pytest.raises(ValueError, match="same DecodeEngine"):
+        PrefixCachingEngine(other, capacity=2, spec=spec)
+
+
+def test_serving_prefix_plus_spec(params):
+    from llm_sharding_demo_tpu.serving.app import create_app
+    from llm_sharding_demo_tpu.serving.http import TestClient
+    from llm_sharding_demo_tpu.serving.tokenizer import ByteTokenizer
+    from llm_sharding_demo_tpu.utils.config import ServingConfig
+
+    # prefill_chunk=8 doubles as the prefix-cache chunk width; the
+    # default 64 would leave this short prompt with no full chunk to
+    # cache (a documented no-op, visible via the stats asserted below)
+    both = TestClient(create_app(
+        ServingConfig(model_id="t", max_seq=96, prefix_cache=2,
+                      spec_decode=4, prefill_chunk=8),
+        model=(CFG, params), tokenizer=ByteTokenizer()))
+    plain = TestClient(create_app(
+        ServingConfig(model_id="t", max_seq=96),
+        model=(CFG, params), tokenizer=ByteTokenizer()))
+    body = {"prompt": "Hi, Hi, Hi, Hi, Hi, ", "max_new_tokens": 10,
+            "mode": "greedy"}
+    assert both.post("/generate", json=body).json() == \
+        plain.post("/generate", json=body).json()
+    both.post("/generate", json=body)  # second: prefix hit + spec
+    h = both.get("/healthz").json()
+    assert h["prefix_cache_stats"]["hits"] >= 1
+    assert h["spec_decode_stats"]["requests"] >= 1
