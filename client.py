@@ -5,12 +5,13 @@ Equivalent of the reference notebook's ``generate_text`` cell
 return the JSON on 200). Differences: errors raise instead of returning a
 string that callers could mistake for model output (the reference's
 mixed-return quirk, SURVEY.md §3.5), and the decode controls our server
-adds (mode/seed) are exposed.
+adds (mode/seed/temperature/top_k/top_p/EOS stopping) are exposed.
 
 Usage:
     from client import generate_text
     generate_text("Hi, ", max_new_tokens=20)
     generate_text("Hi, ", mode="greedy", base_url="http://host:30007")
+    generate_text("Q: ...", top_p=0.9, stop_at_eos=True)
 """
 
 from __future__ import annotations
@@ -23,10 +24,32 @@ import requests
 def generate_text(prompt: str, max_new_tokens: int = 20,
                   base_url: str = "http://127.0.0.1:5000",
                   mode: str = "sample", seed: Optional[int] = None,
+                  temperature: Optional[float] = None,
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None,
+                  stop_at_eos: bool = False,
+                  eos_token_id: Optional[int] = None,
                   timeout: float = 120.0) -> str:
+    """POST /generate and return the generated text.
+
+    Omitted optional knobs are left out of the request body, so the
+    server's defaults (the reference's temperature-0.6/top-k-40 sampler,
+    no nucleus filter, no EOS stop) apply — keeping the default call
+    wire-identical to the reference notebook's.
+    """
     body = {"prompt": prompt, "max_new_tokens": max_new_tokens, "mode": mode}
     if seed is not None:
         body["seed"] = seed
+    if temperature is not None:
+        body["temperature"] = temperature
+    if top_k is not None:
+        body["top_k"] = top_k
+    if top_p is not None:
+        body["top_p"] = top_p
+    if stop_at_eos:
+        body["stop_at_eos"] = True
+    if eos_token_id is not None:
+        body["eos_token_id"] = eos_token_id
     resp = requests.post(f"{base_url}/generate", json=body, timeout=timeout)
     resp.raise_for_status()
     payload = resp.json()
@@ -45,6 +68,12 @@ if __name__ == "__main__":
     parser.add_argument("--mode", default="sample",
                         choices=("sample", "greedy"))
     parser.add_argument("--seed", type=int, default=None)
+    parser.add_argument("--temperature", type=float, default=None)
+    parser.add_argument("--top-k", type=int, default=None)
+    parser.add_argument("--top-p", type=float, default=None)
+    parser.add_argument("--stop-at-eos", action="store_true")
+    parser.add_argument("--eos-token-id", type=int, default=None)
     args = parser.parse_args()
     print(generate_text(args.prompt, args.max_new_tokens, args.url,
-                        args.mode, args.seed))
+                        args.mode, args.seed, args.temperature, args.top_k,
+                        args.top_p, args.stop_at_eos, args.eos_token_id))

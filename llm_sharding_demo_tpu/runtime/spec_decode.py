@@ -288,18 +288,24 @@ class SpecDecodeEngine:
 
         return self.run_loop(run_params, ids_j[0], first, cache, prompt_len,
                              loop_key, max_new_tokens, sampling,
-                             pad_j=pad_j, prefill_seconds=t1 - t0,
+                             prefill_seconds=t1 - t0,
                              pad=pad if pad.any() else None)
 
     def run_loop(self, run_params, prompt_row, first, cache,
                  prompt_len: int, loop_key, max_new_tokens: int,
-                 sampling: SamplingConfig, pad_j=None,
+                 sampling: SamplingConfig,
                  prefill_seconds: float = 0.0,
                  pad=None) -> GenerateResult:
         """Run the compiled verify loop off a prepared prefill state and
         assemble the result — shared by ``generate`` and the prefix-cache
         front end (runtime.prefix_cache), which produces (first, cache)
-        its own way. Donates ``cache``; updates speculation stats."""
+        its own way. Donates ``cache``; updates speculation stats.
+
+        ``pad`` ([1] numpy, optional) is the single source of the
+        left-pad prefix: the loop's device-side mask derives from it, and
+        the result reports it for output stripping — one value, no way to
+        desync the two uses."""
+        pad_j = jnp.asarray(pad) if pad is not None and pad.any() else None
         t1 = time.perf_counter()
         buf = jnp.zeros((self.max_seq + self.draft_len + 1,), jnp.int32)
         buf = jax.lax.dynamic_update_slice(
