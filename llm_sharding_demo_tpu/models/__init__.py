@@ -43,3 +43,15 @@ def is_stage_partitionable(config) -> bool:
     Dense GPT-2 and llama stage; MoE's expert tree decodes unstaged."""
     from . import llama
     return is_partitionable(config) or isinstance(config, llama.LlamaConfig)
+
+
+def is_window_independent(config) -> bool:
+    """True when a token's routing/logits do not depend on which other
+    tokens share its forward window — the property behind every
+    byte-exactness contract that replays tokens in different window
+    shapes (speculative verify windows, chunked prefill, prefix-cache
+    continuations). MoE capacity-factor routing makes tokens compete for
+    expert slots within a window, so it is window-DEPENDENT; the dense
+    families are independent."""
+    from . import moe
+    return not isinstance(config, moe.MoEConfig)

@@ -283,6 +283,19 @@ class DecodeEngine:
         if max_seq > config.n_positions:
             raise ValueError(
                 f"max_seq={max_seq} exceeds n_positions={config.n_positions}")
+        from ..models import is_window_independent
+        if prefill_chunk is not None:
+            if prefill_chunk < 1:
+                raise ValueError(
+                    f"prefill_chunk={prefill_chunk} must be >= 1")
+            if not is_window_independent(config):
+                # chunked prefill replays the prompt in C-token windows;
+                # window-dependent routing (MoE) would route them
+                # differently than the monolithic prefill, breaking the
+                # byte-exactness contract. Refuse before any weight work.
+                raise NotImplementedError(
+                    "prefill_chunk requires window-independent routing; "
+                    "MoE models prefill monolithically")
         quantize = dtype == "int8" or dtype == jnp.int8
         if quantize:
             dtype = jnp.bfloat16  # activation/KV-cache dtype under int8
@@ -321,8 +334,6 @@ class DecodeEngine:
             # the monolithic pytree keeps one set of weights resident, not
             # two (the slices are new buffers).
             self.params = None
-        if prefill_chunk is not None and prefill_chunk < 1:
-            raise ValueError(f"prefill_chunk={prefill_chunk} must be >= 1")
         self.prefill_chunk = prefill_chunk
         # Prefill allocates its cache *inside* the program (zeros are free
         # under XLA and the layout matches the decode program exactly);

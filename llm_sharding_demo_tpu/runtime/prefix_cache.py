@@ -73,6 +73,17 @@ class PrefixCachingEngine:
         path builds the cache, the verify loop decodes off it. Requests
         speculation can't serve (short prompts, no draft headroom) fall
         back to the plain decode scan."""
+        from ..models import is_window_independent
+        if not is_window_independent(engine.config):
+            # same routing-semantics gate as speculation and chunked
+            # prefill (see models.is_window_independent): a chunked
+            # continuation off a cached prefix must route identically to
+            # the monolithic prefill for byte-exactness to hold
+            raise NotImplementedError(
+                "prefix caching replays the prompt in chunk windows; MoE "
+                "capacity-factor routing is window-dependent, so the "
+                "cached path would not be token-exact — serve MoE with "
+                "the plain engine")
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         if chunk < 1:

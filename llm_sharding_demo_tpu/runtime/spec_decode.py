@@ -72,6 +72,17 @@ class SpecDecodeEngine:
     def __init__(self, params: Params, config: GPT2Config, max_seq: int,
                  dtype=jnp.float32, draft_len: int = 6, ngram: int = 2,
                  prefill_chunk: Optional[int] = None):
+        from ..models import is_window_independent
+        if not is_window_independent(config):
+            # Not an implementation gap — a semantic one: a (K+1)-token
+            # verify forward must route identically to the plain engine's
+            # single-token steps for the token-exactness guarantee to
+            # hold (see models.is_window_independent).
+            raise NotImplementedError(
+                "speculative decoding requires window-independent token "
+                "routing; MoE capacity-factor routing makes multi-token "
+                "verify windows route differently than single-token "
+                "decode steps — serve MoE with the plain engine")
         if draft_len < 1:
             raise ValueError("draft_len must be >= 1")
         if ngram < 1:

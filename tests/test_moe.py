@@ -314,3 +314,33 @@ def test_moe_serving_generate(moe_decode_model):
                                  shard_role="coordinator", max_seq=32,
                                  boundaries=(1,), dispatch="remote"),
                    model=moe_decode_model, tokenizer=ByteTokenizer())
+
+
+def test_moe_window_dependent_features_refuse_loudly():
+    """MoE capacity-factor routing is window-dependent (tokens in one
+    forward compete for expert slots), so the byte-exactness contracts of
+    speculation, prefix caching, and chunked prefill CANNOT hold — each
+    must refuse at construction rather than emit a silently different
+    stream (the divergence is real: a verify window routes differently
+    than single-token decode steps)."""
+    from llm_sharding_demo_tpu.runtime.engine import DecodeEngine
+    from llm_sharding_demo_tpu.runtime.prefix_cache import PrefixCachingEngine
+    from llm_sharding_demo_tpu.runtime.spec_decode import SpecDecodeEngine
+
+    config = moe.MoEConfig(vocab_size=97, n_positions=128, n_embd=32,
+                           n_layer=2, n_head=4, n_experts=4, expert_top_k=2)
+    params = moe.init_params(config, jax.random.PRNGKey(0))
+
+    with pytest.raises(NotImplementedError, match="window-independent"):
+        SpecDecodeEngine(params, config, max_seq=96)
+    with pytest.raises(NotImplementedError, match="window-dependent"):
+        PrefixCachingEngine(DecodeEngine(params, config, max_seq=96),
+                            capacity=2)
+    with pytest.raises(NotImplementedError, match="monolithically"):
+        DecodeEngine(params, config, max_seq=96, prefill_chunk=8)
+
+    # the plain engine remains the MoE serving path and stays correct
+    plain = DecodeEngine(params, config, max_seq=96)
+    prompt = np.asarray([3, 8] * 10, dtype=np.int32)
+    out = plain.generate(prompt, max_new_tokens=6)
+    assert out.tokens.shape == (1, 26)
