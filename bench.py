@@ -274,13 +274,13 @@ def measure_spec_decode(config, prompt_len: int,
     import jax
     import jax.numpy as jnp
 
-    from llm_sharding_demo_tpu.models import gpt2
+    from llm_sharding_demo_tpu.models import family_module
     from llm_sharding_demo_tpu.runtime.engine import DecodeEngine
     from llm_sharding_demo_tpu.runtime.spec_decode import SpecDecodeEngine
 
     dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
              "int8": "int8"}[dtype_name]
-    params = gpt2.init_params(config, jax.random.PRNGKey(0))
+    params = family_module(config).init_params(config, jax.random.PRNGKey(0))
     max_seq = min(prompt_len + s_b + draft_len, config.n_positions)
     spec = SpecDecodeEngine(params, config, max_seq=max_seq, dtype=dtype,
                             draft_len=draft_len)
@@ -464,6 +464,49 @@ def measure_uncached_jax(config, prompt_len: int, new_tokens: int,
     return None if m is None else 1.0 / m
 
 
+FULL_MATRIX_FILE = "BENCH_full_r03.json"
+_COMPACT_DROP = ("note", "traceback_tail")
+
+
+def emit(payload: dict, write_file: bool = True) -> None:
+    """Write the FULL annotated matrix to ``FULL_MATRIX_FILE`` and print a
+    COMPACT single JSON line for the driver's tail capture.
+
+    Round 2 lost half its measurement matrix: the one output line (nine
+    configs with long prose notes) outgrew the driver's tail window and
+    BENCH_r02.json recorded ``parsed: null`` (VERDICT.md missing #1). The
+    driver contract is one parseable line; the prose belongs in the
+    committed file. ``write_file=False`` (--quick smoke runs) keeps a
+    full run's committed matrix from being clobbered by a one-config
+    smoke payload.
+    """
+    import os
+    if write_file:
+        full_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 FULL_MATRIX_FILE)
+        try:
+            with open(full_path, "w") as f:
+                json.dump(payload, f, indent=2)
+                f.write("\n")
+        except OSError:
+            pass  # read-only checkout: the compact line still reports
+
+    def compact_cfg(cfg: dict) -> dict:
+        out = {}
+        for k, v in cfg.items():
+            if k in _COMPACT_DROP:
+                continue
+            if isinstance(v, str) and len(v) > 80:
+                v = v[:77] + "..."
+            out[k] = v
+        return out
+
+    compact = {k: v for k, v in payload.items() if k != "configs"}
+    compact["configs"] = [compact_cfg(c) for c in payload.get("configs", [])]
+    compact["full_matrix_file"] = FULL_MATRIX_FILE
+    print(json.dumps(compact))
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true",
@@ -514,13 +557,13 @@ def main() -> None:
     safe("cfg1_tiny_gpt2_2shard_20tok", cfg1)
 
     if args.quick:
-        print(json.dumps({
+        emit({
             "metric": "greedy_decode_throughput_tiny",
             "value": configs[0].get("tokens_per_sec"),
             "unit": "tokens/sec",
             "vs_baseline": configs[0].get("vs_baseline"),
             "configs": configs,
-        }))
+        }, write_file=False)
         return
 
     # Shared 124M baseline: the reference O(n^2) loop, 20 tokens. Guarded
@@ -691,7 +734,7 @@ def main() -> None:
 
     by_name = {c["name"]: c for c in configs}
     head = by_name.get("cfg2_gpt2_124m_2shard_single_prompt", {})
-    print(json.dumps({
+    emit({
         "metric": "greedy_decode_throughput_gpt2_124m",
         "value": head.get("engine_bf16_tokens_per_sec"),
         "unit": "tokens/sec",
@@ -700,7 +743,7 @@ def main() -> None:
         "fp32_tokens_per_sec": head.get("engine_fp32_tokens_per_sec"),
         "transfer_rtt_ms": round(rtt_ms, 1),
         "configs": configs,
-    }))
+    })
 
 
 if __name__ == "__main__":

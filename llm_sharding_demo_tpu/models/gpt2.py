@@ -29,8 +29,9 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import (KVCache, cached_attention, causal_attention,
-                             merge_heads, split_heads, write_kv)
+from ..ops.attention import (KVCache, cached_attention_inplace,
+                             causal_attention, merge_heads, split_heads,
+                             write_kv_layer)
 from ..ops.layers import gelu_new, layer_norm, linear
 
 Params = Dict[str, Any]
@@ -151,9 +152,16 @@ def _block(block_params: Params, h: jnp.ndarray, n_head: int, eps: float,
            cache_k: Optional[jnp.ndarray], cache_v: Optional[jnp.ndarray],
            offset, attn_impl: str = "xla",
            k_valid_from: Optional[jnp.ndarray] = None, mesh=None,
-           mlp_fn=None, flash_prefill: bool = False,
+           mlp_fn=None, flash_prefill: bool = False, layer_idx=None,
            ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
-    """One pre-LN transformer block; optionally reads/writes a KV cache slice.
+    """One pre-LN transformer block; optionally reads/writes the KV cache.
+
+    ``cache_k``/``cache_v`` (when given) are the FULL stacked
+    ``[L, B, H, max_seq, hd]`` buffers and ``layer_idx`` selects this
+    block's slice: the write is an in-place token-column
+    ``dynamic_update_slice`` on the loop-carried cache (see
+    ``ops.attention.write_kv_layer`` for why slice-per-layer re-stacking
+    was a full cache copy per decode step). Returns the updated stacks.
 
     ``mlp_fn(block_params, m) -> mlp_out`` swaps the dense MLP for another
     feed-forward (``models.moe`` passes its routed expert MLP here), so the
@@ -194,12 +202,13 @@ def _block(block_params: Params, h: jnp.ndarray, n_head: int, eps: float,
         new_ck = new_cv = None
     elif flash_prefill:
         from ..ops.flash_attention import flash_attention  # lazy import
-        new_ck, new_cv = write_kv(cache_k, cache_v, k, v, offset)
+        new_ck, new_cv = write_kv_layer(cache_k, cache_v, k, v, layer_idx,
+                                        offset)
         attn_out = flash_attention(
             q, k, v, interpret=jax.default_backend() != "tpu")
     else:
-        attn_out, new_ck, new_cv = cached_attention(q, k, v, cache_k, cache_v,
-                                                    offset, k_valid_from)
+        attn_out, new_ck, new_cv = cached_attention_inplace(
+            q, k, v, cache_k, cache_v, layer_idx, offset, k_valid_from)
     attn_out = linear(merge_heads(attn_out),
                       block_params["attn"]["c_proj"]["kernel"],
                       block_params["attn"]["c_proj"]["bias"])
@@ -269,15 +278,20 @@ def apply_blocks(blocks: Params, h: jnp.ndarray, config: GPT2Config,
                                   "are never padded")
 
     offset = cache.length
+    n_blocks = jax.tree_util.tree_leaves(blocks)[0].shape[0]
 
+    # Cache rides the CARRY (in-place column updates), not xs/ys — see
+    # ops.attention.write_kv_layer for the memory-behavior rationale.
     def body(carry, xs):
-        layer_params, ck, cv = xs
-        out, new_ck, new_cv = _block(layer_params, carry, n_head, eps, ck, cv,
-                                     offset, k_valid_from=k_valid_from,
-                                     flash_prefill=flash_prefill)
-        return out, (new_ck, new_cv)
+        h, K, V = carry
+        layer_params, li = xs
+        out, K, V = _block(layer_params, h, n_head, eps, K, V,
+                           offset, k_valid_from=k_valid_from,
+                           flash_prefill=flash_prefill, layer_idx=li)
+        return (out, K, V), None
 
-    h, (new_k, new_v) = jax.lax.scan(body, h, (blocks, cache.k, cache.v))
+    (h, new_k, new_v), _ = jax.lax.scan(
+        body, (h, cache.k, cache.v), (blocks, jnp.arange(n_blocks)))
     new_len = cache.length + jnp.asarray(h.shape[1], dtype=jnp.int32)
     return h, KVCache(k=new_k, v=new_v, length=new_len)
 

@@ -33,8 +33,9 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import (KVCache, cached_attention, causal_attention,
-                             merge_heads, split_heads, write_kv)
+from ..ops.attention import (KVCache, cached_attention_inplace,
+                             causal_attention, merge_heads, split_heads,
+                             write_kv_layer)
 from ..ops.layers import linear, rms_norm
 from ..ops.rope import apply_rope, rope_angles
 
@@ -132,10 +133,14 @@ def _block(block_params: Params, h: jnp.ndarray, config: LlamaConfig,
            cos: jnp.ndarray, sin: jnp.ndarray,
            cache_k: Optional[jnp.ndarray], cache_v: Optional[jnp.ndarray],
            offset, k_valid_from: Optional[jnp.ndarray] = None,
-           mesh=None, flash_prefill: bool = False,
+           mesh=None, flash_prefill: bool = False, layer_idx=None,
            ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray],
                       Optional[jnp.ndarray]]:
-    """One pre-norm llama block; optionally reads/writes a KV cache slice."""
+    """One pre-norm llama block; optionally reads/writes the KV cache.
+
+    ``cache_k``/``cache_v`` are the FULL stacked ``[L, B, Hkv, max_seq,
+    hd]`` buffers with ``layer_idx`` selecting this block's slice — the
+    in-place carry pattern (see ``ops.attention.write_kv_layer``)."""
     a = rms_norm(h, block_params["ln_attn"]["scale"], config.rms_norm_eps)
     attn = block_params["attn"]
     q = split_heads(linear(a, attn["wq"]["kernel"]), config.n_head)
@@ -176,15 +181,16 @@ def _block(block_params: Params, h: jnp.ndarray, config: LlamaConfig,
         # kernel wants equal q/kv head counts; a one-off prefill
         # materialization, decode still reads the narrow cache)
         from ..ops.flash_attention import flash_attention
-        new_ck, new_cv = write_kv(cache_k, cache_v, k, v, offset)
+        new_ck, new_cv = write_kv_layer(cache_k, cache_v, k, v, layer_idx,
+                                        offset)
         g = config.n_head // config.n_kv_head
         kf = jnp.repeat(k, g, axis=1) if g > 1 else k
         vf = jnp.repeat(v, g, axis=1) if g > 1 else v
         attn_out = flash_attention(
             q, kf, vf, interpret=jax.default_backend() != "tpu")
     else:
-        attn_out, new_ck, new_cv = cached_attention(
-            q, k, v, cache_k, cache_v, offset, k_valid_from)
+        attn_out, new_ck, new_cv = cached_attention_inplace(
+            q, k, v, cache_k, cache_v, layer_idx, offset, k_valid_from)
     h = h + linear(merge_heads(attn_out), attn["wo"]["kernel"])
     m = rms_norm(h, block_params["ln_mlp"]["scale"], config.rms_norm_eps)
     mlp = block_params["mlp"]
@@ -265,16 +271,20 @@ def apply_blocks(blocks: Params, h: jnp.ndarray, config: LlamaConfig,
                                   "are never padded")
 
     offset = cache.length
+    n_blocks = jax.tree_util.tree_leaves(blocks)[0].shape[0]
 
+    # Cache rides the CARRY (in-place column updates), not xs/ys — see
+    # ops.attention.write_kv_layer for the memory-behavior rationale.
     def body(carry, xs):
-        layer_params, ck, cv = xs
-        out, new_ck, new_cv = _block(layer_params, carry, config, cos, sin,
-                                     ck, cv, offset,
-                                     k_valid_from=k_valid_from,
-                                     flash_prefill=flash_prefill)
-        return out, (new_ck, new_cv)
+        h, K, V = carry
+        layer_params, li = xs
+        out, K, V = _block(layer_params, h, config, cos, sin, K, V, offset,
+                           k_valid_from=k_valid_from,
+                           flash_prefill=flash_prefill, layer_idx=li)
+        return (out, K, V), None
 
-    h, (new_k, new_v) = jax.lax.scan(body, h, (blocks, cache.k, cache.v))
+    (h, new_k, new_v), _ = jax.lax.scan(
+        body, (h, cache.k, cache.v), (blocks, jnp.arange(n_blocks)))
     new_len = cache.length + jnp.asarray(h.shape[1], dtype=jnp.int32)
     return h, KVCache(new_k, new_v, new_len)
 

@@ -199,9 +199,12 @@ def moe_mlp(moe_params: Params, h: jnp.ndarray, config: MoEConfig,
 def _moe_block(layer_params: Params, h: jnp.ndarray, config: MoEConfig,
                cache_k: Optional[jnp.ndarray], cache_v: Optional[jnp.ndarray],
                offset, k_valid_from: Optional[jnp.ndarray] = None,
+               layer_idx=None,
                ) -> Tuple[jnp.ndarray, jnp.ndarray,
                           Optional[jnp.ndarray], Optional[jnp.ndarray]]:
-    """One pre-LN MoE block, optionally reading/writing a KV cache slice.
+    """One pre-LN MoE block, optionally reading/writing the KV cache
+    (full stacked buffers + ``layer_idx``, the in-place carry pattern —
+    see ``ops.attention.write_kv_layer``).
 
     Delegates the attention half to ``gpt2._block`` (one implementation
     serves both families) with the dense MLP swapped for ``moe_mlp`` via
@@ -227,7 +230,8 @@ def _moe_block(layer_params: Params, h: jnp.ndarray, config: MoEConfig,
 
     h, new_ck, new_cv = gpt2_block(
         layer_params, h, config.n_head, config.layer_norm_epsilon,
-        cache_k, cache_v, offset, k_valid_from=k_valid_from, mlp_fn=mlp_fn)
+        cache_k, cache_v, offset, k_valid_from=k_valid_from, mlp_fn=mlp_fn,
+        layer_idx=layer_idx)
     return h, aux_cell[0], new_ck, new_cv
 
 
@@ -283,13 +287,15 @@ def forward_with_cache(params: Params, input_ids: jnp.ndarray,
     offset = cache.length
 
     def body(carry, xs):
-        layer_params, ck, cv = xs
-        out, _, new_ck, new_cv = _moe_block(layer_params, carry, config,
-                                            ck, cv, offset, k_valid_from)
-        return out, (new_ck, new_cv)
+        h, K, V = carry
+        layer_params, li = xs
+        out, _, K, V = _moe_block(layer_params, h, config, K, V, offset,
+                                  k_valid_from, layer_idx=li)
+        return (out, K, V), None
 
-    h, (new_k, new_v) = jax.lax.scan(body, h,
-                                     (params["blocks"], cache.k, cache.v))
+    (h, new_k, new_v), _ = jax.lax.scan(
+        body, (h, cache.k, cache.v),
+        (params["blocks"], jnp.arange(config.n_layer)))
     new_len = cache.length + jnp.asarray(h.shape[1], dtype=jnp.int32)
     cache = KVCache(k=new_k, v=new_v, length=new_len)
     return final_logits(params, h, config.layer_norm_epsilon), cache

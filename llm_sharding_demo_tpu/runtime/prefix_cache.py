@@ -96,7 +96,13 @@ class PrefixCachingEngine:
         self.capacity = capacity
         self.chunk = chunk
         self._store: "OrderedDict[Tuple[int, ...], object]" = OrderedDict()
+        # Two locks: ``_lock`` serializes device work (the donation-
+        # sensitive extend/decode programs run one generation at a time),
+        # while ``_store_lock`` guards only the store and counters — so
+        # ``stats()`` (the /healthz read) never waits out an in-flight
+        # generation's seconds of device time behind the big lock.
         self._lock = threading.Lock()
+        self._store_lock = threading.Lock()
         self.hits = 0
         self.misses = 0
         # One continuation program per ids width (the chunk width plus the
@@ -130,12 +136,13 @@ class PrefixCachingEngine:
     def _lookup(self, prompt: np.ndarray) -> Tuple[int, Optional[object]]:
         """Longest cached prefix of ``prompt`` -> (n_chunks_hit, entry)."""
         m_max = (len(prompt) - 1) // self.chunk  # leave >=1 token to forward
-        for m in range(m_max, 0, -1):
-            key = self._key(prompt, m, self.chunk)
-            entry = self._store.get(key)
-            if entry is not None:
-                self._store.move_to_end(key)
-                return m, entry
+        with self._store_lock:
+            for m in range(m_max, 0, -1):
+                key = self._key(prompt, m, self.chunk)
+                entry = self._store.get(key)
+                if entry is not None:
+                    self._store.move_to_end(key)
+                    return m, entry
         return 0, None
 
     def _insert(self, prompt: np.ndarray, m_chunks: int, cache) -> None:
@@ -144,12 +151,13 @@ class PrefixCachingEngine:
         if m_chunks < 1:
             return
         key = self._key(prompt, m_chunks, self.chunk)
-        if key in self._store:
-            self._store.move_to_end(key)
-            return
-        self._store[key] = jax.tree.map(jnp.copy, cache)
-        while len(self._store) > self.capacity:
-            self._store.popitem(last=False)
+        with self._store_lock:
+            if key in self._store:
+                self._store.move_to_end(key)
+                return
+            self._store[key] = jax.tree.map(jnp.copy, cache)
+            while len(self._store) > self.capacity:
+                self._store.popitem(last=False)
 
     def generate(self, prompt_ids, max_new_tokens: int,
                  sampling: SamplingConfig = SamplingConfig(),
@@ -168,13 +176,15 @@ class PrefixCachingEngine:
             t0 = time.perf_counter()
             m_hit, entry = self._lookup(prompt)
             if entry is not None:
-                self.hits += 1
+                with self._store_lock:
+                    self.hits += 1
                 REGISTRY.inc("prefix_cache_hits_total")
                 REGISTRY.inc("prefix_cache_reused_tokens_total",
                              value=m_hit * self.chunk)
                 cache = entry
             else:
-                self.misses += 1
+                with self._store_lock:
+                    self.misses += 1
                 REGISTRY.inc("prefix_cache_misses_total")
                 cache = self._eng._fresh_cache(1)
 
@@ -225,7 +235,7 @@ class PrefixCachingEngine:
         return result
 
     def stats(self) -> dict:
-        with self._lock:
+        with self._store_lock:
             return {"entries": len(self._store), "hits": self.hits,
                     "misses": self.misses, "capacity": self.capacity,
                     "chunk": self.chunk}

@@ -215,14 +215,22 @@ def load_stage_params(directory: str, spec: P_.StageSpec,
         return config, P_.extract_stage_params(params, spec)
     config = load_config(directory)
 
+    # Family detected structurally, mirroring extract_stage_params: the
+    # llama tree carries an untied ``lm_head`` (and no ``wpe``); the
+    # GPT-2/MoE tree ties its head to ``wte``.
+    llama_tree = "lm_head" in disk_tree
     item: dict = {"blocks": {str(i): disk_tree["blocks"][str(i)]
                              for i in range(spec.start, spec.end)}}
     if spec.is_first:
         item["wte"] = disk_tree["wte"]
-        item["wpe"] = disk_tree["wpe"]
+        if not llama_tree:
+            item["wpe"] = disk_tree["wpe"]
     if spec.is_last:
         item["ln_f"] = disk_tree["ln_f"]
-        item.setdefault("wte", disk_tree["wte"])  # tied LM head table
+        if llama_tree:
+            item["lm_head"] = disk_tree["lm_head"]
+        else:
+            item.setdefault("wte", disk_tree["wte"])  # tied LM head table
     # metadata leaves are placeholders; restore_type=np.ndarray reads each
     # array as host numpy (shape/dtype from disk) without consulting the
     # saver's sharding file — a stage pod's topology never matches the
@@ -235,8 +243,12 @@ def load_stage_params(directory: str, spec: P_.StageSpec,
     out: Params = {"blocks": _stack_blocks(got["blocks"])}
     if spec.is_first:
         out["wte"] = got["wte"]
-        out["wpe"] = got["wpe"]
+        if not llama_tree:
+            out["wpe"] = got["wpe"]
     if spec.is_last:
         out["ln_f"] = got["ln_f"]
-        out["wte_out"] = got["wte"]
+        if llama_tree:
+            out["lm_head"] = got["lm_head"]
+        else:
+            out["wte_out"] = got["wte"]
     return config, out

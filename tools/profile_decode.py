@@ -1,0 +1,160 @@
+"""Profile the batched decode step on the real bench chip.
+
+Round-3 investigation of VERDICT.md weak #1: cfg3 (GPT-2 124M, bs=8,
+bf16) measured ~2.0 ms/step vs 0.51 ms/step at bs=1 on a weight-bound
+workload (248 MB bf16 weights/step) — ~4x where theory says ~1.5x
+(the extra KV-cache read traffic at bs=8/max_seq=528 is ~156 MB).
+
+Experiments (all chained-scan programs closed by a host fetch; marginal
+over two window sizes so the tunnel's fixed ~100 ms sync cost cancels —
+see bench.py marginal_seconds):
+
+  A. batch sweep at max_seq=528           — the headline curve
+  B. max_seq sweep at bs=8                — cache-read-traffic hypothesis
+  C. component ablation at bs=1/8:
+       full step | no-attention (weights-only floor) | no-head | attn-only
+
+Usage: python tools/profile_decode.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_sharding_demo_tpu.models import gpt2
+from llm_sharding_demo_tpu.ops.attention import cached_attention
+from llm_sharding_demo_tpu.ops.layers import gelu_new, layer_norm, linear
+
+
+def _fetch(x):
+    np.asarray(jax.tree_util.tree_leaves(x)[0].ravel()[0])
+
+
+def marginal(time_window, n1=32, n2=256, reps=3):
+    time_window(n1), time_window(n2)
+    t1 = min(time_window(n1) for _ in range(reps))
+    t2 = min(time_window(n2) for _ in range(reps))
+    return (t2 - t1) / (n2 - n1)
+
+
+CFG = gpt2.CONFIGS["gpt2"]
+
+
+def decode_step_fn(params, config, variant: str):
+    """One cached decode step, with pieces knocked out per ``variant``."""
+    eps = config.layer_norm_epsilon
+    n_head = config.n_head
+
+    def step(token, cache):
+        h = gpt2.embed(params, token[:, None], cache.length)
+        offset = cache.length
+
+        def body(carry, xs):
+            layer_params, ck, cv = xs
+            a = layer_norm(carry, layer_params["ln_1"]["scale"],
+                           layer_params["ln_1"]["bias"], eps)
+            qkv = linear(a, layer_params["attn"]["c_attn"]["kernel"],
+                         layer_params["attn"]["c_attn"]["bias"])
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q, k, v = (gpt2.split_heads(x, n_head) for x in (q, k, v))
+            if variant == "no_attn":
+                attn_out, new_ck, new_cv = q, ck, cv
+            else:
+                attn_out, new_ck, new_cv = cached_attention(
+                    q, k, v, ck, cv, offset)
+            attn_out = linear(gpt2.merge_heads(attn_out),
+                              layer_params["attn"]["c_proj"]["kernel"],
+                              layer_params["attn"]["c_proj"]["bias"])
+            hh = carry + attn_out
+            if variant == "attn_only":
+                m = 0.0
+            else:
+                mm = layer_norm(hh, layer_params["ln_2"]["scale"],
+                                layer_params["ln_2"]["bias"], eps)
+                m = linear(gelu_new(linear(
+                    mm, layer_params["mlp"]["c_fc"]["kernel"],
+                    layer_params["mlp"]["c_fc"]["bias"])),
+                    layer_params["mlp"]["c_proj"]["kernel"],
+                    layer_params["mlp"]["c_proj"]["bias"])
+            return hh + m, (new_ck, new_cv)
+
+        blocks = params["blocks"]
+        h, (nk, nv) = jax.lax.scan(body, h, (blocks, cache.k, cache.v))
+        from llm_sharding_demo_tpu.ops.attention import KVCache
+        cache = KVCache(k=nk, v=nv, length=cache.length + 1)
+        if variant == "no_head":
+            nxt = h[:, -1, 0].astype(jnp.int32) % config.vocab_size
+        else:
+            logits = gpt2.final_logits(params, h, eps)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        return nxt, cache
+
+    return step
+
+
+def time_variant(params, config, batch, max_seq, variant, quick=False):
+    step = decode_step_fn(params, config, variant)
+
+    @functools.partial(jax.jit, static_argnames=("n",), donate_argnums=(1,))
+    def run(token, cache, n):
+        def body(carry, _):
+            token, cache = carry
+            nxt, cache = step(token, cache)
+            return (nxt, cache), None
+        (token, cache), _ = jax.lax.scan(body, (token, cache), None, length=n)
+        return token, cache
+
+    token = jnp.zeros((batch,), jnp.int32)
+
+    def window(n):
+        cache = gpt2.make_cache(config, batch, max_seq, jnp.bfloat16)
+        t0 = time.perf_counter()
+        out, c = run(token, cache, n)
+        _fetch(out)
+        return time.perf_counter() - t0
+
+    n1, n2 = (16, 64) if quick else (32, 256)
+    return marginal(window, n1, n2)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    params = gpt2.init_params(CFG, jax.random.PRNGKey(0))
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+
+    rows = []
+
+    def report(name, batch, max_seq, variant):
+        ms = time_variant(params, CFG, batch, max_seq, variant,
+                          args.quick) * 1e3
+        rows.append((name, batch, max_seq, variant, ms))
+        print(f"{name:34s} bs={batch} max_seq={max_seq:5d} "
+              f"{variant:10s} {ms:8.3f} ms/step "
+              f"({batch / ms * 1e3:8.0f} tok/s)", flush=True)
+
+    for b in (1, 8):
+        report("A_batch_sweep", b, 528, "full")
+    for ms_ in (64, 528, 1024):
+        report("B_cache_sweep", 8, ms_, "full")
+    for v in ("no_attn", "no_head", "attn_only"):
+        report("C_ablate_bs8", 8, 528, v)
+        report("C_ablate_bs1", 1, 528, v)
+
+
+if __name__ == "__main__":
+    main()
