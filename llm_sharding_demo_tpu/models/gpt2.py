@@ -153,6 +153,7 @@ def _block(block_params: Params, h: jnp.ndarray, n_head: int, eps: float,
            offset, attn_impl: str = "xla",
            k_valid_from: Optional[jnp.ndarray] = None, mesh=None,
            mlp_fn=None, flash_prefill: bool = False, layer_idx=None,
+           decode_kernel: Optional[str] = None,
            ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
     """One pre-LN transformer block; optionally reads/writes the KV cache.
 
@@ -200,6 +201,30 @@ def _block(block_params: Params, h: jnp.ndarray, n_head: int, eps: float,
             attn_out = causal_attention(q, k, v, q_offset=offset,
                                         k_valid_from=k_valid_from)
         new_ck = new_cv = None
+    elif decode_kernel:
+        # FUSED cache mode (see ops.attention.create_fused_cache):
+        # ``cache_k`` is the [L, B, H, Smax, 2*hd] fused buffer and
+        # ``cache_v`` an empty placeholder riding the pytree.
+        from ..ops.attention import (cached_attention_fused,
+                                     write_kv_layer_fused)
+        if flash_prefill:
+            from ..ops.flash_attention import flash_attention
+            new_ck = write_kv_layer_fused(cache_k, k, v, layer_idx, offset)
+            attn_out = flash_attention(
+                q, k, v, interpret=jax.default_backend() != "tpu")
+        elif q.shape[2] == 1:
+            # single-token step -> the Pallas flash-decode kernel: fused
+            # row written in place inside the kernel, KV blocks streamed
+            # with a depth-adaptive trip count (ops.decode_attention —
+            # the XLA path measures ~3x slower at batched-decode shapes)
+            from ..ops.decode_attention import decode_attention
+            attn_out, new_ck = decode_attention(
+                q, k, v, cache_k, layer_idx, offset, k_valid_from,
+                interpret=decode_kernel == "interpret")
+        else:
+            attn_out, new_ck = cached_attention_fused(
+                q, k, v, cache_k, layer_idx, offset, k_valid_from)
+        new_cv = cache_v
     elif flash_prefill:
         from ..ops.flash_attention import flash_attention  # lazy import
         new_ck, new_cv = write_kv_layer(cache_k, cache_v, k, v, layer_idx,
@@ -229,6 +254,7 @@ def apply_blocks(blocks: Params, h: jnp.ndarray, config: GPT2Config,
                  k_valid_from: Optional[jnp.ndarray] = None, mesh=None,
                  valid: Optional[jnp.ndarray] = None,
                  flash_prefill: bool = False,
+                 decode_kernel: Optional[str] = None,
                  ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Run a stack of blocks (leading layer axis) via ``lax.scan``.
 
@@ -287,7 +313,8 @@ def apply_blocks(blocks: Params, h: jnp.ndarray, config: GPT2Config,
         layer_params, li = xs
         out, K, V = _block(layer_params, h, n_head, eps, K, V,
                            offset, k_valid_from=k_valid_from,
-                           flash_prefill=flash_prefill, layer_idx=li)
+                           flash_prefill=flash_prefill, layer_idx=li,
+                           decode_kernel=decode_kernel)
         return (out, K, V), None
 
     (h, new_k, new_v), _ = jax.lax.scan(
@@ -334,6 +361,7 @@ def forward_with_cache(params: Params, input_ids: jnp.ndarray,
                        config: GPT2Config, cache: KVCache,
                        pad: Optional[jnp.ndarray] = None,
                        flash_prefill: bool = False,
+                       decode_kernel: Optional[str] = None,
                        ) -> Tuple[jnp.ndarray, KVCache]:
     """Cached forward (prefill when cache.length==0, decode step otherwise).
 
@@ -350,11 +378,13 @@ def forward_with_cache(params: Params, input_ids: jnp.ndarray,
     if pad is None:
         h = embed(params, input_ids, cache.length)
         h, cache = apply_blocks(params["blocks"], h, config, cache,
-                                flash_prefill=flash_prefill)
+                                flash_prefill=flash_prefill,
+                                decode_kernel=decode_kernel)
     else:
         h = embed(params, input_ids, cache.length - pad[:, None])
         h, cache = apply_blocks(params["blocks"], h, config, cache,
-                                k_valid_from=pad)
+                                k_valid_from=pad,
+                                decode_kernel=decode_kernel)
     return final_logits(params, h, config.layer_norm_epsilon), cache
 
 

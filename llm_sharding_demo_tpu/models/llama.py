@@ -134,6 +134,7 @@ def _block(block_params: Params, h: jnp.ndarray, config: LlamaConfig,
            cache_k: Optional[jnp.ndarray], cache_v: Optional[jnp.ndarray],
            offset, k_valid_from: Optional[jnp.ndarray] = None,
            mesh=None, flash_prefill: bool = False, layer_idx=None,
+           decode_kernel: Optional[str] = None,
            ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray],
                       Optional[jnp.ndarray]]:
     """One pre-norm llama block; optionally reads/writes the KV cache.
@@ -174,6 +175,30 @@ def _block(block_params: Params, h: jnp.ndarray, config: LlamaConfig,
             attn_out = causal_attention(q, k, v, q_offset=offset,
                                         k_valid_from=k_valid_from)
         new_ck = new_cv = None
+    elif decode_kernel is not None:
+        # FUSED cache mode (ops.attention.create_fused_cache): cache_k is
+        # the fused [L, B, Hkv, Smax, 2*hd] buffer, cache_v a placeholder
+        from ..ops.attention import (cached_attention_fused,
+                                     write_kv_layer_fused)
+        if flash_prefill:
+            from ..ops.flash_attention import flash_attention
+            new_ck = write_kv_layer_fused(cache_k, k, v, layer_idx, offset)
+            g = config.n_head // config.n_kv_head
+            kf = jnp.repeat(k, g, axis=1) if g > 1 else k
+            vf = jnp.repeat(v, g, axis=1) if g > 1 else v
+            attn_out = flash_attention(
+                q, kf, vf, interpret=jax.default_backend() != "tpu")
+        elif q.shape[2] == 1:
+            # GQA-native flash-decode kernel: g = n_head/n_kv_head query
+            # heads ride each kv head's block stream, K/V never repeat
+            from ..ops.decode_attention import decode_attention
+            attn_out, new_ck = decode_attention(
+                q, k, v, cache_k, layer_idx, offset, k_valid_from,
+                interpret=decode_kernel == "interpret")
+        else:
+            attn_out, new_ck = cached_attention_fused(
+                q, k, v, cache_k, layer_idx, offset, k_valid_from)
+        new_cv = cache_v
     elif flash_prefill:
         # fresh-cache prefill (offset 0, no pad): cached attention is
         # plain causal attention over the new K/V — write the cache at
@@ -235,6 +260,7 @@ def apply_blocks(blocks: Params, h: jnp.ndarray, config: LlamaConfig,
                  k_valid_from: Optional[jnp.ndarray] = None, mesh=None,
                  flash_prefill: bool = False,
                  valid: Optional[jnp.ndarray] = None,
+                 decode_kernel: Optional[str] = None,
                  ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Run a stack of llama blocks (leading layer axis) via ``lax.scan`` —
     the llama sibling of ``gpt2.apply_blocks``, factored out so the
@@ -280,7 +306,8 @@ def apply_blocks(blocks: Params, h: jnp.ndarray, config: LlamaConfig,
         layer_params, li = xs
         out, K, V = _block(layer_params, h, config, cos, sin, K, V, offset,
                            k_valid_from=k_valid_from,
-                           flash_prefill=flash_prefill, layer_idx=li)
+                           flash_prefill=flash_prefill, layer_idx=li,
+                           decode_kernel=decode_kernel)
         return (out, K, V), None
 
     (h, new_k, new_v), _ = jax.lax.scan(
@@ -303,6 +330,7 @@ def forward_with_cache(params: Params, input_ids: jnp.ndarray,
                        config: LlamaConfig, cache: KVCache,
                        pad: Optional[jnp.ndarray] = None,
                        flash_prefill: bool = False,
+                       decode_kernel: Optional[str] = None,
                        ) -> Tuple[jnp.ndarray, KVCache]:
     """Cached forward (prefill when cache.length==0, decode otherwise).
 
@@ -317,7 +345,8 @@ def forward_with_cache(params: Params, input_ids: jnp.ndarray,
     # so ragged batches always take the masked cached-attention path
     flash_prefill = flash_prefill and pad is None
     h, cache = apply_blocks(params["blocks"], h, config, cos, sin, cache,
-                            k_valid_from=pad, flash_prefill=flash_prefill)
+                            k_valid_from=pad, flash_prefill=flash_prefill,
+                            decode_kernel=decode_kernel)
     return _final(params, h, config), cache
 
 

@@ -174,6 +174,55 @@ def cached_attention_inplace(q: jnp.ndarray, k_new: jnp.ndarray,
     return out, K, V
 
 
+def create_fused_cache(n_layer: int, batch: int, n_kv_head: int,
+                       max_seq: int, head_dim: int, dtype) -> KVCache:
+    """FUSED cache layout: K and V interleaved on the lane axis —
+    ``k`` holds ``[L, B, Hkv, max_seq, 2*hd]`` rows ``[K | V]`` and ``v``
+    is an empty placeholder. The fused row is the layout the Pallas
+    flash-decode kernel wants: each position is one 128-lane-aligned row
+    (hd=64 models), so a single DMA streams both K and V and the new
+    token's write is one full-row copy — Mosaic rejects the 64-lane
+    slices that separate K/V buffers would need."""
+    shape = (n_layer, batch, n_kv_head, max_seq, 2 * head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype=dtype),
+                   v=jnp.zeros((0,), dtype=dtype),
+                   length=jnp.zeros((), dtype=jnp.int32))
+
+
+def is_fused_cache(cache: KVCache) -> bool:
+    return cache.v.ndim == 1 and cache.v.shape[0] == 0
+
+
+def write_kv_layer_fused(KV: jnp.ndarray, k_new: jnp.ndarray,
+                         v_new: jnp.ndarray, layer_idx, offset) -> jnp.ndarray:
+    """Fused-layout sibling of ``write_kv_layer``: new rows are
+    ``concat([K, V])`` on the lane axis, written in one update."""
+    rows = jnp.concatenate([k_new, v_new], axis=-1).astype(KV.dtype)
+    return jax.lax.dynamic_update_slice(KV, rows[None],
+                                        (layer_idx, 0, 0, offset, 0))
+
+
+def cached_attention_fused(q: jnp.ndarray, k_new: jnp.ndarray,
+                           v_new: jnp.ndarray, KV: jnp.ndarray,
+                           layer_idx, offset,
+                           k_valid_from: Optional[jnp.ndarray] = None,
+                           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Multi-token cached attention over the FUSED cache (XLA path): used
+    for prefill continuations, chunked prefill, prefix-cache extends, and
+    speculative verify windows when the engine runs the fused layout.
+    Unfusing is a lane slice — values round-trip bitwise, so this path
+    stays byte-exact vs the separate-buffer XLA path."""
+    s = k_new.shape[2]
+    hd = k_new.shape[-1]
+    KV = write_kv_layer_fused(KV, k_new, v_new, layer_idx, offset)
+    layer = jax.lax.dynamic_index_in_dim(KV, layer_idx, axis=0,
+                                         keepdims=False)
+    out = causal_attention(q, layer[..., :hd], layer[..., hd:],
+                           q_offset=offset, kv_length=offset + s,
+                           k_valid_from=k_valid_from)
+    return out, KV
+
+
 def cached_attention(q: jnp.ndarray, k_new: jnp.ndarray, v_new: jnp.ndarray,
                      cache_k: jnp.ndarray, cache_v: jnp.ndarray,
                      offset: jnp.ndarray,

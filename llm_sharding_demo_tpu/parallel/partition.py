@@ -158,6 +158,7 @@ def partition_params(params: Params, specs: Sequence[StageSpec]) -> List[Params]
 def stage_apply(stage_params: Params, spec: StageSpec, config: GPT2Config,
                 x: jnp.ndarray, cache: Optional[KVCache] = None,
                 pad: Optional[jnp.ndarray] = None,
+                decode_kernel=None,
                 ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """Run one stage. First stage takes ``[B,S]`` ids, others ``[B,S,D]``
     hidden states; last stage returns ``[B,S,vocab]`` logits.
@@ -177,13 +178,14 @@ def stage_apply(stage_params: Params, spec: StageSpec, config: GPT2Config,
     """
     from ..models.llama import LlamaConfig
     if isinstance(config, LlamaConfig):
-        return _stage_apply_llama(stage_params, spec, config, x, cache, pad)
+        return _stage_apply_llama(stage_params, spec, config, x, cache, pad,
+                                  decode_kernel)
     position_offset = cache.length if cache is not None else 0
     if pad is not None:
         position_offset = position_offset - pad[:, None]
     h = embed(stage_params, x, position_offset) if spec.is_first else x
     h, cache = apply_blocks(stage_params["blocks"], h, config, cache,
-                            k_valid_from=pad)
+                            k_valid_from=pad, decode_kernel=decode_kernel)
     if spec.is_last:
         head_params = {"ln_f": stage_params["ln_f"], "wte": stage_params["wte_out"]}
         h = final_logits(head_params, h, config.layer_norm_epsilon)
@@ -192,7 +194,7 @@ def stage_apply(stage_params: Params, spec: StageSpec, config: GPT2Config,
 
 def _stage_apply_llama(stage_params: Params, spec: StageSpec, config,
                        x: jnp.ndarray, cache: Optional[KVCache],
-                       pad: Optional[jnp.ndarray],
+                       pad: Optional[jnp.ndarray], decode_kernel=None,
                        ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
     """llama stage: RoPE angles derive from the stage cache's length (the
     same same-for-all-stages offset the dense path derives), embedding on
@@ -202,7 +204,8 @@ def _stage_apply_llama(stage_params: Params, spec: StageSpec, config,
     cos, sin = llama._angles(config, x.shape[1], offset, pad)
     h = llama._embed(stage_params, x) if spec.is_first else x
     h, cache = llama.apply_blocks(stage_params["blocks"], h, config,
-                                  cos, sin, cache, k_valid_from=pad)
+                                  cos, sin, cache, k_valid_from=pad,
+                                  decode_kernel=decode_kernel)
     if spec.is_last:
         h = llama._final(stage_params, h, config)
     return h, cache

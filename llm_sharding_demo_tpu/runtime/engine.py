@@ -253,7 +253,8 @@ class DecodeEngine:
 
     def __init__(self, params: Params, config: GPT2Config, max_seq: int,
                  dtype=jnp.float32, boundaries=None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 decode_kernel: str = "auto"):
         """``dtype`` is the inference compute dtype: float params are cast
         once here and the KV cache allocates in it. bfloat16 halves weight
         and cache HBM traffic (the decode bottleneck — each token streams
@@ -335,6 +336,36 @@ class DecodeEngine:
             # two (the slices are new buffers).
             self.params = None
         self.prefill_chunk = prefill_chunk
+        # Decode-attention dispatch (``decode_kernel``): "auto" routes
+        # single-token decode steps through the Pallas flash-decode kernel
+        # on TPU (in-place cache write + depth-adaptive block reads —
+        # ops.decode_attention has the measurements), "xla" keeps the
+        # einsum path (the byte-pinned parity mode), "interpret" forces
+        # the kernel in interpret mode for CPU tests. The kernel needs the
+        # cache allocated in whole blocks, so the PHYSICAL cache rounds up
+        # to a BLOCK_S multiple (capped at n_positions; ineligible shapes
+        # fall back to "xla" with the exact ``max_seq`` allocation).
+        from ..ops import decode_attention as _DA
+        if decode_kernel not in ("auto", "xla", "interpret"):
+            raise ValueError(
+                f"decode_kernel={decode_kernel!r} not auto|xla|interpret")
+        self._cache_seq = max_seq
+        self._decode_kernel: Optional[str] = None
+        # "auto" additionally requires a non-fp32 compute dtype: fp32 is
+        # BASELINE.json's byte-pinned greedy-parity mode, and the kernel's
+        # online softmax is allclose-not-bitwise vs the einsum path.
+        want = (decode_kernel == "interpret"
+                or (decode_kernel == "auto"
+                    and jax.default_backend() == "tpu"
+                    and dtype != jnp.float32))
+        if want:
+            rounded = min(-(-max_seq // _DA.BLOCK_S) * _DA.BLOCK_S,
+                          config.n_positions)
+            if _DA.eligible(rounded, config.head_dim, 1):
+                self._cache_seq = rounded
+                self._decode_kernel = ("interpret"
+                                       if decode_kernel == "interpret"
+                                       else "device")
         # Prefill allocates its cache *inside* the program (zeros are free
         # under XLA and the layout matches the decode program exactly);
         # decode donates the prefill-produced cache so the two
@@ -351,11 +382,26 @@ class DecodeEngine:
     # -- compiled programs ---------------------------------------------------
 
     def _fresh_cache(self, batch: int):
+        # allocation size may exceed the semantic ``max_seq`` bound: the
+        # decode kernel wants whole BLOCK_S blocks (see __init__). Kernel
+        # mode allocates the FUSED layout (K|V interleaved rows — see
+        # ops.attention.create_fused_cache) the kernel's aligned DMAs
+        # require; the XLA mode keeps the family's separate buffers.
+        heads = getattr(self.config, "n_kv_head", self.config.n_head)
+        if self._decode_kernel is not None:
+            from ..ops.attention import create_fused_cache
+            if self.specs is None:
+                return create_fused_cache(self.config.n_layer, batch, heads,
+                                          self._cache_seq,
+                                          self.config.head_dim, self.dtype)
+            return [create_fused_cache(s.n_blocks, batch, heads,
+                                       self._cache_seq, self.config.head_dim,
+                                       self.dtype) for s in self.specs]
         if self.specs is None:
-            return self._model.make_cache(self.config, batch, self.max_seq,
-                                          self.dtype)
+            return self._model.make_cache(self.config, batch,
+                                          self._cache_seq, self.dtype)
         from ..parallel import partition as P
-        return [P.make_stage_cache(s, self.config, batch, self.max_seq,
+        return [P.make_stage_cache(s, self.config, batch, self._cache_seq,
                                    self.dtype) for s in self.specs]
 
     def _forward_cached(self, params, x, cache, pad, flash_prefill=False):
@@ -363,16 +409,21 @@ class DecodeEngine:
 
         ``flash_prefill`` is the static fresh-cache-prefill flag (see
         ``_prefill_impl``); the staged path ignores it (stage prefills
-        are short at current scales).
+        are short at current scales). Single-token calls route through
+        the flash-decode kernel when enabled (``decode_kernel``); the
+        model gates on query length, so prefill and the speculative
+        multi-token verify forwards stay on the XLA path.
         """
         if self.specs is None:
-            return self._model.forward_with_cache(params, x, self.config,
-                                                  cache, pad,
-                                                  flash_prefill=flash_prefill)
+            return self._model.forward_with_cache(
+                params, x, self.config, cache, pad,
+                flash_prefill=flash_prefill,
+                decode_kernel=self._decode_kernel)
         from ..parallel import partition as P
         new_caches = []
         for sp, spec, c in zip(params, self.specs, cache):
-            x, c = P.stage_apply(sp, spec, self.config, x, c, pad)
+            x, c = P.stage_apply(sp, spec, self.config, x, c, pad,
+                                 decode_kernel=self._decode_kernel)
             new_caches.append(c)
         return x, new_caches
 
@@ -475,7 +526,14 @@ class DecodeEngine:
         so the decode program set is keyed by (depth-to-bucket-edge
         distance, steps) rather than steps alone — a handful of extra
         (smaller) programs per prompt bucket, traded for attention reads
-        that track actual depth instead of ``max_seq``."""
+        that track actual depth instead of ``max_seq``.
+
+        With the flash-decode kernel active, segmentation is pointless:
+        the kernel's block loop already bounds its reads by the live
+        depth (a dynamic trip count — no recompiles), so the whole decode
+        runs as one full-cache program."""
+        if self._decode_kernel is not None:
+            return [(steps - 1, None)]
         total = steps - 1
         segs = []
         d = start_depth

@@ -91,9 +91,14 @@ class SpecDecodeEngine:
         self.ngram = ngram
         # The engine owns params/cache sizing (and chunked prefill); its
         # overflow guard also covers ours (we re-check with draft headroom
-        # in generate()).
+        # in generate()). decode_kernel is pinned to "xla" on BOTH sides:
+        # the verify windows are multi-token (fused-XLA numerics), so a
+        # kernel-decoding plain engine would break the token-exactness
+        # contract between the spec stream and the plain fallback stream
+        # on argmax near-ties.
         self._eng = DecodeEngine(params, config, max_seq, dtype=dtype,
-                                 prefill_chunk=prefill_chunk)
+                                 prefill_chunk=prefill_chunk,
+                                 decode_kernel="xla")
         self.config = config
         self.max_seq = max_seq
         import threading
