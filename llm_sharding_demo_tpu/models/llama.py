@@ -151,17 +151,27 @@ def _block(block_params: Params, h: jnp.ndarray, config: LlamaConfig,
     k = apply_rope(k, cos, sin)
     if cache_k is None:
         impl = config.attention_impl
-        if impl in ("pallas", "ring") and config.n_kv_head != config.n_head:
-            # those kernels are written for equal q/kv head counts; repeat
+
+        def repeat_kv(k, v):
+            # the pallas/ring kernels want equal q/kv head counts; repeat
             # (HF repeat_kv ordering) — a training-path materialization,
-            # the cached decode path below never repeats
+            # the cached decode path below never repeats, and neither do
+            # the XLA fallbacks (grouped einsum handles GQA natively)
             g = config.n_head // config.n_kv_head
-            k = jnp.repeat(k, g, axis=1)
-            v = jnp.repeat(v, g, axis=1)
+            return ((jnp.repeat(k, g, axis=1), jnp.repeat(v, g, axis=1))
+                    if g > 1 else (k, v))
+
         if impl == "pallas":
-            from ..ops.flash_attention import flash_attention
-            attn_out = flash_attention(
-                q, k, v, interpret=jax.default_backend() != "tpu")
+            from ..ops.flash_attention import (flash_attention,
+                                               flash_profitable)
+            if flash_profitable(q.shape[2]):
+                kf, vf = repeat_kv(k, v)
+                attn_out = flash_attention(
+                    q, kf, vf, interpret=jax.default_backend() != "tpu")
+            else:
+                # below the measured crossover the XLA einsum wins
+                attn_out = causal_attention(q, k, v, q_offset=offset,
+                                            k_valid_from=k_valid_from)
         elif impl == "ring":
             from ..ops.ring_attention import ring_attention
             if mesh is None:
@@ -170,7 +180,8 @@ def _block(block_params: Params, h: jnp.ndarray, config: LlamaConfig,
             if k_valid_from is not None:
                 raise NotImplementedError(
                     "ring attention does not support ragged batches")
-            attn_out = ring_attention(q, k, v, mesh, axis="sp")
+            kf, vf = repeat_kv(k, v)
+            attn_out = ring_attention(q, kf, vf, mesh, axis="sp")
         else:
             attn_out = causal_attention(q, k, v, q_offset=offset,
                                         k_valid_from=k_valid_from)
@@ -291,27 +302,31 @@ def apply_blocks(blocks: Params, h: jnp.ndarray, config: LlamaConfig,
             body = jax.checkpoint(body)
         h, _ = jax.lax.scan(body, h, blocks)
         return h, None
-    if valid is not None:
-        raise NotImplementedError("valid masking is a no-cache (pipeline "
-                                  "training) feature; cached decode stages "
-                                  "are never padded")
 
     offset = cache.length
     n_blocks = jax.tree_util.tree_leaves(blocks)[0].shape[0]
 
     # Cache rides the CARRY (in-place column updates), not xs/ys — see
     # ops.attention.write_kv_layer for the memory-behavior rationale.
+    # ``valid`` masks padding layers to identity, as in gpt2.apply_blocks
+    # (their cache slices take garbage writes no real layer ever reads).
     def body(carry, xs):
         h, K, V = carry
-        layer_params, li = xs
+        if valid is None:
+            layer_params, li = xs
+        else:
+            layer_params, li, valid_l = xs
         out, K, V = _block(layer_params, h, config, cos, sin, K, V, offset,
                            k_valid_from=k_valid_from,
                            flash_prefill=flash_prefill, layer_idx=li,
                            decode_kernel=decode_kernel)
+        if valid is not None:
+            out = jnp.where(valid_l, out, h)
         return (out, K, V), None
 
-    (h, new_k, new_v), _ = jax.lax.scan(
-        body, (h, cache.k, cache.v), (blocks, jnp.arange(n_blocks)))
+    xs = ((blocks, jnp.arange(n_blocks)) if valid is None
+          else (blocks, jnp.arange(n_blocks), valid))
+    (h, new_k, new_v), _ = jax.lax.scan(body, (h, cache.k, cache.v), xs)
     new_len = cache.length + jnp.asarray(h.shape[1], dtype=jnp.int32)
     return h, KVCache(new_k, new_v, new_len)
 

@@ -280,6 +280,7 @@ def _moe_block(layer_params: Params, h: jnp.ndarray, config: MoEConfig,
                cache_k: Optional[jnp.ndarray], cache_v: Optional[jnp.ndarray],
                offset, k_valid_from: Optional[jnp.ndarray] = None,
                layer_idx=None, decode_kernel: Optional[str] = None,
+               routed_mlp: bool = True,
                ) -> Tuple[jnp.ndarray, jnp.ndarray,
                           Optional[jnp.ndarray], Optional[jnp.ndarray]]:
     """One pre-LN MoE block, optionally reading/writing the KV cache
@@ -306,7 +307,9 @@ def _moe_block(layer_params: Params, h: jnp.ndarray, config: MoEConfig,
     # rows gather only the selected experts' kernels (k/E of the MLP
     # weight traffic — see moe_mlp_routed). Decode tokens are always real
     # (pad lives in the prefix), so token_valid never gates them.
-    use_routed = (h.shape[1] == 1
+    # ``routed_mlp=False`` (ep-sharded inference) keeps the dense
+    # formulation, whose einsums GSPMD partitions over the expert axis.
+    use_routed = (routed_mlp and h.shape[1] == 1
                   and h.shape[0] * config.expert_top_k <= config.n_experts)
 
     def mlp_fn(block_params: Params, m: jnp.ndarray) -> jnp.ndarray:
@@ -344,6 +347,7 @@ def forward_with_cache(params: Params, input_ids: jnp.ndarray,
                        pad: Optional[jnp.ndarray] = None,
                        flash_prefill: bool = False,
                        decode_kernel: Optional[str] = None,
+                       routed_mlp: bool = True,
                        ) -> Tuple[jnp.ndarray, KVCache]:
     """Cached MoE forward (prefill / incremental decode), engine-compatible.
 
@@ -381,7 +385,8 @@ def forward_with_cache(params: Params, input_ids: jnp.ndarray,
         layer_params, li = xs
         out, _, K, V = _moe_block(layer_params, h, config, K, V, offset,
                                   k_valid_from, layer_idx=li,
-                                  decode_kernel=decode_kernel)
+                                  decode_kernel=decode_kernel,
+                                  routed_mlp=routed_mlp)
         return (out, K, V), None
 
     (h, new_k, new_v), _ = jax.lax.scan(

@@ -3,9 +3,10 @@
 The reference processes one request at a time end-to-end (a single
 uvicorn worker looping over synchronous HTTP hops, reference
 server.py:154-210). Single-stream decode leaves most of a TPU idle —
-throughput scales near-linearly with batch size until the MXU saturates
-(bench cfg3: 8 rows ≈ 2x the aggregate tokens/sec of 1 row... per row).
-This module multiplexes concurrent requests onto batched decodes:
+decode is weight-bandwidth-bound, so rows sharing one weight stream are
+nearly free (bench cfg3: 8 rows ≈ 5x the aggregate tokens/sec, bounded
+by the per-row KV-cache reads). This module multiplexes concurrent
+requests onto batched decodes:
 
 - callers block in ``generate`` while a worker thread drains a queue,
   groups compatible requests, left-pads the ragged prompts
@@ -41,6 +42,7 @@ import threading
 from typing import List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..utils.metrics import REGISTRY
@@ -81,12 +83,25 @@ class BatchingEngine:
 
     def __init__(self, engine: DecodeEngine, max_batch: int = 8,
                  max_wait_ms: float = 5.0, prompt_bucket: int = 16,
-                 steps_bucket: int = 32):
+                 steps_bucket: int = 32, prefix=None):
+        """``prefix`` (optional ``PrefixCachingEngine`` wrapping the SAME
+        underlying engine) composes cross-request KV reuse with batching:
+        each row prefills solo through the prefix store (hit or miss at
+        its own depth), the per-row caches merge into one left-padded
+        batched cache (a roll by each row's pad — cache slots shift with
+        positions, so the merged state is exactly what a batched prefill
+        would have produced), and ONE batched decode serves all rows.
+        Single-request rounds route through ``prefix.generate`` directly,
+        preserving the solo path's speculation composition."""
+        if prefix is not None and prefix.plain is not engine:
+            raise ValueError("prefix must wrap the same engine instance")
         self.engine = engine
+        self.prefix = prefix
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1e3
         self.prompt_bucket = prompt_bucket
         self.steps_bucket = steps_bucket
+        self._merge = jax.jit(self._merge_impl)
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._pending: Optional[_Request] = None  # held head of next round
         self._stats_lock = threading.Lock()
@@ -209,7 +224,67 @@ class BatchingEngine:
                         req.error = e
                         req.done.set()
 
+    @staticmethod
+    def _merge_impl(solos, pads, length):
+        """Per-row solo caches -> one batched left-padded cache.
+
+        Row i's solo cache holds positions ``[0, plen_i)`` at slots
+        ``[0, plen_i)``; the batched layout wants them at slots
+        ``[pad_i, s_max)``. A roll by ``pad_i`` along the slot axis does
+        exactly that (the wrapped garbage lands in the pad prefix, which
+        ``k_valid_from`` masks, and beyond ``s_max``, which ``kv_length``
+        masks until decode overwrites it). Handles plain, staged (list),
+        and fused (empty ``v``) cache forms.
+        """
+        from ..ops.attention import KVCache
+
+        def one(row_caches):
+            def cat(leaves):
+                if leaves[0].ndim <= 1:          # fused placeholder v
+                    return leaves[0]
+                return jnp.concatenate(
+                    [jnp.roll(x, pads[i], axis=-2)
+                     for i, x in enumerate(leaves)], axis=1)
+            return KVCache(k=cat([c.k for c in row_caches]),
+                           v=cat([c.v for c in row_caches]),
+                           length=length)
+
+        if isinstance(solos[0], list):           # staged engine
+            return [one([s[j] for s in solos]) for j in range(len(solos[0]))]
+        return one(solos)
+
+    def _run_prefix(self, batch: List[_Request], ids: np.ndarray,
+                    pad: np.ndarray, steps: int):
+        """Batched decode over per-row prefix-store prefills (greedy-only
+        rounds — _gather never groups sample requests)."""
+        t0 = _monotonic()
+        states = []
+        for req in batch:
+            logits, cache, _ = self.prefix.prefill_state(req.prompt)
+            states.append((logits, cache))
+        while len(states) < ids.shape[0]:        # dummy rows replicate last
+            states.append(states[len(batch) - 1])
+        first = jnp.argmax(jnp.concatenate([s[0] for s in states], axis=0),
+                           axis=-1).astype(jnp.int32)
+        pads_j = jnp.asarray(pad)
+        cache = self._merge([s[1] for s in states], pads_j,
+                            jnp.asarray(ids.shape[1], jnp.int32))
+        eng = self.engine
+        return eng._decode_and_pack(
+            eng._run_params(), ids, pad, pads_j if pad.any() else None,
+            first, cache, jax.random.PRNGKey(0), steps,
+            batch[0].sampling, ids.shape[1], _monotonic() - t0)
+
     def _run(self, batch: List[_Request]):
+        if self.prefix is not None and len(batch) == 1:
+            # solo rounds keep the full single-stream prefix path
+            # (including its speculation composition) and true shapes
+            req = batch[0]
+            result = self.prefix.generate(req.prompt, req.max_new_tokens,
+                                          sampling=req.sampling, key=req.key)
+            self._deliver(batch, result)
+            return
+
         s_max, steps = self._shapes(batch)  # planned feasible: not None
         b = _bucket_batch(len(batch), self.max_batch)
 
@@ -220,16 +295,23 @@ class BatchingEngine:
             ids[i, s_max - len(r.prompt):] = r.prompt
             pad[i] = s_max - len(r.prompt)
 
-        key = batch[0].key  # greedy never consumes it; solo sample uses it
-        result = self.engine.generate(ids, steps,
-                                      sampling=batch[0].sampling, key=key,
-                                      pad=pad)
+        if self.prefix is not None:
+            result = self._run_prefix(batch, ids, pad, steps)
+        else:
+            key = batch[0].key  # greedy never consumes it; solo sample uses it
+            result = self.engine.generate(ids, steps,
+                                          sampling=batch[0].sampling, key=key,
+                                          pad=pad)
+        self._deliver(batch, result, padded_rows=b - len(batch))
+
+    def _deliver(self, batch: List[_Request], result: GenerateResult,
+                 padded_rows: int = 0):
         with self._stats_lock:
             self.batches_run += 1
             self.rows_served += len(batch)
         REGISTRY.inc("decode_batches_total")
         REGISTRY.inc("batched_requests_total", value=len(batch))
-        REGISTRY.inc("batched_rows_padded_total", value=b - len(batch))
+        REGISTRY.inc("batched_rows_padded_total", value=padded_rows)
         for i, req in enumerate(batch):
             # row_tokens strips the engine-reported pad — OUR bucket pad
             # plus any chunk-alignment pad the engine added on top

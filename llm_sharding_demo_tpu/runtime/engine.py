@@ -254,7 +254,8 @@ class DecodeEngine:
     def __init__(self, params: Params, config: GPT2Config, max_seq: int,
                  dtype=jnp.float32, boundaries=None,
                  prefill_chunk: Optional[int] = None,
-                 decode_kernel: str = "auto"):
+                 decode_kernel: str = "auto",
+                 mesh=None, ep_axis: str = "ep"):
         """``dtype`` is the inference compute dtype: float params are cast
         once here and the KV cache allocates in it. bfloat16 halves weight
         and cache HBM traffic (the decode bottleneck — each token streams
@@ -312,6 +313,50 @@ class DecodeEngine:
         self.config = config
         self.max_seq = max_seq
         self.dtype = dtype
+        # Expert-parallel inference: with a mesh carrying an ``ep`` axis,
+        # the stacked expert kernels/biases shard over their E axis and
+        # everything else replicates — each chip holds (and streams)
+        # E/ep experts' weights, and GSPMD derives the dispatch/combine
+        # collectives from the dense formulation (the routed-gather fast
+        # path is disabled under a mesh: a jnp.take over the sharded E
+        # axis would make XLA all-gather the full expert stack, exactly
+        # the traffic ep-sharding exists to avoid).
+        self._ep_mesh = mesh
+        if mesh is not None:
+            if not hasattr(config, "n_experts"):
+                raise ValueError(
+                    "mesh/ep decode applies to the MoE family; dense "
+                    "models shard via parallel.spmd / parallel.ppdecode")
+            if ep_axis not in mesh.axis_names:
+                raise ValueError(
+                    f"mesh has no {ep_axis!r} axis: {mesh.axis_names}")
+            ep = mesh.shape[ep_axis]
+            if config.n_experts % ep:
+                raise ValueError(
+                    f"n_experts={config.n_experts} not divisible by "
+                    f"ep={ep}")
+            if boundaries is not None:
+                raise ValueError("ep decode and stage partitioning are "
+                                 "mutually exclusive (MoE decodes "
+                                 "unstaged)")
+            from jax.sharding import NamedSharding, PartitionSpec as P_
+
+            def place(path, leaf):
+                names = [getattr(p, "key", p) for p in path]
+                if "experts" in names:
+                    # stacked expert leaves: [L, E, ...] — shard axis 1
+                    ndim = (leaf.q.ndim if hasattr(leaf, "q")
+                            else leaf.ndim)
+                    spec = P_(None, ep_axis, *([None] * (ndim - 2)))
+                else:
+                    spec = P_()
+                return jax.tree.map(
+                    lambda x: jax.device_put(
+                        x, NamedSharding(mesh, P_(*spec[:x.ndim]))), leaf)
+
+            self.params = jax.tree_util.tree_map_with_path(
+                place, self.params,
+                is_leaf=lambda x: hasattr(x, "q") or hasattr(x, "ndim"))
         # Model dispatch: any family module exposing the
         # (forward_with_cache, make_cache) pair can be decoded
         # (models.family_module — gpt2, moe, llama). Stage partitioning
@@ -354,10 +399,20 @@ class DecodeEngine:
         # "auto" additionally requires a non-fp32 compute dtype: fp32 is
         # BASELINE.json's byte-pinned greedy-parity mode, and the kernel's
         # online softmax is allclose-not-bitwise vs the einsum path.
-        want = (decode_kernel == "interpret"
-                or (decode_kernel == "auto"
-                    and jax.default_backend() == "tpu"
-                    and dtype != jnp.float32))
+        # under an ep mesh the attention stays in partitioned XLA — the
+        # kernel's manual DMAs don't compose with GSPMD partitioning.
+        # "auto" quietly resolves to XLA there; the EXPLICIT kernel
+        # request refuses rather than silently running something else
+        if mesh is not None and decode_kernel == "interpret":
+            raise ValueError(
+                "decode_kernel='interpret' does not compose with an ep "
+                "mesh (the Pallas decode kernel is unpartitioned); use "
+                "'auto' or 'xla'")
+        want = mesh is None and (
+            decode_kernel == "interpret"
+            or (decode_kernel == "auto"
+                and jax.default_backend() == "tpu"
+                and dtype != jnp.float32))
         if want:
             rounded = min(-(-max_seq // _DA.BLOCK_S) * _DA.BLOCK_S,
                           config.n_positions)
@@ -415,10 +470,13 @@ class DecodeEngine:
         multi-token verify forwards stay on the XLA path.
         """
         if self.specs is None:
+            kw = {}
+            if self._ep_mesh is not None:
+                kw["routed_mlp"] = False  # MoE only (validated in __init__)
             return self._model.forward_with_cache(
                 params, x, self.config, cache, pad,
                 flash_prefill=flash_prefill,
-                decode_kernel=self._decode_kernel)
+                decode_kernel=self._decode_kernel, **kw)
         from ..parallel import partition as P
         new_caches = []
         for sp, spec, c in zip(params, self.specs, cache):
@@ -441,10 +499,11 @@ class DecodeEngine:
         # trace time; flash_eligible keeps ragged user lengths the kernel
         # cannot tile (it would fall back to one full-S VMEM block) on
         # the XLA path.
-        from ..ops.flash_attention import flash_eligible
+        from ..ops.flash_attention import flash_eligible, flash_profitable
         flash = (self.config.attention_impl == "pallas" and pad is None
                  and ids.shape[1] > 1 and self.specs is None
-                 and flash_eligible(ids.shape[1]))
+                 and flash_eligible(ids.shape[1])
+                 and flash_profitable(ids.shape[1]))
         logits, cache = self._forward_cached(params, ids, cache, pad,
                                              flash_prefill=flash)
         return logits[:, -1], cache

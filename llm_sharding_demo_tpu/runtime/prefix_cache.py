@@ -159,6 +159,63 @@ class PrefixCachingEngine:
             while len(self._store) > self.capacity:
                 self._store.popitem(last=False)
 
+    def _prefill_walk(self, prompt: np.ndarray, prompt_len: int):
+        """Store-aware chunk-aligned prefill of one prompt row: returns
+        ``(last_logits [1, V], cache)``. Caller holds ``self._lock``.
+
+        The returned cache is always a fresh program output (the tail
+        step runs unconditionally and the first step off a stored entry
+        copies inside the program, ``_extend_keep``), so downstream
+        decode may donate it."""
+        run_params = self._eng._run_params()
+        m_hit, entry = self._lookup(prompt)
+        if entry is not None:
+            with self._store_lock:
+                self.hits += 1
+            REGISTRY.inc("prefix_cache_hits_total")
+            REGISTRY.inc("prefix_cache_reused_tokens_total",
+                         value=m_hit * self.chunk)
+            cache = entry
+        else:
+            with self._store_lock:
+                self.misses += 1
+            REGISTRY.inc("prefix_cache_misses_total")
+            cache = self._eng._fresh_cache(1)
+
+        # extend chunk by chunk (one shared program), snapshotting the
+        # deepest full-chunk state for the store before the ragged
+        # tail consumes the buffers. The first step off a stored
+        # entry must not donate it (see _extend_keep).
+        m_total = (prompt_len - 1) // self.chunk
+        from_store = entry is not None
+
+        def step(cache, ids):
+            nonlocal from_store
+            fn = self._extend_keep if from_store else self._extend
+            from_store = False
+            return fn(run_params, cache, ids)
+
+        logits = None
+        for m in range(m_hit, m_total):
+            piece = jnp.asarray(
+                prompt[None, m * self.chunk:(m + 1) * self.chunk])
+            logits, cache = step(cache, piece)
+        if m_total > m_hit:
+            self._insert(prompt, m_total, cache)
+        tail = jnp.asarray(prompt[None, m_total * self.chunk:])
+        logits, cache = step(cache, tail)
+        return logits, cache
+
+    def prefill_state(self, prompt: np.ndarray):
+        """Public single-row prefill for the batching front end
+        (runtime.batcher): ``(last_logits [1, V], cache, prompt_len)``
+        with the store consulted/updated. The caller owns the returned
+        cache (safe to donate)."""
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        with self._lock:
+            logits, cache = self._prefill_walk(prompt, len(prompt))
+        return logits[:, -1], cache, len(prompt)
+
     def generate(self, prompt_ids, max_new_tokens: int,
                  sampling: SamplingConfig = SamplingConfig(),
                  key: Optional[jax.Array] = None) -> GenerateResult:
@@ -174,42 +231,7 @@ class PrefixCachingEngine:
 
         with self._lock:
             t0 = time.perf_counter()
-            m_hit, entry = self._lookup(prompt)
-            if entry is not None:
-                with self._store_lock:
-                    self.hits += 1
-                REGISTRY.inc("prefix_cache_hits_total")
-                REGISTRY.inc("prefix_cache_reused_tokens_total",
-                             value=m_hit * self.chunk)
-                cache = entry
-            else:
-                with self._store_lock:
-                    self.misses += 1
-                REGISTRY.inc("prefix_cache_misses_total")
-                cache = self._eng._fresh_cache(1)
-
-            # extend chunk by chunk (one shared program), snapshotting the
-            # deepest full-chunk state for the store before the ragged
-            # tail consumes the buffers. The first step off a stored
-            # entry must not donate it (see _extend_keep).
-            m_total = (prompt_len - 1) // self.chunk
-            from_store = entry is not None
-
-            def step(cache, ids):
-                nonlocal from_store
-                fn = self._extend_keep if from_store else self._extend
-                from_store = False
-                return fn(run_params, cache, ids)
-
-            logits = None
-            for m in range(m_hit, m_total):
-                piece = jnp.asarray(
-                    prompt[None, m * self.chunk:(m + 1) * self.chunk])
-                logits, cache = step(cache, piece)
-            if m_total > m_hit:
-                self._insert(prompt, m_total, cache)
-            tail = jnp.asarray(prompt[None, m_total * self.chunk:])
-            logits, cache = step(cache, tail)
+            logits, cache = self._prefill_walk(prompt, prompt_len)
 
             prefill_key, decode_key = jax.random.split(key)
             first = select_token(logits[:, -1], sampling, prefill_key)

@@ -175,12 +175,28 @@ def create_app(cfg: Optional[ServingConfig] = None,
             raise ValueError(
                 f"PREFIX_CACHE={cfg.prefix_cache} applies to the "
                 "coordinator's local decode path only")
-        if cfg.max_batch > 1:
+        # prefix+batching composes (per-row store prefills merged into
+        # one batched decode, runtime.batcher._run_prefix), and
+        # prefix+speculation composes single-stream; the triple is
+        # already refused by the SPEC_DECODE x MAX_BATCH guard above.
+    if cfg.ep_decode:
+        if not (cfg.shard_role == "coordinator" and cfg.dispatch == "local"):
+            raise ValueError("EP_DECODE applies to the coordinator's local "
+                             "decode path only")
+        if not hasattr(config, "n_experts"):
             raise ValueError(
-                "PREFIX_CACHE is a single-stream feature; it is mutually "
-                "exclusive with MAX_BATCH>1 (the batcher owns its own "
-                "prefill shapes). SPEC_DECODE composes: the prefix path "
-                "prefills, the verify loop decodes.")
+                f"EP_DECODE shards MoE expert weights; "
+                f"{type(config).__name__} models have no expert axis")
+        if cfg.pp_decode or cfg.spec_decode > 0 or cfg.prefix_cache > 0:
+            raise ValueError(
+                "EP_DECODE composes with MAX_BATCH only; PP_DECODE, "
+                "SPEC_DECODE, and PREFIX_CACHE own other decode programs "
+                "(and MoE prefills monolithically — no PREFILL_CHUNK)")
+        ep_size = min(len(jax.devices()), config.n_experts)
+        if config.n_experts % ep_size:
+            raise ValueError(
+                f"EP_DECODE: n_experts={config.n_experts} not divisible "
+                f"by the {ep_size}-device ep axis")
     if cfg.pp_decode:
         if not (cfg.shard_role == "coordinator" and cfg.dispatch == "local"):
             raise ValueError("PP_DECODE applies to the coordinator's local "
@@ -189,31 +205,21 @@ def create_app(cfg: Optional[ServingConfig] = None,
             raise ValueError(
                 f"PP_DECODE requires a stage-partitionable family; "
                 f"{type(config).__name__} models decode unstaged")
-        if (cfg.max_batch > 1 or cfg.spec_decode > 0 or cfg.prefix_cache > 0
-                or cfg.inference_dtype == "int8" or cfg.prefill_chunk > 0):
+        if cfg.spec_decode > 0 or cfg.prefix_cache > 0 or cfg.prefill_chunk > 0:
+            # round 3 lifted the rest of the round-2 exclusivity wall:
+            # int8 stage weights and (ragged) batching now compose with
+            # the ppermute program (parallel.ppdecode); speculation,
+            # prefix caching, and chunked prefill still own the
+            # single-device engine's prefill/decode program structure
             raise ValueError(
-                "PP_DECODE is the plain multi-device decoder; it is "
-                "mutually exclusive with MAX_BATCH>1, SPEC_DECODE, "
-                "PREFIX_CACHE, INFERENCE_DTYPE=int8, and PREFILL_CHUNK "
-                "(those features own the single-device engine's programs)")
+                "PP_DECODE composes with MAX_BATCH>1 and "
+                "INFERENCE_DTYPE=int8; SPEC_DECODE, PREFIX_CACHE, and "
+                "PREFILL_CHUNK own the single-device engine's programs")
         n_stages_cfg = len(cfg.boundaries) + 1
         if len(jax.devices()) < n_stages_cfg:
             raise ValueError(
                 f"PP_DECODE needs >= {n_stages_cfg} devices (one per "
                 f"stage); this pod sees {len(jax.devices())}")
-        if config.n_layer % n_stages_cfg:
-            raise ValueError(
-                f"PP_DECODE uses equal stage-major stacking: "
-                f"n_layer={config.n_layer} must divide by "
-                f"{n_stages_cfg} stages")
-        from ..parallel.partition import balanced_boundaries
-        if list(cfg.boundaries) != balanced_boundaries(
-                config.n_layer, n_stages_cfg):
-            raise ValueError(
-                f"PP_DECODE uses equal stage-major stacking: BOUNDARIES "
-                f"{list(cfg.boundaries)} must be the equal split "
-                f"{balanced_boundaries(config.n_layer, n_stages_cfg)} "
-                f"for n_layer={config.n_layer}")
     runner = None
     spec_runner = None
     # What /healthz reports as n_stages: the decode topology actually
@@ -245,11 +251,33 @@ def create_app(cfg: Optional[ServingConfig] = None,
         elif not stageable:
             # MoE's expert tree isn't stage-partitionable; the whole
             # model decodes as one program on the pod's devices
-            # (models.family_module dispatch in the engine).
+            # (models.family_module dispatch in the engine). EP_DECODE
+            # shards the expert stack over an ep mesh axis spanning the
+            # pod's devices (validated above).
             from ..runtime.engine import DecodeEngine
+            mesh = None
+            if cfg.ep_decode:
+                from ..parallel.spmd import make_mesh
+                ep_size = min(len(jax.devices()), config.n_experts)
+                mesh = make_mesh({"ep": ep_size}, jax.devices()[:ep_size])
             runner = DecodeEngine(params, config, max_seq=cfg.max_seq,
-                                  dtype=dtype, prefill_chunk=pchunk)
+                                  dtype=dtype, prefill_chunk=pchunk,
+                                  mesh=mesh)
             decode_stages = 1  # unstaged (no dense partition)
+        elif cfg.pp_decode:
+            # one stage per device, activations hop the ICI ring inside
+            # a single compiled program per phase (parallel.ppdecode) —
+            # the TPU-native endgame of the reference's per-token HTTP
+            # topology. Composes with int8 stage weights, uneven
+            # BOUNDARIES (padded stacking), and MAX_BATCH>1 (the batcher
+            # wraps below; ragged rows ride per-row pad masks).
+            from ..parallel.ppdecode import PipelinedDecoder
+            from ..parallel.spmd import make_mesh
+            n_st = len(cfg.boundaries) + 1
+            mesh = make_mesh({"pp": n_st}, jax.devices()[:n_st])
+            runner = PipelinedDecoder(params, config, mesh,
+                                      max_seq=cfg.max_seq, dtype=dtype,
+                                      boundaries=list(cfg.boundaries))
         elif (cfg.max_batch > 1 or cfg.inference_dtype == "int8" or pchunk
               or cfg.prefix_cache > 0):
             # Continuous batching multiplexes concurrent requests onto
@@ -264,32 +292,25 @@ def create_app(cfg: Optional[ServingConfig] = None,
             runner = DecodeEngine(params, config, max_seq=cfg.max_seq,
                                   boundaries=list(cfg.boundaries),
                                   dtype=dtype, prefill_chunk=pchunk)
-        elif cfg.pp_decode:
-            # one stage per device, activations hop the ICI ring inside
-            # a single compiled program per phase (parallel.ppdecode) —
-            # the TPU-native endgame of the reference's per-token HTTP
-            # topology (zero host dispatches per token)
-            from ..parallel.ppdecode import PipelinedDecoder
-            from ..parallel.spmd import make_mesh
-            n_st = len(cfg.boundaries) + 1
-            mesh = make_mesh({"pp": n_st}, jax.devices()[:n_st])
-            runner = PipelinedDecoder(params, config, mesh,
-                                      max_seq=cfg.max_seq, dtype=dtype)
         else:
             runner = PipelineRunner(params, config, list(cfg.boundaries),
                                     max_seq=cfg.max_seq, dtype=dtype)
+        prefix_runner = None
         if cfg.prefix_cache > 0:
             # cross-request KV reuse (runtime.prefix_cache): wraps the
             # plain single-stream engine built above; with SPEC_DECODE
             # also on, the verify loop decodes off the prefix-built cache
             from ..runtime.prefix_cache import PrefixCachingEngine
-            runner = PrefixCachingEngine(
+            prefix_runner = PrefixCachingEngine(
                 runner, capacity=cfg.prefix_cache,
                 chunk=cfg.prefill_chunk or 64, spec=spec_runner)
+            runner = prefix_runner
         if cfg.max_batch > 1:
             from ..runtime.batcher import BatchingEngine
-            runner = BatchingEngine(runner, max_batch=cfg.max_batch,
-                                    max_wait_ms=cfg.batch_wait_ms)
+            base = prefix_runner.plain if prefix_runner is not None else runner
+            runner = BatchingEngine(base, max_batch=cfg.max_batch,
+                                    max_wait_ms=cfg.batch_wait_ms,
+                                    prefix=prefix_runner)
     if not partitionable:
         compat_specs = compat_params = None
     else:
@@ -313,8 +334,13 @@ def create_app(cfg: Optional[ServingConfig] = None,
     @app.get("/healthz")
     def healthz():
         live = {}
-        if hasattr(runner, "stats"):  # prefix cache: live hit/miss/entries
-            live["prefix_cache_stats"] = runner.stats()
+        # prefix cache: live hit/miss/entries — directly, or through the
+        # batcher when PREFIX_CACHE composes with MAX_BATCH>1
+        prefix_src = getattr(runner, "prefix", None)
+        if prefix_src is None and hasattr(runner, "stats"):
+            prefix_src = runner
+        if prefix_src is not None and hasattr(prefix_src, "stats"):
+            live["prefix_cache_stats"] = prefix_src.stats()
         if spec_runner is not None:  # speculation: live acceptance stats
             live["spec_decode_stats"] = spec_runner.stats()
         return {
@@ -330,6 +356,7 @@ def create_app(cfg: Optional[ServingConfig] = None,
             "prefill_chunk": cfg.prefill_chunk,
             "prefix_cache": cfg.prefix_cache,
             "pp_decode": cfg.pp_decode,
+            "ep_decode": cfg.ep_decode,
             "devices": [str(d) for d in jax.devices()],
         }
 

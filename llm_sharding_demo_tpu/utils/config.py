@@ -71,6 +71,12 @@ class ServingConfig:
     # default (the host-driven PipelineRunner / staged engine serve the
     # single-chip case).
     pp_decode: bool = False
+    # Expert-parallel inference (MoE family only): shard the stacked
+    # expert weights over an ``ep`` mesh axis spanning this pod's devices
+    # — each chip holds and streams E/ep experts; GSPMD derives the
+    # dispatch/combine collectives. Off by default (unstaged single-group
+    # decode, the round-2 behavior).
+    ep_decode: bool = False
 
     def __post_init__(self):
         if self.shard_role not in VALID_ROLES:
@@ -185,4 +191,5 @@ def from_env() -> ServingConfig:
         prefill_chunk=_env_int("PREFILL_CHUNK", 0),
         prefix_cache=_env_int("PREFIX_CACHE", 0),
         pp_decode=_env_bool("PP_DECODE"),
+        ep_decode=_env_bool("EP_DECODE"),
     )

@@ -49,8 +49,13 @@ def test_flash_backward_matches_xla(block_q, block_k):
                                    atol=5e-5, rtol=5e-5)
 
 
-def test_model_forward_pallas_impl_matches_xla():
-    """attention_impl='pallas' is numerics-identical at the model level."""
+def test_model_forward_pallas_impl_matches_xla(monkeypatch):
+    """attention_impl='pallas' is numerics-identical at the model level.
+    (FLASH_MIN_SEQ pinned to 0 so the test shapes actually reach the
+    kernel — the crossover dispatch would otherwise route them to XLA
+    and the oracle would compare XLA to itself.)"""
+    from llm_sharding_demo_tpu.ops import flash_attention as fa
+    monkeypatch.setattr(fa, "FLASH_MIN_SEQ", 0)
     cfg_x = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_embd=32,
                             n_layer=2, n_head=4)
     cfg_p = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_embd=32,
@@ -63,8 +68,10 @@ def test_model_forward_pallas_impl_matches_xla():
                                atol=2e-5, rtol=2e-5)
 
 
-def test_flash_is_differentiable():
+def test_flash_is_differentiable(monkeypatch):
     """Training forwards use this path: grads must flow (Pallas bwd kernels)."""
+    from llm_sharding_demo_tpu.ops import flash_attention as fa
+    monkeypatch.setattr(fa, "FLASH_MIN_SEQ", 0)
     cfg_p = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_embd=32,
                             n_layer=2, n_head=4, attention_impl="pallas")
     cfg_x = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_embd=32,
@@ -107,7 +114,7 @@ def test_flash_survives_extreme_negative_scores():
                                atol=2e-5, rtol=2e-3)
 
 
-def test_flash_prefill_in_decode_engine():
+def test_flash_prefill_in_decode_engine(monkeypatch):
     """attention_impl='pallas' now accelerates the ENGINE's fresh-cache
     prefill (not just the no-cache forward): generated streams match the
     xla engine for both dense families (GQA heads repeat for the kernel;
@@ -116,6 +123,8 @@ def test_flash_prefill_in_decode_engine():
 
     import numpy as np
 
+    from llm_sharding_demo_tpu.ops import flash_attention as fa
+    monkeypatch.setattr(fa, "FLASH_MIN_SEQ", 0)
     from llm_sharding_demo_tpu.models import gpt2 as g
     from llm_sharding_demo_tpu.models import llama
     from llm_sharding_demo_tpu.runtime.engine import DecodeEngine

@@ -195,11 +195,13 @@ def test_long_context_beyond_gpt2_ceiling(hf_pair):
     assert int(out.tokens[0, -1]) == want
 
 
-def test_llama_pallas_and_ring_attention_impls(hf_pair):
+def test_llama_pallas_and_ring_attention_impls(hf_pair, monkeypatch):
     """The alternate attention impls are product paths for llama too: GQA
     heads repeat into the full-width kernels and match the grouped xla
     einsum. ring runs on a dp×sp mesh (sequence sharded)."""
     from llm_sharding_demo_tpu.parallel import spmd
+    from llm_sharding_demo_tpu.ops import flash_attention as fa
+    monkeypatch.setattr(fa, "FLASH_MIN_SEQ", 0)  # reach the kernel at test shapes
 
     _, config, params = hf_pair
     ids = np.random.default_rng(8).integers(0, config.vocab_size, (2, 9))

@@ -60,15 +60,44 @@ def test_ppdecode_sampling_deterministic(model):
     np.testing.assert_array_equal(a.tokens, b.tokens)
 
 
-def test_ppdecode_rejects_ragged_and_uneven(model):
+def test_ppdecode_ragged_batch_matches_engine(model):
+    """Round-3 composition: ragged left-padded batches decode through the
+    ppermute program with per-row pad masks — token-exact vs the
+    single-device engine row for row."""
     cfg, params = model
     mesh = make_mesh({"pp": 2}, jax.devices()[:2])
     dec = PipelinedDecoder(params, cfg, mesh, max_seq=64)
-    with pytest.raises(NotImplementedError, match="equal-length"):
-        dec.generate([[1, 2], [1, 2, 3]], 4)
+    eng = DecodeEngine(params, cfg, max_seq=64)
+    ragged = [[5, 6, 7], [1, 2, 3, 4, 5]]
+    a = eng.generate(ragged, 8)
+    b = dec.generate(ragged, 8)
+    np.testing.assert_array_equal(a.tokens, b.tokens)
+
+
+def test_ppdecode_uneven_stages_match_engine(model, want):
+    """3 stages over 4 layers: zero-padded stage-major stacking with
+    identity masking (partition.stack_stage_params_padded) — the uneven
+    partition decodes token-exact."""
+    cfg, params = model
+    prompt, expected = want
     mesh3 = make_mesh({"pp": 3}, jax.devices()[:3])
-    with pytest.raises(ValueError, match="not divisible"):
-        PipelinedDecoder(params, cfg, mesh3, max_seq=64)
+    dec = PipelinedDecoder(params, cfg, mesh3, max_seq=64)
+    assert dec._valid is not None       # really took the padded path
+    np.testing.assert_array_equal(dec.generate(prompt, 12).tokens, expected)
+
+
+def test_ppdecode_int8_matches_int8_engine(model):
+    """Weight-only int8 stage weights: the ppermute program quantizes via
+    ops.quant exactly like the engine, so the two int8 streams agree."""
+    cfg, params = model
+    prompt = np.random.default_rng(3).integers(0, cfg.vocab_size, size=(2, 7))
+    mesh = make_mesh({"pp": 2}, jax.devices()[:2])
+    dec = PipelinedDecoder(params, cfg, mesh, max_seq=64, dtype="int8")
+    eng = DecodeEngine(params, cfg, max_seq=64, dtype="int8",
+                       decode_kernel="xla")
+    a = eng.generate(prompt, 10)
+    b = dec.generate(prompt, 10)
+    np.testing.assert_array_equal(a.tokens, b.tokens)
 
 
 def test_staged_engine_matches_plain(model, want):
@@ -141,11 +170,27 @@ def test_serving_pp_decode_knob():
     assert pp.post("/generate", json=body).json() == \
         plain.post("/generate", json=body).json()
 
-    with pytest.raises(ValueError, match="equal split"):
-        create_app(ServingConfig(model_id="t", boundaries=(1,),
-                                 pp_decode=True),
-                   model=(config, params), tokenizer=ByteTokenizer())
-    with pytest.raises(ValueError, match="mutually exclusive"):
-        create_app(ServingConfig(model_id="t", pp_decode=True, max_batch=4,
-                                 boundaries=(2,)),
+    # round 3: uneven boundaries serve (padded stacking) ...
+    uneven = TestClient(create_app(
+        ServingConfig(model_id="t", max_seq=64, boundaries=(1,),
+                      pp_decode=True),
+        model=(config, params), tokenizer=ByteTokenizer()))
+    assert uneven.post("/generate", json=body).json() == \
+        plain.post("/generate", json=body).json()
+    # ... as do int8 + batched pp decode (the composed production shape)
+    combo = TestClient(create_app(
+        ServingConfig(model_id="t", max_seq=64, boundaries=(2,),
+                      pp_decode=True, max_batch=4,
+                      inference_dtype="int8"),
+        model=(config, params), tokenizer=ByteTokenizer()))
+    int8_plain = TestClient(create_app(
+        ServingConfig(model_id="t", max_seq=64, boundaries=(2,),
+                      inference_dtype="int8"),
+        model=(config, params), tokenizer=ByteTokenizer()))
+    assert combo.post("/generate", json=body).json() == \
+        int8_plain.post("/generate", json=body).json()
+    # speculation/prefix/chunked prefill still own the engine's programs
+    with pytest.raises(ValueError, match="own the single-device"):
+        create_app(ServingConfig(model_id="t", pp_decode=True,
+                                 spec_decode=4, boundaries=(2,)),
                    model=(config, params), tokenizer=ByteTokenizer())
