@@ -113,6 +113,13 @@ def _kernel(meta_ref,                      # SMEM  [2] int32 (li, off)
             kvbuf.at[slot], copy_sems.at[slot])
 
     fetch(0, 0).start()
+    # the column write's RMW window read starts NOW so its latency hides
+    # behind the block stream (it reads pre-write state: rows < off are
+    # never touched by this kernel until the final write below)
+    base = (off // _WRITE_ROWS) * _WRITE_ROWS
+    win_rd = pltpu.make_async_copy(
+        kv_in.at[li, :, :, pl.ds(base, _WRITE_ROWS), :], winbuf, write_sem)
+    win_rd.start()
     m_ref[...] = jnp.full((bh, g, 1), NEG_INF, jnp.float32)
     l_ref[...] = jnp.zeros((bh, g, 1), jnp.float32)
     acc_ref[...] = jnp.zeros((bh, g, 2 * hd), jnp.float32)
@@ -164,16 +171,13 @@ def _kernel(meta_ref,                      # SMEM  [2] int32 (li, off)
     out_ref[...] = (acc_v / l_fin).astype(out_ref.dtype)
 
     # in-place fused-row write for ALL (b, h) at once: read-modify-write
-    # of one 8-row-aligned window per cache slice, two DMAs total. The
-    # cache is aliased in/out, so these windows are the ONLY mutation —
-    # untouched slots never copy. (Single-row HBM writes are not DMA-able
-    # under bf16 tiling; the window's earlier rows are past positions and
-    # its later rows future garbage, both preserved.)
-    base = (off // _WRITE_ROWS) * _WRITE_ROWS
-    rd = pltpu.make_async_copy(
-        kv_in.at[li, :, :, pl.ds(base, _WRITE_ROWS), :], winbuf, write_sem)
-    rd.start()
-    rd.wait()
+    # of one 8-row-aligned window per cache slice. The cache is aliased
+    # in/out, so these windows are the ONLY mutation — untouched slots
+    # never copy. (Single-row HBM writes are not DMA-able under bf16
+    # tiling; the window's earlier rows are past positions and its later
+    # rows future garbage, both preserved.) The read was issued at kernel
+    # entry (win_rd) so only the final write's latency is exposed.
+    win_rd.wait()
     kn2 = knew_ref[...].reshape(batch * hkv, hd).astype(jnp.float32)
     vn2 = vnew_ref[...].reshape(batch * hkv, hd).astype(jnp.float32)
     rows = (jax.lax.dot_general(kn2, p_k, (((1,), (0,)), ((), ())),

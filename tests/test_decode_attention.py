@@ -108,6 +108,22 @@ def test_kernel_mode_composes_with_spec_and_chunked_prefill():
     assert list(sp.tokens[0]) == want
 
 
+def test_staged_engine_with_kernel_matches_xla():
+    """DecodeEngine(boundaries=...) + the decode kernel: per-stage fused
+    caches, kernel invoked per stage — streams match the XLA engine."""
+    cfg = gpt2.GPT2Config(vocab_size=211, n_positions=1024, n_embd=64,
+                          n_layer=4, n_head=1)
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(5))
+    p = np.asarray([[5, 9, 2, 77, 30]])
+    a = DecodeEngine(params, cfg, max_seq=300,
+                     decode_kernel="xla").generate(p, 24)
+    staged = DecodeEngine(params, cfg, max_seq=300, boundaries=[1, 3],
+                          decode_kernel="interpret")
+    assert is_fused_cache(staged._fresh_cache(1)[0])
+    b = staged.generate(p, 24)
+    assert list(a.tokens[0]) == list(b.tokens[0])
+
+
 def test_eligibility_gates():
     assert eligible(BLOCK_S, 64, 1)
     assert not eligible(BLOCK_S, 64, 2)        # multi-token query
