@@ -280,3 +280,43 @@ def test_spec_stats_surface(model):
     s = client.get("/healthz").json()["spec_decode_stats"]
     assert s["requests"] == 1 and s["verify_steps"] >= 1
     assert s["emitted_tokens"] == 8
+
+
+def test_serving_ep_decode_knob():
+    """EP_DECODE=1 serves MoE /generate with the expert stack sharded
+    over the pod's devices, byte-equal to the unsharded runner;
+    misconfigurations refuse at startup."""
+    import jax
+    import pytest
+
+    from llm_sharding_demo_tpu.models import gpt2, moe
+    from llm_sharding_demo_tpu.serving.app import create_app
+    from llm_sharding_demo_tpu.serving.http import TestClient
+    from llm_sharding_demo_tpu.serving.tokenizer import ByteTokenizer
+    from llm_sharding_demo_tpu.utils.config import ServingConfig
+
+    mcfg = moe.MoEConfig(vocab_size=256, n_positions=64, n_embd=16,
+                         n_layer=2, n_head=2, n_experts=8, expert_top_k=2)
+    mparams = moe.init_params(mcfg, jax.random.PRNGKey(0))
+    body = {"prompt": "Hi, ", "max_new_tokens": 5, "mode": "greedy"}
+
+    ep = TestClient(create_app(
+        ServingConfig(model_id="t", max_seq=64, ep_decode=True),
+        model=(mcfg, mparams), tokenizer=ByteTokenizer()))
+    assert ep.get("/healthz").json()["ep_decode"] is True
+    plain = TestClient(create_app(
+        ServingConfig(model_id="t", max_seq=64),
+        model=(mcfg, mparams), tokenizer=ByteTokenizer()))
+    assert ep.post("/generate", json=body).json() == \
+        plain.post("/generate", json=body).json()
+
+    dense = gpt2.GPT2Config(vocab_size=256, n_positions=64, n_embd=16,
+                            n_layer=2, n_head=2)
+    with pytest.raises(ValueError, match="no expert axis"):
+        create_app(ServingConfig(model_id="t", max_seq=64, ep_decode=True),
+                   model=(dense, gpt2.init_params(dense, jax.random.PRNGKey(0))),
+                   tokenizer=ByteTokenizer())
+    with pytest.raises(ValueError, match="own other decode programs"):
+        create_app(ServingConfig(model_id="t", max_seq=64, ep_decode=True,
+                                 prefix_cache=2),
+                   model=(mcfg, mparams), tokenizer=ByteTokenizer())

@@ -38,16 +38,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-
-def _fetch(x) -> None:
-    np.asarray(jax.tree_util.tree_leaves(x)[0].ravel()[0])
+# THE timing harness lives in bench.py (incl. the non-positive-marginal
+# guard for windows drowned by barrier jitter) — reuse, don't re-derive
+from bench import _fetch, marginal_seconds
 
 
 def marginal(window, n1: int, n2: int, reps: int = 3) -> float:
-    window(n1), window(n2)
-    t1 = min(window(n1) for _ in range(reps))
-    t2 = min(window(n2) for _ in range(reps))
-    return (t2 - t1) / (n2 - n1)
+    m = marginal_seconds(window, n1, n2, reps=reps)
+    if m is None:
+        raise RuntimeError("marginal below the tunnel's timer resolution "
+                           "(t2 <= t1); enlarge the windows")
+    return m
 
 
 def probe_engine() -> None:
