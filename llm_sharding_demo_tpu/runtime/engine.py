@@ -235,6 +235,38 @@ class GenerateResult:
         return self.decode_seconds / self.decode_steps
 
 
+def _place_ep_params(params: Params, config, mesh, ep_axis: str) -> Params:
+    """Expert-parallel placement: stacked expert leaves ``[L, E, ...]``
+    shard over ``ep`` on their E axis (int8 ``QuantizedTensor`` codes and
+    scales in lockstep), everything else replicates. Validates the
+    mesh/family contract — see the ``DecodeEngine(mesh=...)`` docs."""
+    if not hasattr(config, "n_experts"):
+        raise ValueError(
+            "mesh/ep decode applies to the MoE family; dense "
+            "models shard via parallel.spmd / parallel.ppdecode")
+    if ep_axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no {ep_axis!r} axis: {mesh.axis_names}")
+    ep = mesh.shape[ep_axis]
+    if config.n_experts % ep:
+        raise ValueError(
+            f"n_experts={config.n_experts} not divisible by ep={ep}")
+    from jax.sharding import NamedSharding, PartitionSpec as P_
+
+    def place(path, leaf):
+        names = [getattr(p, "key", p) for p in path]
+        if "experts" in names:
+            ndim = leaf.q.ndim if hasattr(leaf, "q") else leaf.ndim
+            spec = P_(None, ep_axis, *([None] * (ndim - 2)))
+        else:
+            spec = P_()
+        return jax.tree.map(
+            lambda x: jax.device_put(
+                x, NamedSharding(mesh, P_(*spec[:x.ndim]))), leaf)
+
+    return jax.tree_util.tree_map_with_path(
+        place, params, is_leaf=lambda x: hasattr(x, "q") or hasattr(x, "ndim"))
+
+
 class DecodeEngine:
     """Single-model decode engine (pipeline-parallel variant in
     ``parallel.pipeline``): owns jitted prefill/decode programs keyed by
@@ -323,40 +355,11 @@ class DecodeEngine:
         # the traffic ep-sharding exists to avoid).
         self._ep_mesh = mesh
         if mesh is not None:
-            if not hasattr(config, "n_experts"):
-                raise ValueError(
-                    "mesh/ep decode applies to the MoE family; dense "
-                    "models shard via parallel.spmd / parallel.ppdecode")
-            if ep_axis not in mesh.axis_names:
-                raise ValueError(
-                    f"mesh has no {ep_axis!r} axis: {mesh.axis_names}")
-            ep = mesh.shape[ep_axis]
-            if config.n_experts % ep:
-                raise ValueError(
-                    f"n_experts={config.n_experts} not divisible by "
-                    f"ep={ep}")
             if boundaries is not None:
                 raise ValueError("ep decode and stage partitioning are "
                                  "mutually exclusive (MoE decodes "
                                  "unstaged)")
-            from jax.sharding import NamedSharding, PartitionSpec as P_
-
-            def place(path, leaf):
-                names = [getattr(p, "key", p) for p in path]
-                if "experts" in names:
-                    # stacked expert leaves: [L, E, ...] — shard axis 1
-                    ndim = (leaf.q.ndim if hasattr(leaf, "q")
-                            else leaf.ndim)
-                    spec = P_(None, ep_axis, *([None] * (ndim - 2)))
-                else:
-                    spec = P_()
-                return jax.tree.map(
-                    lambda x: jax.device_put(
-                        x, NamedSharding(mesh, P_(*spec[:x.ndim]))), leaf)
-
-            self.params = jax.tree_util.tree_map_with_path(
-                place, self.params,
-                is_leaf=lambda x: hasattr(x, "q") or hasattr(x, "ndim"))
+            self.params = _place_ep_params(self.params, config, mesh, ep_axis)
         # Model dispatch: any family module exposing the
         # (forward_with_cache, make_cache) pair can be decoded
         # (models.family_module — gpt2, moe, llama). Stage partitioning
@@ -396,13 +399,13 @@ class DecodeEngine:
                 f"decode_kernel={decode_kernel!r} not auto|xla|interpret")
         self._cache_seq = max_seq
         self._decode_kernel: Optional[str] = None
-        # "auto" additionally requires a non-fp32 compute dtype: fp32 is
-        # BASELINE.json's byte-pinned greedy-parity mode, and the kernel's
-        # online softmax is allclose-not-bitwise vs the einsum path.
-        # under an ep mesh the attention stays in partitioned XLA — the
-        # kernel's manual DMAs don't compose with GSPMD partitioning.
-        # "auto" quietly resolves to XLA there; the EXPLICIT kernel
-        # request refuses rather than silently running something else
+        # "auto" engages only for non-fp32 dtypes (fp32 is BASELINE.json's
+        # byte-pinned greedy-parity mode; the kernel's online softmax is
+        # allclose-not-bitwise vs the einsum path) and only without an ep
+        # mesh (the kernel's manual DMAs don't compose with GSPMD
+        # partitioning — "auto" quietly resolves to XLA there, while the
+        # EXPLICIT kernel request refuses rather than silently running
+        # something else).
         if mesh is not None and decode_kernel == "interpret":
             raise ValueError(
                 "decode_kernel='interpret' does not compose with an ep "
