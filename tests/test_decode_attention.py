@@ -135,3 +135,18 @@ def test_eligibility_gates():
                        cfg, max_seq=64, decode_kernel="interpret")
     assert eng._decode_kernel is None
     assert not is_fused_cache(eng._fresh_cache(1))
+
+
+def test_fp32_parity_mode_never_takes_the_kernel(monkeypatch):
+    """BASELINE.json's fp32 greedy-parity mode must stay on the
+    byte-pinned XLA path even on a TPU backend where "auto" would
+    otherwise engage the (allclose-not-bitwise) kernel."""
+    import llm_sharding_demo_tpu.runtime.engine as eng_mod
+    monkeypatch.setattr(eng_mod.jax, "default_backend", lambda: "tpu")
+    cfg = gpt2.GPT2Config(vocab_size=97, n_positions=1024, n_embd=64,
+                          n_layer=2, n_head=1)
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    fp32 = DecodeEngine(params, cfg, max_seq=300, dtype=jnp.float32)
+    assert fp32._decode_kernel is None          # parity mode -> XLA
+    bf16 = DecodeEngine(params, cfg, max_seq=300, dtype=jnp.bfloat16)
+    assert bf16._decode_kernel == "device"      # fast path -> kernel
