@@ -563,13 +563,31 @@ def measure_training(config, batch: int = 8, seq: int = 512,
     if m is None:
         return {"error": "marginal below timer resolution"}
     tokens_per_sec = batch * seq / m
-    peak = 197e12  # v5e bf16
-    return {
+    out = {
         "tokens_per_sec": round(tokens_per_sec, 1),
         "step_ms": round(m * 1e3, 2),
         "batch": batch, "seq": seq, "n_params": n_params,
-        "mfu": round(tokens_per_sec * 6 * n_params / peak, 4),
     }
+    peak = _peak_bf16_flops()
+    if peak is not None:  # MFU only when the device's peak is known —
+        out["peak_flops"] = peak  # a hard-coded v5e peak would silently
+        out["mfu"] = round(tokens_per_sec * 6 * n_params / peak, 4)
+        # mislabel MFU on other backends (incl. the CPU fallback)
+    return out
+
+
+def _peak_bf16_flops():
+    """Dense bf16 peak for the attached device kind, or None when unknown
+    (CPU fallback, unrecognized TPU generation)."""
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    for tag, peak in (("v5 lite", 197e12), ("v5e", 197e12),
+                      ("v5p", 459e12), ("v5", 459e12),
+                      ("v6 lite", 918e12), ("v6e", 918e12),
+                      ("v4", 275e12)):
+        if tag in kind:
+            return peak
+    return None
 
 
 def measure_gpipe_overhead() -> dict:

@@ -51,9 +51,11 @@ class GPT2Config:
     # kernel (ops.flash_attention). "ring": sequence-parallel ring
     # attention over the mesh's "sp" axis (ops.ring_attention) — the
     # long-context path; requires a mesh passed to ``forward``. All three
-    # apply to the no-cache forward (training / compat endpoints); cached
-    # decode always uses the fused XLA path (single-token steps have no
-    # sequence dim to shard or tile).
+    # apply to the no-cache forward (training / compat endpoints).
+    # Cached single-token decode has its own dispatch, independent of
+    # this knob: the engine's ``decode_kernel`` routes it through the
+    # Pallas flash-decode kernel (ops.decode_attention) on TPU, or the
+    # fused XLA path in the byte-pinned parity modes.
     attention_impl: str = "xla"
 
     @property

@@ -263,6 +263,9 @@ class BatchingEngine:
             logits, cache, _ = self.prefix.prefill_state(req.prompt)
             states.append((logits, cache))
         while len(states) < ids.shape[0]:        # dummy rows replicate last
+            # (their pad/ids were already replicated from the same source
+            # row in _run, so a dummy row's cache, pad and positions are
+            # self-consistent — it is a full clone of the last real row)
             states.append(states[len(batch) - 1])
         first = jnp.argmax(jnp.concatenate([s[0] for s in states], axis=0),
                            axis=-1).astype(jnp.int32)

@@ -391,8 +391,10 @@ class DecodeEngine:
         # einsum path (the byte-pinned parity mode), "interpret" forces
         # the kernel in interpret mode for CPU tests. The kernel needs the
         # cache allocated in whole blocks, so the PHYSICAL cache rounds up
-        # to a BLOCK_S multiple (capped at n_positions; ineligible shapes
-        # fall back to "xla" with the exact ``max_seq`` allocation).
+        # to a BLOCK_S multiple (capped at n_positions). On ineligible
+        # shapes "auto" falls back to "xla" with the exact ``max_seq``
+        # allocation; an EXPLICIT "interpret" request refuses instead
+        # (see the raise below).
         from ..ops import decode_attention as _DA
         if decode_kernel not in ("auto", "xla", "interpret"):
             raise ValueError(
@@ -424,6 +426,17 @@ class DecodeEngine:
                 self._decode_kernel = ("interpret"
                                        if decode_kernel == "interpret"
                                        else "device")
+            elif decode_kernel == "interpret":
+                # An EXPLICIT kernel request must never silently run
+                # something else (mirrors the ep-mesh refusal above): a
+                # config slip would otherwise stop exercising the kernel
+                # in tests that forget to assert _decode_kernel. Only
+                # "auto" may quietly resolve to XLA.
+                raise ValueError(
+                    "decode_kernel='interpret' requested but the geometry "
+                    f"is ineligible (head_dim={config.head_dim}, "
+                    f"cache={rounded}): needs 2*head_dim % 128 == 0 and a "
+                    f"whole-{_DA.BLOCK_S}-block cache; use 'auto' or 'xla'")
         # Prefill allocates its cache *inside* the program (zeros are free
         # under XLA and the layout matches the decode program exactly);
         # decode donates the prefill-produced cache so the two
@@ -577,18 +590,22 @@ class DecodeEngine:
         return merge(full, sub)
 
     def _segments(self, start_depth: int, steps: int,
-                  bucket: int = 128) -> list:
+                  bucket: int = 128, quant: int = 32) -> list:
         """Split ``steps - 1`` decode forwards into ``(n_forwards, window)``
         segments. The forward at cache depth ``d`` needs ``window >= d+1``;
         windows are power-of-two multiples of ``bucket``. Once the window
         reaches ``max_seq`` the remainder runs as ``(n, None)`` — the plain
         full-cache program, shared by every generate (no slice/merge).
 
-        Compile-space note: the FIRST segment's length is ``w - depth``,
-        so the decode program set is keyed by (depth-to-bucket-edge
-        distance, steps) rather than steps alone — a handful of extra
-        (smaller) programs per prompt bucket, traded for attention reads
-        that track actual depth instead of ``max_seq``.
+        Compile-space note: intermediate segment lengths are quantized
+        DOWN to multiples of ``quant`` (a depth within ``quant`` of a
+        window edge skips straight to the next window), so the program
+        set is bounded by {multiples of quant} x {log windows} no matter
+        how many distinct prompt depths serving sees — unbatched traffic
+        with arbitrary prompt lengths compiles the same handful of
+        bodies. Only the FINAL segment's length is request-keyed
+        (= remaining steps), exactly like the pre-windowing steps-keyed
+        scheme, and the batcher's ``steps_bucket`` already quantizes that.
 
         With the flash-decode kernel active, segmentation is pointless:
         the kernel's block loop already bounds its reads by the live
@@ -603,10 +620,17 @@ class DecodeEngine:
             w = bucket
             while w < d + 1:
                 w *= 2
+            if w - d < quant and w < self.max_seq:
+                w *= 2  # too close to the edge: a sub-quant segment
+                        # would mint a new program for little read saving
             if w >= self.max_seq:
                 segs.append((total, None))
                 break
-            n = min(total, w - d)
+            room = w - d
+            if room >= total:
+                segs.append((total, w))
+                break
+            n = (room // quant) * quant
             segs.append((n, w))
             d += n
             total -= n

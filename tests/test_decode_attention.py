@@ -129,10 +129,16 @@ def test_eligibility_gates():
     assert not eligible(BLOCK_S, 64, 2)        # multi-token query
     assert not eligible(BLOCK_S - 1, 64, 1)    # unaligned cache
     assert not eligible(BLOCK_S, 8, 1)         # tiny head dim
-    # ineligible geometry must silently fall back to the XLA engine
+    # an EXPLICIT kernel request on ineligible geometry must refuse
+    # loudly (silent fallback is reserved for "auto" — a config slip
+    # would otherwise stop exercising the kernel unnoticed)
     cfg = gpt2.CONFIGS["tiny-gpt2"]            # hd == 1
+    with pytest.raises(ValueError, match="ineligible"):
+        DecodeEngine(gpt2.init_params(cfg, jax.random.PRNGKey(0)),
+                     cfg, max_seq=64, decode_kernel="interpret")
+    # "auto" on the same geometry quietly keeps the XLA engine
     eng = DecodeEngine(gpt2.init_params(cfg, jax.random.PRNGKey(0)),
-                       cfg, max_seq=64, decode_kernel="interpret")
+                       cfg, max_seq=64, decode_kernel="auto")
     assert eng._decode_kernel is None
     assert not is_fused_cache(eng._fresh_cache(1))
 

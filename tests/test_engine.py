@@ -257,3 +257,56 @@ def test_empirical_top_p_sampler_matches_pmf():
     freq = counts / n
     tol = 4 * np.sqrt(pmf * (1 - pmf) / n) + 1e-3
     assert (np.abs(freq - pmf) <= tol).all()
+
+
+def test_segments_invariants_and_bounded_program_set():
+    """The windowed-segment planner must (a) cover exactly steps-1
+    forwards, (b) give every forward a window covering its cache depth,
+    and (c) key intermediate segments on a bounded set of lengths
+    (multiples of the quantum) no matter the prompt depth — the
+    compile-space contract behind unbatched serving."""
+    cfg = gpt2.GPT2Config(vocab_size=97, n_positions=4096, n_embd=64,
+                          n_layer=1, n_head=1)
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    eng = DecodeEngine(params, cfg, max_seq=4096)
+    quant = 32
+    intermediate_lengths = set()
+    for depth in list(range(1, 600, 7)) + [127, 128, 129, 255, 511, 1023]:
+        for steps in (2, 17, 33, 200, 1000):
+            segs = eng._segments(depth, steps, quant=quant)
+            assert sum(n for n, _ in segs) == steps - 1
+            d = depth
+            for i, (n, w) in enumerate(segs):
+                assert n > 0
+                if w is not None:
+                    assert d + n <= w          # deepest forward in window
+                    assert w <= eng.max_seq
+                else:
+                    assert i == len(segs) - 1  # full-cache only at tail
+                if i < len(segs) - 1:
+                    assert n % quant == 0      # bounded program set
+                    intermediate_lengths.add(n)
+                d += n
+    # the whole sweep (85+ distinct depths) mints only a handful of
+    # intermediate segment programs
+    assert len(intermediate_lengths) <= 24
+
+
+def test_decode_with_edge_adjacent_depth_matches_unsegmented():
+    """A prompt depth within the quantum of a window edge takes the new
+    skip-ahead branch; the token stream must equal a full-window decode."""
+    cfg = gpt2.GPT2Config(vocab_size=211, n_positions=1024, n_embd=64,
+                          n_layer=2, n_head=2)
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(3))
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, cfg.vocab_size, size=(1, 126))  # 128 - 2
+    eng = DecodeEngine(params, cfg, max_seq=700)
+    segs = eng._segments(126, 80)
+    assert segs[0][1] == 256                   # skipped past the 128 edge
+    got = eng.generate(prompt, max_new_tokens=80)
+    # oracle: a fresh engine whose planner is forced to one full-cache
+    # segment (the unsegmented program)
+    oracle = DecodeEngine(params, cfg, max_seq=700)
+    oracle._segments = lambda depth, steps, **kw: [(steps - 1, None)]
+    want = oracle.generate(prompt, max_new_tokens=80)
+    assert np.array_equal(got.tokens, want.tokens)
