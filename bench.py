@@ -522,7 +522,9 @@ def measure_training(config, batch: int = 8, seq: int = 512,
 
     MFU convention: model FLOPs = 6 * n_params per token (fwd 2N + bwd
     4N; attention FLOPs and the remat recompute are excluded, the
-    standard accounting), against v5e's 197 TFLOP/s bf16 peak.
+    standard accounting), against the attached device kind's bf16 peak
+    (emitted as ``peak_flops``; MFU is omitted when the peak is unknown,
+    e.g. on the CPU fallback).
     """
     import jax
     import jax.numpy as jnp
@@ -877,7 +879,8 @@ def main() -> None:
             "gpipe_cpu_mesh": gp,
             "note": "single-chip jitted train step (fwd+bwd+AdamW, remat), "
                     "GPT-2 124M bf16; MFU = 6N-per-token model FLOPs vs "
-                    "197 TFLOP/s v5e peak; gpipe_cpu_mesh = pp4xdp2 GPipe "
+                    "the emitted peak_flops (device-kind bf16 peak; "
+                    "omitted when unknown); gpipe_cpu_mesh = pp4xdp2 GPipe "
                     "vs pure dp8 step-time ratio on the 8-device virtual "
                     "CPU mesh (schedule overhead; CPU absolute times are "
                     "not chip numbers)",
