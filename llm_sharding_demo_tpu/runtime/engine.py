@@ -240,10 +240,6 @@ def _place_ep_params(params: Params, config, mesh, ep_axis: str) -> Params:
     shard over ``ep`` on their E axis (int8 ``QuantizedTensor`` codes and
     scales in lockstep), everything else replicates. Validates the
     mesh/family contract — see the ``DecodeEngine(mesh=...)`` docs."""
-    if not hasattr(config, "n_experts"):
-        raise ValueError(
-            "mesh/ep decode applies to the MoE family; dense "
-            "models shard via parallel.spmd / parallel.ppdecode")
     if ep_axis not in mesh.axis_names:
         raise ValueError(f"mesh has no {ep_axis!r} axis: {mesh.axis_names}")
     ep = mesh.shape[ep_axis]
@@ -265,6 +261,39 @@ def _place_ep_params(params: Params, config, mesh, ep_axis: str) -> Params:
 
     return jax.tree_util.tree_map_with_path(
         place, params, is_leaf=lambda x: hasattr(x, "q") or hasattr(x, "ndim"))
+
+
+def _place_tp_params(params: Params, config, mesh) -> Params:
+    """Megatron tensor-parallel placement for dense-family decode: QKV/up
+    projections column-sharded, attention-out/down row-sharded over the
+    ``tp`` mesh axis (the family's ``parallel.spmd`` pspecs), embeddings
+    and norms replicated. GSPMD derives the two per-block all-reduces;
+    the KV cache shards over the head axis (``DecodeEngine._fresh_cache``)
+    so each chip attends only its own heads. This is the one classic
+    inference-parallelism axis the reference lacks entirely — its only
+    split is between layers (reference server.py:63-64)."""
+    from jax.sharding import NamedSharding
+
+    from ..models.llama import LlamaConfig
+    from ..parallel import spmd
+
+    # the spmd pspec helpers key on the literal axis name "tp"
+    if "tp" not in mesh.axis_names:
+        raise ValueError(f"mesh has no 'tp' axis: {mesh.axis_names}")
+    tp = mesh.shape["tp"]
+    kv_heads = getattr(config, "n_kv_head", config.n_head)
+    if config.n_head % tp or kv_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide n_head={config.n_head} and "
+            f"n_kv_head={kv_heads}: the KV cache and attention shard "
+            "over whole heads")
+    specs = (spmd.llama_param_pspecs(mesh) if isinstance(config, LlamaConfig)
+             else spmd.param_pspecs(mesh))
+
+    def place(spec, leaf):
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree.map(place, specs, params)
 
 
 class DecodeEngine:
@@ -345,21 +374,39 @@ class DecodeEngine:
         self.config = config
         self.max_seq = max_seq
         self.dtype = dtype
-        # Expert-parallel inference: with a mesh carrying an ``ep`` axis,
-        # the stacked expert kernels/biases shard over their E axis and
-        # everything else replicates — each chip holds (and streams)
-        # E/ep experts' weights, and GSPMD derives the dispatch/combine
-        # collectives from the dense formulation (the routed-gather fast
-        # path is disabled under a mesh: a jnp.take over the sharded E
-        # axis would make XLA all-gather the full expert stack, exactly
-        # the traffic ep-sharding exists to avoid).
-        self._ep_mesh = mesh
+        # Mesh decode — the family picks the parallelism axis:
+        #
+        # - MoE + mesh("ep"): expert-parallel inference. Stacked expert
+        #   kernels/biases shard over their E axis and everything else
+        #   replicates — each chip holds (and streams) E/ep experts'
+        #   weights, and GSPMD derives the dispatch/combine collectives
+        #   from the dense formulation (the routed-gather fast path is
+        #   disabled under a mesh: a jnp.take over the sharded E axis
+        #   would make XLA all-gather the full expert stack, exactly the
+        #   traffic ep-sharding exists to avoid).
+        # - dense (GPT-2 / llama) + mesh("tp"): tensor-parallel decode.
+        #   Megatron column/row-sharded projections (_place_tp_params),
+        #   KV cache sharded over heads, GSPMD-derived per-block
+        #   all-reduces — single-stream latency scaling across chips.
+        self._mesh = mesh
+        self._mesh_mode: Optional[str] = None
         if mesh is not None:
             if boundaries is not None:
-                raise ValueError("ep decode and stage partitioning are "
-                                 "mutually exclusive (MoE decodes "
-                                 "unstaged)")
-            self.params = _place_ep_params(self.params, config, mesh, ep_axis)
+                raise ValueError("mesh decode (ep/tp) and stage "
+                                 "partitioning are mutually exclusive")
+            if hasattr(config, "n_experts"):
+                self._mesh_mode = "ep"
+                self.params = _place_ep_params(self.params, config, mesh,
+                                               ep_axis)
+            else:
+                if quantize:
+                    raise NotImplementedError(
+                        "int8 does not compose with tp decode: the int8 "
+                        "streaming matmuls are unpartitioned Pallas "
+                        "kernels GSPMD cannot split; tp decode runs "
+                        "fp32/bf16")
+                self._mesh_mode = "tp"
+                self.params = _place_tp_params(self.params, config, mesh)
         # Model dispatch: any family module exposing the
         # (forward_with_cache, make_cache) pair can be decoded
         # (models.family_module — gpt2, moe, llama). Stage partitioning
@@ -410,8 +457,8 @@ class DecodeEngine:
         # something else).
         if mesh is not None and decode_kernel == "interpret":
             raise ValueError(
-                "decode_kernel='interpret' does not compose with an ep "
-                "mesh (the Pallas decode kernel is unpartitioned); use "
+                "decode_kernel='interpret' does not compose with a mesh "
+                "(the Pallas decode kernel is unpartitioned); use "
                 "'auto' or 'xla'")
         want = mesh is None and (
             decode_kernel == "interpret"
@@ -469,8 +516,20 @@ class DecodeEngine:
                                        self._cache_seq, self.config.head_dim,
                                        self.dtype) for s in self.specs]
         if self.specs is None:
-            return self._model.make_cache(self.config, batch,
-                                          self._cache_seq, self.dtype)
+            cache = self._model.make_cache(self.config, batch,
+                                           self._cache_seq, self.dtype)
+            if self._mesh_mode == "tp":
+                # [L, B, H, S, hd] buffers shard over the HEAD axis: each
+                # chip's attention reads/writes only its own heads' cache
+                # slots — no cross-chip KV traffic, only the two
+                # GSPMD-inserted per-block all-reduces touch ICI
+                from jax.sharding import NamedSharding, PartitionSpec as P_
+                sh = NamedSharding(self._mesh, P_(None, None, "tp"))
+                cache = KVCache(
+                    k=jax.lax.with_sharding_constraint(cache.k, sh),
+                    v=jax.lax.with_sharding_constraint(cache.v, sh),
+                    length=cache.length)
+            return cache
         from ..parallel import partition as P
         return [P.make_stage_cache(s, self.config, batch, self._cache_seq,
                                    self.dtype) for s in self.specs]
@@ -487,7 +546,7 @@ class DecodeEngine:
         """
         if self.specs is None:
             kw = {}
-            if self._ep_mesh is not None:
+            if self._mesh_mode == "ep":
                 kw["routed_mlp"] = False  # MoE only (validated in __init__)
             return self._model.forward_with_cache(
                 params, x, self.config, cache, pad,

@@ -197,6 +197,31 @@ def create_app(cfg: Optional[ServingConfig] = None,
             raise ValueError(
                 f"EP_DECODE: n_experts={config.n_experts} not divisible "
                 f"by the {ep_size}-device ep axis")
+    if cfg.tp_decode:
+        if not (cfg.shard_role == "coordinator" and cfg.dispatch == "local"):
+            raise ValueError("TP_DECODE applies to the coordinator's local "
+                             "decode path only")
+        if hasattr(config, "n_experts"):
+            raise ValueError(
+                "TP_DECODE shards dense-family projections; MoE models "
+                "shard their expert axis via EP_DECODE instead")
+        if (cfg.pp_decode or cfg.ep_decode or cfg.spec_decode > 0
+                or cfg.prefix_cache > 0):
+            raise ValueError(
+                "TP_DECODE composes with MAX_BATCH and PREFILL_CHUNK "
+                "only; PP_DECODE/EP_DECODE/SPEC_DECODE/PREFIX_CACHE own "
+                "other decode programs")
+        if cfg.inference_dtype == "int8":
+            raise ValueError(
+                "TP_DECODE runs fp32/bf16 (the int8 streaming matmuls "
+                "are unpartitioned Pallas kernels GSPMD cannot split)")
+        tp_size = len(jax.devices())
+        kv_heads = getattr(config, "n_kv_head", config.n_head)
+        if config.n_head % tp_size or kv_heads % tp_size:
+            raise ValueError(
+                f"TP_DECODE: this pod's {tp_size} devices must divide "
+                f"n_head={config.n_head} and n_kv_head={kv_heads} "
+                "(attention shards over whole heads)")
     if cfg.pp_decode:
         if not (cfg.shard_role == "coordinator" and cfg.dispatch == "local"):
             raise ValueError("PP_DECODE applies to the coordinator's local "
@@ -278,6 +303,19 @@ def create_app(cfg: Optional[ServingConfig] = None,
             runner = PipelinedDecoder(params, config, mesh,
                                       max_seq=cfg.max_seq, dtype=dtype,
                                       boundaries=list(cfg.boundaries))
+        elif cfg.tp_decode:
+            # tensor-parallel single-stream decode: Megatron column/row
+            # projections + head-sharded KV cache over a tp mesh spanning
+            # the pod's devices (runtime.engine._place_tp_params);
+            # composes with MAX_BATCH (the batcher wraps below) and
+            # PREFILL_CHUNK. Divisibility validated above.
+            from ..parallel.spmd import make_mesh
+            from ..runtime.engine import DecodeEngine
+            mesh = make_mesh({"tp": len(jax.devices())}, jax.devices())
+            runner = DecodeEngine(params, config, max_seq=cfg.max_seq,
+                                  dtype=dtype, prefill_chunk=pchunk,
+                                  mesh=mesh)
+            decode_stages = 1  # unstaged (tensor axis, not stage axis)
         elif (cfg.max_batch > 1 or cfg.inference_dtype == "int8" or pchunk
               or cfg.prefix_cache > 0):
             # Continuous batching multiplexes concurrent requests onto
@@ -357,6 +395,7 @@ def create_app(cfg: Optional[ServingConfig] = None,
             "prefix_cache": cfg.prefix_cache,
             "pp_decode": cfg.pp_decode,
             "ep_decode": cfg.ep_decode,
+            "tp_decode": cfg.tp_decode,
             "devices": [str(d) for d in jax.devices()],
         }
 

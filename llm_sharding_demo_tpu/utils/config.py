@@ -77,6 +77,12 @@ class ServingConfig:
     # dispatch/combine collectives. Off by default (unstaged single-group
     # decode, the round-2 behavior).
     ep_decode: bool = False
+    # Tensor-parallel inference (dense families): Megatron column/row-
+    # sharded projections + a head-sharded KV cache over a ``tp`` mesh
+    # axis spanning this pod's devices — single-stream latency scaling,
+    # GSPMD-derived per-block all-reduces. Requires the device count to
+    # divide n_head (and n_kv_head). fp32/bf16 only. Off by default.
+    tp_decode: bool = False
 
     def __post_init__(self):
         if self.shard_role not in VALID_ROLES:
@@ -192,4 +198,5 @@ def from_env() -> ServingConfig:
         prefix_cache=_env_int("PREFIX_CACHE", 0),
         pp_decode=_env_bool("PP_DECODE"),
         ep_decode=_env_bool("EP_DECODE"),
+        tp_decode=_env_bool("TP_DECODE"),
     )

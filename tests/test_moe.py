@@ -418,7 +418,10 @@ def test_ep_mesh_rejects_dense_families_and_bad_splits():
     mesh = make_mesh({"ep": 2}, jax.devices()[:2])
     g = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_embd=8,
                         n_layer=2, n_head=2)
-    with pytest.raises(ValueError, match="MoE family"):
+    # a dense family under a mesh dispatches to TP decode, which needs a
+    # 'tp' axis — an ep-only mesh refuses (the old "MoE family" rejection
+    # generalized by the round-4 tp-decode dispatch)
+    with pytest.raises(ValueError, match="no 'tp' axis"):
         DecodeEngine(gpt2.init_params(g, jax.random.PRNGKey(0)), g,
                      max_seq=32, mesh=mesh)
     bad = moe.MoEConfig(vocab_size=97, n_positions=64, n_embd=8, n_layer=2,

@@ -320,3 +320,61 @@ def test_serving_ep_decode_knob():
         create_app(ServingConfig(model_id="t", max_seq=64, ep_decode=True,
                                  prefix_cache=2),
                    model=(mcfg, mparams), tokenizer=ByteTokenizer())
+
+
+def test_serving_tp_decode_knob():
+    """TP_DECODE=1 serves dense /generate with Megatron-sharded
+    projections over the pod's devices, byte-equal to the unsharded
+    runner; misconfigurations refuse at startup."""
+    import jax
+    import pytest
+
+    from llm_sharding_demo_tpu.models import gpt2, moe
+    from llm_sharding_demo_tpu.serving.app import create_app
+    from llm_sharding_demo_tpu.serving.http import TestClient
+    from llm_sharding_demo_tpu.serving.tokenizer import ByteTokenizer
+    from llm_sharding_demo_tpu.utils.config import ServingConfig
+
+    # n_head = 8 so the pod's full 8-device CPU mesh divides it
+    dcfg = gpt2.GPT2Config(vocab_size=256, n_positions=64, n_embd=32,
+                           n_layer=2, n_head=8)
+    dparams = gpt2.init_params(dcfg, jax.random.PRNGKey(0))
+    body = {"prompt": "Hi, ", "max_new_tokens": 5, "mode": "greedy"}
+
+    tp = TestClient(create_app(
+        ServingConfig(model_id="t", max_seq=64, tp_decode=True),
+        model=(dcfg, dparams), tokenizer=ByteTokenizer()))
+    assert tp.get("/healthz").json()["tp_decode"] is True
+    plain = TestClient(create_app(
+        ServingConfig(model_id="t", max_seq=64),
+        model=(dcfg, dparams), tokenizer=ByteTokenizer()))
+    assert tp.post("/generate", json=body).json() == \
+        plain.post("/generate", json=body).json()
+
+    # TP composes with MAX_BATCH: the batcher wraps the tp engine
+    tpb = TestClient(create_app(
+        ServingConfig(model_id="t", max_seq=64, tp_decode=True, max_batch=4),
+        model=(dcfg, dparams), tokenizer=ByteTokenizer()))
+    assert tpb.post("/generate", json=body).json()["generated"] == \
+        plain.post("/generate", json=body).json()["generated"]
+
+    mcfg = moe.MoEConfig(vocab_size=256, n_positions=64, n_embd=16,
+                         n_layer=2, n_head=2, n_experts=8, expert_top_k=2)
+    with pytest.raises(ValueError, match="EP_DECODE instead"):
+        create_app(ServingConfig(model_id="t", max_seq=64, tp_decode=True),
+                   model=(mcfg, moe.init_params(mcfg, jax.random.PRNGKey(0))),
+                   tokenizer=ByteTokenizer())
+    bad = gpt2.GPT2Config(vocab_size=256, n_positions=64, n_embd=36,
+                          n_layer=2, n_head=6)  # 8 devices don't divide 6
+    with pytest.raises(ValueError, match="must divide"):
+        create_app(ServingConfig(model_id="t", max_seq=64, tp_decode=True),
+                   model=(bad, gpt2.init_params(bad, jax.random.PRNGKey(0))),
+                   tokenizer=ByteTokenizer())
+    with pytest.raises(ValueError, match="own other decode programs"):
+        create_app(ServingConfig(model_id="t", max_seq=64, tp_decode=True,
+                                 pp_decode=True),
+                   model=(dcfg, dparams), tokenizer=ByteTokenizer())
+    with pytest.raises(ValueError, match="fp32/bf16"):
+        create_app(ServingConfig(model_id="t", max_seq=64, tp_decode=True,
+                                 inference_dtype="int8"),
+                   model=(dcfg, dparams), tokenizer=ByteTokenizer())
