@@ -46,8 +46,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.gpt2 import GPT2Config, Params, apply_blocks, embed, final_logits
 from ..ops.attention import KVCache
-from ..runtime.engine import (GenerateResult, SamplingConfig,
-                              prepare_generate, select_token)
+from ..runtime.engine import (GenerateResult, SamplingConfig, _split_keys,
+                              _step_keys, prepare_generate, select_token)
 from . import partition as Pt
 
 
@@ -251,7 +251,7 @@ class PipelinedDecoder:
                                step_key)
             return (nxt, ck, cv, length + 1), nxt
 
-        keys = jax.random.split(key, steps - 1)
+        keys = _step_keys(key, steps - 1)
         (_, ck, cv, _), rest = jax.lax.scan(
             body, (first_token, ck, cv, length0), keys)
         tokens = jnp.concatenate([first_token[None, :], rest], axis=0)
@@ -271,7 +271,7 @@ class PipelinedDecoder:
         pad_j = jnp.asarray(pad) if pad.any() else None
 
         t0 = time.perf_counter()
-        prefill_key, decode_key = jax.random.split(key)
+        prefill_key, decode_key = _split_keys(key)
         last_logits, ck, cv = self._prefill(self.shared, self.blocks, ids_j,
                                             pad_j)
         first = select_token(last_logits, sampling, prefill_key)

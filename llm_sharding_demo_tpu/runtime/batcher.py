@@ -23,15 +23,19 @@ requests onto batched decodes:
   coexist are split into separately-feasible sub-batches instead of
   erroring (each request individually fitting ``max_seq`` is the
   caller's contract, enforced on entry);
-- only greedy requests batch together: sample-mode requests carry a
-  per-request PRNG seed whose reproducibility would be lost inside a
-  shared batch, so they run solo (documented contract, not a silent
-  behavior change). A policy change never starves anyone: the
-  out-of-policy request is held as the guaranteed head of the next
-  round, preserving FIFO.
+- requests batch when their ``SamplingConfig`` matches (greedy with
+  greedy; sample rounds share one temperature/top-k/top-p policy, each
+  row drawing from its OWN per-request PRNG key — the engine's per-row
+  key form, ``engine._split_keys``). A policy change never starves
+  anyone: the out-of-policy request is held as the guaranteed head of
+  the next round, preserving FIFO.
 
-Greedy batching is exact: batched rows equal solo runs token-for-token
-(pinned by tests via the engine's ragged-parity guarantees).
+Batching is exact in BOTH modes: greedy rows equal solo runs
+token-for-token (the engine's ragged-parity guarantees), and seeded
+sample rows are byte-equal to their solo runs — a row's stream depends
+only on its own key (per-row categorical draws), and the PRNG splits
+are prefix-stable, so neither batch composition, bucketed step
+over-decode, nor dummy padding rows can perturb it (pinned by tests).
 """
 
 from __future__ import annotations
@@ -130,6 +134,12 @@ class BatchingEngine:
             raise ValueError(
                 f"prompt_len={len(prompt)} + max_new_tokens="
                 f"{max_new_tokens} exceeds max_seq={self.engine.max_seq}")
+        if sampling.mode != "greedy" and key is None:
+            # also caller-thread: a keyless sample request cannot join the
+            # per-row-key batch contract (and the engine would reject it
+            # later anyway, from the worker thread)
+            raise ValueError(
+                "sample-mode requests must carry a per-request PRNG key")
         req = _Request(prompt=prompt, max_new_tokens=max_new_tokens,
                        sampling=sampling, key=key)
         self._queue.put(req)
@@ -149,15 +159,25 @@ class BatchingEngine:
 
     def _gather(self) -> List[_Request]:
         """Block for the first request, then collect batchable peers for
-        up to ``max_wait_ms``. Sample-mode requests always go solo (see
-        module docstring); greedy requests group freely. An out-of-policy
+        up to ``max_wait_ms``. Requests group when their SamplingConfig
+        matches exactly (sample rows each draw from their own key, so a
+        shared policy is the only batching requirement). An out-of-policy
         request ends the round and is HELD as the next round's first
         request — re-queueing it at the tail would let sustained traffic
-        of the other policy starve it forever."""
+        of another policy starve it forever."""
         first = self._pending or self._queue.get()
         self._pending = None
         batch = [first]
-        if first.sampling.mode != "greedy":
+        if (first.sampling.mode != "greedy" and self.prefix is not None
+                and getattr(self.prefix, "_spec", None) is not None):
+            # with speculation attached to the prefix engine, a solo
+            # sample round streams rejection-sampled tokens while a
+            # batched round would use the plain per-row path — the same
+            # seed would emit different tokens depending on concurrent
+            # traffic. Keep such requests solo so streams stay a pure
+            # function of (prompt, params, seed, config). (Serving
+            # cannot reach this: SPEC_DECODE x MAX_BATCH is refused at
+            # startup — this guards the library composition.)
             return batch
         deadline = _monotonic() + self.max_wait_s
         while len(batch) < self.max_batch:
@@ -255,8 +275,9 @@ class BatchingEngine:
 
     def _run_prefix(self, batch: List[_Request], ids: np.ndarray,
                     pad: np.ndarray, steps: int):
-        """Batched decode over per-row prefix-store prefills (greedy-only
-        rounds — _gather never groups sample requests)."""
+        """Batched decode over per-row prefix-store prefills (greedy
+        rounds only — the first-token merge below is argmax; sample
+        batches bypass the prefix store, see _run)."""
         t0 = _monotonic()
         states = []
         for req in batch:
@@ -298,10 +319,22 @@ class BatchingEngine:
             ids[i, s_max - len(r.prompt):] = r.prompt
             pad[i] = s_max - len(r.prompt)
 
-        if self.prefix is not None:
+        greedy = batch[0].sampling.mode == "greedy"
+        if self.prefix is not None and greedy:
             result = self._run_prefix(batch, ids, pad, steps)
         else:
-            key = batch[0].key  # greedy never consumes it; solo sample uses it
+            if greedy:
+                key = batch[0].key  # never consumed by greedy draws
+            else:
+                # per-row key stack: row i's stream derives only from its
+                # own request key (dummy rows replicate the last real
+                # key — their draws are dropped), so batched rows are
+                # byte-equal to solo runs (engine._split_keys contract).
+                # Sample rounds bypass the prefix store: its first-token
+                # merge is argmax-only.
+                keys = [r.key for r in batch]
+                keys += [keys[-1]] * (b - len(batch))
+                key = jnp.stack([jnp.asarray(k) for k in keys])
             result = self.engine.generate(ids, steps,
                                           sampling=batch[0].sampling, key=key,
                                           pad=pad)

@@ -104,12 +104,47 @@ def select_token(logits: jnp.ndarray, sampling: SamplingConfig,
     reference's temperature/top-k math (server.py:187-205) plus optional
     nucleus filtering — as one fused device computation (categorical over
     the k survivors, mapped back through the top-k indices).
+
+    ``key`` is either ONE key (a single joint draw over the batch — the
+    single-stream form) or a ``[B, 2]`` stack of per-row keys (one
+    independent draw per row, so a row's stream depends only on its own
+    key — the basis of batched seeded sampling, ``runtime.batcher``).
+    At B=1 the two forms draw identical bits (the categorical's gumbel
+    bits depend on the element count, not the leading shape), so a solo
+    run and a one-row per-row run are byte-equal — pinned in tests.
     """
     if sampling.mode == "greedy":
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     probs, top_idx = sampler_pmf(logits, sampling)
-    choice = jax.random.categorical(key, jnp.log(probs), axis=-1)   # [B]
+    if key.ndim == 2:                                  # [B, 2] per-row keys
+        choice = jax.vmap(
+            lambda k, p: jax.random.categorical(k, jnp.log(p)))(key, probs)
+    else:
+        choice = jax.random.categorical(key, jnp.log(probs), axis=-1)  # [B]
     return jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+
+def _split_keys(key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(prefill_key, decode_key) from either key form: a single key
+    splits once; a ``[B, 2]`` per-row stack splits per row (each row's
+    derivation identical to a solo run's — the byte-equality basis of
+    batched seeded sampling)."""
+    if key.ndim == 2:
+        pair = jax.vmap(jax.random.split)(key)         # [B, 2, 2]
+        return pair[:, 0], pair[:, 1]
+    return tuple(jax.random.split(key))
+
+
+def _step_keys(decode_key: jax.Array, n: int) -> jax.Array:
+    """Per-decode-step keys: ``[n, 2]`` for a single key, ``[n, B, 2]``
+    for a per-row stack (the scan consumes axis 0 either way). Splits are
+    prefix-stable (``split(k, n)[i]`` is independent of ``n``), so a
+    row's stream does not change when the batcher's steps bucket
+    over-decodes past its own max_new_tokens."""
+    if decode_key.ndim == 2:
+        return jax.vmap(
+            lambda k: jax.random.split(k, n))(decode_key).transpose(1, 0, 2)
+    return jax.random.split(decode_key, n)
 
 
 def left_pad(prompts, pad_id: int = 0) -> Tuple[np.ndarray, np.ndarray]:
@@ -188,6 +223,10 @@ def prepare_generate(prompt_ids, max_new_tokens: int, max_seq: int,
         raise ValueError("sample mode requires an explicit PRNG key")
     if key is None:
         key = jax.random.PRNGKey(0)  # unused by greedy; fixed for shape
+    elif getattr(key, "ndim", 1) == 2 and key.shape[0] != batch:
+        raise ValueError(
+            f"per-row key stack has {key.shape[0]} rows for a "
+            f"batch of {batch}")
     return ids, batch, prompt_len, key, pad
 
 
@@ -360,6 +399,13 @@ class DecodeEngine:
                     "prefill_chunk requires window-independent routing; "
                     "MoE models prefill monolithically")
         quantize = dtype == "int8" or dtype == jnp.int8
+        if quantize and mesh is not None and not hasattr(config, "n_experts"):
+            # refuse BEFORE any weight work (quantizing a real checkpoint
+            # takes seconds — same convention as the prefill_chunk guard)
+            raise NotImplementedError(
+                "int8 does not compose with tp decode: the int8 "
+                "streaming matmuls are unpartitioned Pallas kernels "
+                "GSPMD cannot split; tp decode runs fp32/bf16")
         if quantize:
             dtype = jnp.bfloat16  # activation/KV-cache dtype under int8
             from ..ops.quant import quantize_params
@@ -399,12 +445,7 @@ class DecodeEngine:
                 self.params = _place_ep_params(self.params, config, mesh,
                                                ep_axis)
             else:
-                if quantize:
-                    raise NotImplementedError(
-                        "int8 does not compose with tp decode: the int8 "
-                        "streaming matmuls are unpartitioned Pallas "
-                        "kernels GSPMD cannot split; tp decode runs "
-                        "fp32/bf16")
+                # (int8 x tp already refused above, before weight work)
                 self._mesh_mode = "tp"
                 self.params = _place_tp_params(self.params, config, mesh)
         # Model dispatch: any family module exposing the
@@ -575,8 +616,12 @@ class DecodeEngine:
         # cannot tile (it would fall back to one full-S VMEM block) on
         # the XLA path.
         from ..ops.flash_attention import flash_eligible, flash_profitable
+        # _mesh gate: the Mosaic flash kernel is unpartitioned — under a
+        # tp/ep mesh GSPMD cannot split it, so mesh decode keeps the XLA
+        # prefill (same rule as the decode kernel and int8 matmuls)
         flash = (self.config.attention_impl == "pallas" and pad is None
                  and ids.shape[1] > 1 and self.specs is None
+                 and self._mesh is None
                  and flash_eligible(ids.shape[1])
                  and flash_profitable(ids.shape[1]))
         logits, cache = self._forward_cached(params, ids, cache, pad,
@@ -742,7 +787,7 @@ class DecodeEngine:
         pad_j = jnp.asarray(pad) if pad.any() else None
 
         t0 = time.perf_counter()
-        prefill_key, decode_key = jax.random.split(key)
+        prefill_key, decode_key = _split_keys(key)
         run_params = self._run_params()
         if chunk:
             n_chunks = ids_j.shape[1] // chunk
@@ -779,7 +824,7 @@ class DecodeEngine:
         parts = [first[:, None]]
         token = first
         if steps > 1:
-            step_keys = jax.random.split(decode_key, steps - 1)
+            step_keys = _step_keys(decode_key, steps - 1)
             used = 0
             for n, window in self._segments(prompt_len, steps):
                 out, cache = self._decode_seg(

@@ -310,3 +310,31 @@ def test_decode_with_edge_adjacent_depth_matches_unsegmented():
     oracle._segments = lambda depth, steps, **kw: [(steps - 1, None)]
     want = oracle.generate(prompt, max_new_tokens=80)
     assert np.array_equal(got.tokens, want.tokens)
+
+
+def test_per_row_key_stack_matches_solo_runs(hf_engine):
+    """The per-row key contract behind batched seeded sampling: row i of
+    a stacked-key batch draws exactly the stream of a solo run with key
+    k_i (engine._split_keys/_step_keys derivation + the B=1 bit-equality
+    of joint and per-row categorical draws)."""
+    _, config, engine = hf_engine
+    rng = np.random.default_rng(21)
+    s = SamplingConfig(mode="sample", temperature=0.7, top_k=25)
+    k0, k1 = jax.random.PRNGKey(5), jax.random.PRNGKey(6)
+    p0 = rng.integers(0, config.vocab_size, size=(6,))
+    p1 = rng.integers(0, config.vocab_size, size=(6,))
+    solo0 = engine.generate(p0[None, :], 10, sampling=s, key=k0).tokens[0]
+    solo1 = engine.generate(p1[None, :], 10, sampling=s, key=k1).tokens[0]
+    # same rows batched with a [B, 2] key stack
+    batched = engine.generate(np.stack([p0, p1]), 10, sampling=s,
+                              key=jnp.stack([k0, k1])).tokens
+    np.testing.assert_array_equal(batched[0], solo0)
+    np.testing.assert_array_equal(batched[1], solo1)
+    # and the one-row stack is byte-equal to the plain solo form
+    stack1 = engine.generate(p0[None, :], 10, sampling=s,
+                             key=jnp.stack([k0])).tokens[0]
+    np.testing.assert_array_equal(stack1, solo0)
+    # mismatched stack size refuses
+    with pytest.raises(ValueError, match="per-row key"):
+        engine.generate(np.stack([p0, p1]), 4, sampling=s,
+                        key=jnp.stack([k0]))
