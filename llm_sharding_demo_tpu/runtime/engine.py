@@ -45,6 +45,22 @@ from ..ops.attention import KVCache
 REF_TEMPERATURE = 0.6
 REF_TOP_K = 40
 
+# EOS-armed decodes check for stop every this many steps (a multiple of
+# the segment planner's quantum, so capping mints no new programs).
+EOS_SEGMENT = 32
+
+
+def _cap_segment(seg, cap: int) -> list:
+    """Split one ``(n, window)`` segment into ``cap``-step chunks (same
+    window — the chunks reuse one compiled body)."""
+    n, w = seg
+    out = []
+    while n > cap:
+        out.append((cap, w))
+        n -= cap
+    out.append((n, w))
+    return out
+
 
 @dataclasses.dataclass(frozen=True)
 class SamplingConfig:
@@ -693,8 +709,26 @@ class DecodeEngine:
             return [merge(f, s) for f, s in zip(full, sub)]
         return merge(full, sub)
 
+    # windowed-decode bucket policy, shared with runtime.iterbatch
+    WINDOW_BUCKET = 128
+
+    def _decode_window(self, deepest: int) -> Optional[int]:
+        """The attention window for a segment whose deepest cache slot is
+        ``deepest``: the smallest power-of-two multiple of
+        ``WINDOW_BUCKET`` covering it, or ``None`` for the full-cache
+        program (window would reach ``max_seq``, or the flash-decode
+        kernel is active — its block loop already depth-bounds reads).
+        THE single definition of the bucket policy; ``_segments`` and the
+        iteration-level scheduler both derive windows from it."""
+        if self._decode_kernel is not None:
+            return None
+        w = self.WINDOW_BUCKET
+        while w < deepest:
+            w *= 2
+        return None if w >= self.max_seq else w
+
     def _segments(self, start_depth: int, steps: int,
-                  bucket: int = 128, quant: int = 32) -> list:
+                  bucket: Optional[int] = None, quant: int = 32) -> list:
         """Split ``steps - 1`` decode forwards into ``(n_forwards, window)``
         segments. The forward at cache depth ``d`` needs ``window >= d+1``;
         windows are power-of-two multiples of ``bucket``. Once the window
@@ -717,6 +751,7 @@ class DecodeEngine:
         runs as one full-cache program."""
         if self._decode_kernel is not None:
             return [(steps - 1, None)]
+        bucket = bucket or self.WINDOW_BUCKET
         total = steps - 1
         segs = []
         d = start_depth
@@ -766,13 +801,25 @@ class DecodeEngine:
     def generate(self, prompt_ids, max_new_tokens: int,
                  sampling: SamplingConfig = SamplingConfig(),
                  key: Optional[jax.Array] = None,
-                 pad: Optional[np.ndarray] = None) -> GenerateResult:
+                 pad: Optional[np.ndarray] = None,
+                 eos_id: Optional[int] = None) -> GenerateResult:
         """[B, S] (or [S]) prompt ids -> GenerateResult with [B, S+N] tokens.
 
         Validation (including the static cache-overflow guard) is shared
         with the pipeline runner via ``prepare_generate``. ``pad`` lets
         pre-padded callers (runtime.batcher) declare their left-pad
         prefixes explicitly.
+
+        ``eos_id`` arms on-device-work early exit: the decode runs in
+        segments capped at ``EOS_SEGMENT`` steps and stops at the first
+        boundary where EVERY row has emitted ``eos_id`` — the emitted
+        tokens are the byte-exact prefix of the uncapped stream (same
+        programs, same prefix-stable per-step keys), but dead tokens
+        past the last row's EOS stop costing device time. Costs one
+        host sync per segment while armed (the unarmed path keeps its
+        zero-sync dispatch pipeline), so serving arms it only for
+        ``stop_at_eos`` requests. May return fewer than
+        ``max_new_tokens`` tokens (``GenerateResult.new_tokens``).
         """
         ids, batch, prompt_len, key, pad = prepare_generate(
             prompt_ids, max_new_tokens, self.max_seq, sampling, key, pad=pad)
@@ -803,12 +850,14 @@ class DecodeEngine:
         t1 = time.perf_counter()
         return self._decode_and_pack(run_params, ids, pad, pad_j, first,
                                      cache, decode_key, max_new_tokens,
-                                     sampling, prompt_len, t1 - t0)
+                                     sampling, prompt_len, t1 - t0,
+                                     eos_id=eos_id)
 
     def _decode_and_pack(self, run_params, ids, pad, pad_j, first, cache,
                          decode_key, max_new_tokens: int,
                          sampling: SamplingConfig, prompt_len: int,
-                         prefill_seconds: float) -> GenerateResult:
+                         prefill_seconds: float,
+                         eos_id: Optional[int] = None) -> GenerateResult:
         """Run the compiled decode scan off a prepared (first token, cache)
         state and assemble the GenerateResult — shared by ``generate`` and
         the prefix-cache front end (runtime.prefix_cache), which prepares
@@ -818,15 +867,26 @@ class DecodeEngine:
         segment is one compiled scan whose attention reads only the
         current power-of-two depth bucket of the cache, so shallow steps
         stop paying for the full ``max_seq`` read. Exact, and the same
-        program count as before for short generations."""
+        program count as before for short generations.
+
+        ``eos_id`` (see ``generate``) caps segments at ``EOS_SEGMENT``
+        steps and fetches each segment's tokens; the loop exits at the
+        first boundary where every row has emitted the id. No new
+        programs: a capped segment reuses the (n, window) body the cap
+        produces, and caps are multiples of the planner's quantum."""
         t1 = time.perf_counter()
         steps = max_new_tokens
         parts = [first[:, None]]
         token = first
-        if steps > 1:
+        segs = self._segments(prompt_len, steps)
+        done = None
+        if eos_id is not None:
+            segs = [s for seg in segs for s in _cap_segment(seg, EOS_SEGMENT)]
+            done = np.asarray(first) == eos_id
+        if steps > 1 and not (done is not None and done.all()):
             step_keys = _step_keys(decode_key, steps - 1)
             used = 0
-            for n, window in self._segments(prompt_len, steps):
+            for n, window in segs:
                 out, cache = self._decode_seg(
                     run_params, token, cache, pad_j,
                     step_keys[used:used + n], sampling=sampling,
@@ -834,6 +894,10 @@ class DecodeEngine:
                 token = out[:, -1]
                 parts.append(out)
                 used += n
+                if done is not None:
+                    done |= (np.asarray(out) == eos_id).any(axis=1)
+                    if done.all():
+                        break
         del cache  # last segment's output aliases the donated prefill cache
         new = np.asarray(jax.block_until_ready(jnp.concatenate(parts, axis=1)))
         t2 = time.perf_counter()
@@ -842,6 +906,6 @@ class DecodeEngine:
         return GenerateResult(tokens=tokens, prompt_len=prompt_len,
                               prefill_seconds=prefill_seconds,
                               decode_seconds=t2 - t1,
-                              new_tokens=max_new_tokens,
-                              decode_steps=max_new_tokens - 1,
+                              new_tokens=new.shape[1],
+                              decode_steps=new.shape[1] - 1,
                               pad=pad if pad.any() else None)

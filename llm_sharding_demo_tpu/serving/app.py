@@ -197,6 +197,26 @@ def create_app(cfg: Optional[ServingConfig] = None,
             raise ValueError(
                 f"EP_DECODE: n_experts={config.n_experts} not divisible "
                 f"by the {ep_size}-device ep axis")
+    if cfg.batch_mode == "iter":
+        if cfg.max_batch <= 1:
+            raise ValueError("BATCH_MODE=iter requires MAX_BATCH > 1 "
+                             "(iteration-level scheduling is a batching "
+                             "policy)")
+        if not (cfg.shard_role == "coordinator" and cfg.dispatch == "local"):
+            raise ValueError("BATCH_MODE=iter applies to the coordinator's "
+                             "local decode path only")
+        if (cfg.prefix_cache > 0 or cfg.prefill_chunk > 0 or cfg.pp_decode
+                or cfg.ep_decode or cfg.tp_decode or cfg.spec_decode > 0):
+            raise ValueError(
+                "BATCH_MODE=iter drives the single-device engine's "
+                "segment loop; PREFIX_CACHE/PREFILL_CHUNK/PP/EP/"
+                "TP_DECODE/SPEC_DECODE use BATCH_MODE=admission")
+        from ..models import is_window_independent
+        if not is_window_independent(config):
+            raise ValueError(
+                "BATCH_MODE=iter requires window-independent routing "
+                f"(dense families); {type(config).__name__} batches via "
+                "BATCH_MODE=admission")
     if cfg.tp_decode:
         if not (cfg.shard_role == "coordinator" and cfg.dispatch == "local"):
             raise ValueError("TP_DECODE applies to the coordinator's local "
@@ -344,11 +364,22 @@ def create_app(cfg: Optional[ServingConfig] = None,
                 chunk=cfg.prefill_chunk or 64, spec=spec_runner)
             runner = prefix_runner
         if cfg.max_batch > 1:
-            from ..runtime.batcher import BatchingEngine
-            base = prefix_runner.plain if prefix_runner is not None else runner
-            runner = BatchingEngine(base, max_batch=cfg.max_batch,
-                                    max_wait_ms=cfg.batch_wait_ms,
-                                    prefix=prefix_runner)
+            if cfg.batch_mode == "iter":
+                # iteration-level scheduling: requests join the live
+                # batch at the next decode segment; early-EOS rows free
+                # their slot (runtime.iterbatch; exclusions validated
+                # above, so ``runner`` here is always a DecodeEngine)
+                from ..runtime.iterbatch import IterBatchingEngine
+                runner = IterBatchingEngine(runner,
+                                            max_batch=cfg.max_batch,
+                                            max_wait_ms=cfg.batch_wait_ms)
+            else:
+                from ..runtime.batcher import BatchingEngine
+                base = (prefix_runner.plain if prefix_runner is not None
+                        else runner)
+                runner = BatchingEngine(base, max_batch=cfg.max_batch,
+                                        max_wait_ms=cfg.batch_wait_ms,
+                                        prefix=prefix_runner)
     if not partitionable:
         compat_specs = compat_params = None
     else:
@@ -372,13 +403,18 @@ def create_app(cfg: Optional[ServingConfig] = None,
     @app.get("/healthz")
     def healthz():
         live = {}
-        # prefix cache: live hit/miss/entries — directly, or through the
-        # batcher when PREFIX_CACHE composes with MAX_BATCH>1
-        prefix_src = getattr(runner, "prefix", None)
-        if prefix_src is None and hasattr(runner, "stats"):
-            prefix_src = runner
-        if prefix_src is not None and hasattr(prefix_src, "stats"):
-            live["prefix_cache_stats"] = prefix_src.stats()
+        from ..runtime.iterbatch import IterBatchingEngine as _IB
+        if isinstance(runner, _IB):
+            # iteration-level scheduler: joins/segments/eos-retires
+            live["iter_batch_stats"] = runner.stats()
+        else:
+            # prefix cache: live hit/miss/entries — directly, or through
+            # the batcher when PREFIX_CACHE composes with MAX_BATCH>1
+            prefix_src = getattr(runner, "prefix", None)
+            if prefix_src is None and hasattr(runner, "stats"):
+                prefix_src = runner
+            if prefix_src is not None and hasattr(prefix_src, "stats"):
+                live["prefix_cache_stats"] = prefix_src.stats()
         if spec_runner is not None:  # speculation: live acceptance stats
             live["spec_decode_stats"] = spec_runner.stats()
         return {
@@ -389,6 +425,7 @@ def create_app(cfg: Optional[ServingConfig] = None,
             "n_stages": decode_stages,
             "dispatch": cfg.dispatch,
             "max_batch": cfg.max_batch,
+            "batch_mode": cfg.batch_mode,
             "inference_dtype": cfg.inference_dtype,
             "spec_decode": cfg.spec_decode,
             "prefill_chunk": cfg.prefill_chunk,
@@ -425,7 +462,8 @@ def create_app(cfg: Optional[ServingConfig] = None,
                                    config, hidden)
         return {"logits": np.asarray(logits).tolist()}
 
-    def _generate_local(req: GenerateReq, prompt_ids: List[int]) -> List[int]:
+    def _generate_local(req: GenerateReq, prompt_ids: List[int],
+                        eos_id: Optional[int] = None) -> List[int]:
         sampling = (SamplingConfig(mode="greedy") if req.mode == "greedy"
                     else SamplingConfig(mode="sample",
                                         temperature=req.temperature,
@@ -445,10 +483,21 @@ def create_app(cfg: Optional[ServingConfig] = None,
                 and spec_runner.eligible(len(prompt_ids),
                                          req.max_new_tokens)):
             eng = spec_runner
+        kw = {}
+        from ..runtime.engine import DecodeEngine as _DE
+        from ..runtime.iterbatch import IterBatchingEngine as _IB
+        if eos_id is not None and isinstance(eng, (_DE, _IB)):
+            # segment-boundary early exit: stop_at_eos requests stop
+            # paying device time for dead tokens past the stop (tokens
+            # emitted are the exact prefix of the uncapped stream; the
+            # iter scheduler additionally frees the row's slot). Other
+            # runners (spec/prefix/admission-batcher/pipeline) keep the
+            # host-side truncation below — same wire result.
+            kw["eos_id"] = eos_id
         result = eng.generate(np.asarray(prompt_ids),
                               max_new_tokens=req.max_new_tokens,
                               sampling=sampling,
-                              key=jax.random.PRNGKey(seed))
+                              key=jax.random.PRNGKey(seed), **kw)
         # row_tokens strips any left pad the engine introduced (chunked
         # prefill alignment); plain runs return the row unchanged
         return [int(t) for t in result.row_tokens(0)]
@@ -570,7 +619,7 @@ def create_app(cfg: Optional[ServingConfig] = None,
                                  "shard": e.shard, "upstream": e.url,
                                  "detail": e.detail}
             else:
-                ids = _generate_local(req, prompt_ids)
+                ids = _generate_local(req, prompt_ids, eos_id=eos_id)
         finish_reason = "length"
         if eos_id is not None:
             # truncate at the first EOS among the NEW tokens (the decode

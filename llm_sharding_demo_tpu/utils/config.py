@@ -77,6 +77,14 @@ class ServingConfig:
     # dispatch/combine collectives. Off by default (unstaged single-group
     # decode, the round-2 behavior).
     ep_decode: bool = False
+    # Batch scheduling policy when MAX_BATCH > 1. "admission" groups
+    # waiting requests into rounds that run to completion
+    # (runtime.batcher). "iter" schedules at iteration level
+    # (runtime.iterbatch): requests join a LIVE batch at the next decode
+    # segment instead of waiting the round out, and early-EOS rows free
+    # their slot. "iter" serves window-independent (dense) families and
+    # excludes PREFIX_CACHE/PREFILL_CHUNK/PP/EP/TP_DECODE.
+    batch_mode: str = "admission"
     # Tensor-parallel inference (dense families): Megatron column/row-
     # sharded projections + a head-sharded KV cache over a ``tp`` mesh
     # axis spanning this pod's devices — single-stream latency scaling,
@@ -104,6 +112,9 @@ class ServingConfig:
         if self.batch_wait_ms < 0:
             raise ValueError(
                 f"BATCH_WAIT_MS={self.batch_wait_ms} must be >= 0")
+        if self.batch_mode not in ("admission", "iter"):
+            raise ValueError(
+                f"BATCH_MODE={self.batch_mode!r} not admission|iter")
         if self.inference_dtype not in ("float32", "bfloat16", "int8"):
             raise ValueError(
                 f"INFERENCE_DTYPE={self.inference_dtype!r} not "
@@ -199,4 +210,5 @@ def from_env() -> ServingConfig:
         pp_decode=_env_bool("PP_DECODE"),
         ep_decode=_env_bool("EP_DECODE"),
         tp_decode=_env_bool("TP_DECODE"),
+        batch_mode=os.environ.get("BATCH_MODE", "admission"),
     )

@@ -338,3 +338,53 @@ def test_per_row_key_stack_matches_solo_runs(hf_engine):
     with pytest.raises(ValueError, match="per-row key"):
         engine.generate(np.stack([p0, p1]), 4, sampling=s,
                         key=jnp.stack([k0]))
+
+
+def test_eos_early_exit_emits_exact_prefix(hf_engine):
+    """eos_id-armed decode stops at a segment boundary once every row
+    emitted the id; tokens are the byte-exact prefix of the uncapped
+    stream and device work is actually saved (fewer decode steps)."""
+    _, config, engine = hf_engine
+    rng = np.random.default_rng(31)
+    p = rng.integers(0, config.vocab_size, size=(1, 7))
+    plain = engine.generate(p, 50)
+    # pick the token emitted at new-position 4 as "EOS"
+    eos = int(plain.tokens[0, 7 + 4])
+    early = engine.generate(p, 50, eos_id=eos)
+    assert early.new_tokens < 50                       # stopped early
+    assert early.decode_steps == early.new_tokens - 1
+    np.testing.assert_array_equal(
+        early.tokens, plain.tokens[:, :7 + early.new_tokens])
+    assert eos in early.tokens[0, 7:]
+    # stop lands within one EOS_SEGMENT of the id's position
+    from llm_sharding_demo_tpu.runtime.engine import EOS_SEGMENT
+    assert early.new_tokens <= 5 + EOS_SEGMENT
+
+
+def test_eos_early_exit_batched_waits_for_all_rows(hf_engine):
+    _, config, engine = hf_engine
+    rng = np.random.default_rng(32)
+    prompts = rng.integers(0, config.vocab_size, size=(2, 6))
+    plain = engine.generate(prompts, 40)
+    # an id only row 0 emits (if row 1 also emits it, pick another)
+    new0 = plain.tokens[0, 6:]
+    new1 = set(int(t) for t in plain.tokens[1, 6:])
+    eos = next(int(t) for t in new0 if int(t) not in new1)
+    early = engine.generate(prompts, 40, eos_id=eos)
+    # row 1 never stops -> full length, tokens unchanged for both rows
+    assert early.new_tokens == 40
+    np.testing.assert_array_equal(early.tokens, plain.tokens)
+
+
+def test_eos_early_exit_sampled_stream_prefix(hf_engine):
+    _, config, engine = hf_engine
+    rng = np.random.default_rng(33)
+    p = rng.integers(0, config.vocab_size, size=(1, 5))
+    s = SamplingConfig(mode="sample", temperature=0.8, top_k=30)
+    k = jax.random.PRNGKey(9)
+    plain = engine.generate(p, 40, sampling=s, key=k)
+    eos = int(plain.tokens[0, 5 + 3])
+    early = engine.generate(p, 40, sampling=s, key=k, eos_id=eos)
+    assert early.new_tokens < 40
+    np.testing.assert_array_equal(
+        early.tokens, plain.tokens[:, :5 + early.new_tokens])

@@ -378,3 +378,28 @@ def test_serving_tp_decode_knob():
         create_app(ServingConfig(model_id="t", max_seq=64, tp_decode=True,
                                  inference_dtype="int8"),
                    model=(dcfg, dparams), tokenizer=ByteTokenizer())
+
+
+def test_stop_at_eos_early_exit_wire_equal(model):
+    """A DecodeEngine-backed config (PREFILL_CHUNK) arms the engine's
+    segment-boundary early exit for stop_at_eos; the wire response must
+    equal the default config's host-truncated response."""
+    config, params = model
+    from llm_sharding_demo_tpu.serving.app import create_app
+    from llm_sharding_demo_tpu.serving.http import TestClient
+    from llm_sharding_demo_tpu.serving.tokenizer import ByteTokenizer
+    from llm_sharding_demo_tpu.utils.config import ServingConfig
+
+    plain = TestClient(create_app(
+        ServingConfig(model_id="t", max_seq=60),
+        model=model, tokenizer=ByteTokenizer()))
+    chunked = TestClient(create_app(
+        ServingConfig(model_id="t", max_seq=60, prefill_chunk=8),
+        model=model, tokenizer=ByteTokenizer()))
+    base = {"prompt": "abcd", "max_new_tokens": 50, "mode": "greedy"}
+    toks = plain.post("/generate", json=base).json()["generated"]
+    eos = ord(toks[4 + 2]) if len(toks) > 6 else 65
+    body = {**base, "stop_at_eos": True, "eos_token_id": eos}
+    a = plain.post("/generate", json=body).json()
+    b = chunked.post("/generate", json=body).json()
+    assert a == b
