@@ -390,6 +390,26 @@ def forward_with_cache(params: Params, input_ids: jnp.ndarray,
     Cache indices stay uniform across rows (the point of left-padding: one
     ``dynamic_update_slice`` serves the whole batch).
     """
+    if (decode_kernel and decode_kernel.startswith("mega")
+            and input_ids.shape[1] == 1):
+        from ..ops.decode_layer import MAX_BATCH, decode_layers
+        if input_ids.shape[0] <= MAX_BATCH:
+            # whole-stack megakernel: all L layers in one launch
+            # (ops.decode_layer — the dispatch-overhead fix). Falls
+            # through to the per-layer path above MAX_BATCH (VMEM).
+            offset = (cache.length if pad is None
+                      else cache.length - pad[:, None])
+            h = embed(params, input_ids, offset)
+            h, KV = decode_layers(
+                params["blocks"], h, cache.k, cache.length,
+                k_valid_from=pad, n_head=config.n_head,
+                eps=config.layer_norm_epsilon,
+                interpret=decode_kernel == "mega-interpret")
+            cache = KVCache(k=KV, v=cache.v, length=cache.length + 1)
+            return final_logits(params, h,
+                                config.layer_norm_epsilon), cache
+        decode_kernel = ("interpret" if decode_kernel == "mega-interpret"
+                         else "device")
     if pad is None:
         h = embed(params, input_ids, cache.length)
         h, cache = apply_blocks(params["blocks"], h, config, cache,

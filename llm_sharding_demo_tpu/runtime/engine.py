@@ -530,6 +530,18 @@ class DecodeEngine:
                 self._decode_kernel = ("interpret"
                                        if decode_kernel == "interpret"
                                        else "device")
+                # whole-stack megakernel upgrade (ops.decode_layer): one
+                # launch per decode step instead of one per op — plain
+                # (unstaged) GPT-2 engines with lane-aligned dims. The
+                # model falls back to the per-layer kernel at trace time
+                # for batches past its VMEM budget.
+                from ..models import gpt2 as _g
+                from ..ops import decode_layer as _DL
+                if (self.specs is None and self._model is _g
+                        and _DL.eligible(config, rounded)):
+                    self._decode_kernel = ("mega-interpret"
+                                           if decode_kernel == "interpret"
+                                           else "mega")
             elif decode_kernel == "interpret":
                 # An EXPLICIT kernel request must never silently run
                 # something else (mirrors the ep-mesh refusal above): a
