@@ -356,6 +356,27 @@ def forward_with_cache(params: Params, input_ids: jnp.ndarray,
     h = _embed(params, input_ids)
     offset = cache.length
     cos, sin = _angles(config, input_ids.shape[1], offset, pad)
+    if (decode_kernel and decode_kernel.startswith("mega")
+            and input_ids.shape[1] == 1):
+        from ..ops.decode_layer import MAX_BATCH, decode_layers_llama
+        b = input_ids.shape[0]
+        if b <= MAX_BATCH:
+            # whole-stack megakernel (ops.decode_layer): all L layers in
+            # one launch; RoPE angles for the single current position
+            # pass in as [B, hd]
+            cos1 = jnp.broadcast_to(cos.reshape(-1, config.head_dim),
+                                    (b, config.head_dim))
+            sin1 = jnp.broadcast_to(sin.reshape(-1, config.head_dim),
+                                    (b, config.head_dim))
+            h, KV = decode_layers_llama(
+                params["blocks"], h, cache.k, cache.length, cos1, sin1,
+                k_valid_from=pad, n_head=config.n_head,
+                eps=config.rms_norm_eps,
+                interpret=decode_kernel == "mega-interpret")
+            cache = KVCache(KV, cache.v, cache.length + 1)
+            return _final(params, h, config), cache
+        decode_kernel = ("interpret" if decode_kernel == "mega-interpret"
+                         else "device")
     # structural guard (mirrors gpt2): the flash branch has no pad mask,
     # so ragged batches always take the masked cached-attention path
     flash_prefill = flash_prefill and pad is None

@@ -59,17 +59,32 @@ _LANE = 128
 # VMEM budget keeps the whole-stack fusion to decode-sized batches; the
 # model falls back to the per-layer kernel above this (trace-time shape).
 MAX_BATCH = 16
+# Conservative VMEM ceiling for the eligibility estimate: the call sets
+# vmem_limit_bytes=110MB; leave slack for accumulators/activations so
+# "auto" never selects a megakernel Mosaic cannot allocate.
+_VMEM_BUDGET = 90 * 1024 * 1024
+
+
+def _vmem_fits(weight_bytes_per_layer: int, hkv: int, hd: int) -> bool:
+    """The two big VMEM tenants: double-buffered layer weights (BlockSpec
+    pipelining) and the double-buffered KV stream at the worst-case
+    batch. Computed for bf16 weights (int8 is smaller)."""
+    kv_stream = 2 * MAX_BATCH * hkv * BLOCK_S * 2 * hd * 2
+    return 2 * weight_bytes_per_layer + kv_stream <= _VMEM_BUDGET
 
 
 def eligible(config, max_seq: int) -> bool:
     """Whether the megakernel applies to this GPT-2 geometry: fused rows
     lane-aligned, cache in whole blocks, every matmul dim lane-aligned
     (real-model sizes are; toy test sizes fall back to the per-layer
-    kernel). Batch is a trace-time check (``MAX_BATCH``)."""
+    kernel), and the per-layer weights + KV stream fit the VMEM budget
+    so "auto" never picks an uncompilable kernel. Batch is a trace-time
+    check (``MAX_BATCH``)."""
     d = config.n_embd
     return ((2 * config.head_dim) % _LANE == 0
             and max_seq % BLOCK_S == 0 and max_seq >= BLOCK_S
-            and d % _LANE == 0)
+            and d % _LANE == 0
+            and _vmem_fits(12 * d * d * 2, config.n_head, config.head_dim))
 
 
 def _ln(h, scale, bias, eps):
@@ -339,13 +354,16 @@ def _weight_parts(blocks) -> Tuple[list, bool]:
     return parts, quantized
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("quantized", "n_head", "eps",
-                                    "interpret"))
-def _call(parts, h0, vf_bh, KV, meta, *, quantized, n_head, eps,
-          interpret):
-    L, B, Hkv, Smax, hd2 = KV.shape
+def _build_call(kernel, parts, vmem_operands, KV, meta, *, n_head,
+                interpret):
+    """Shared pallas_call plumbing for both family kernels: grid over
+    layers with BlockSpec-pipelined stacked weights, whole-array VMEM
+    operands (``vmem_operands[0]`` is the hidden state, whose shape and
+    dtype define the output), the HBM-aliased fused cache, and the
+    attention scratch set."""
+    L, B, Hkv, _, hd2 = KV.shape
     hd = hd2 // 2
+    h0 = vmem_operands[0]
 
     def layer_block(x):
         # one layer's block of a stacked [L, ...] tensor, pipelined
@@ -357,9 +375,9 @@ def _call(parts, h0, vf_bh, KV, meta, *, quantized, n_head, eps,
         num_scalar_prefetch=1,
         grid=(L,),
         in_specs=([layer_block(x) for x in parts]
-                  + [pl.BlockSpec(memory_space=pltpu.VMEM),   # h0
-                     pl.BlockSpec(memory_space=pltpu.VMEM),   # vf
-                     pl.BlockSpec(memory_space=pltpu.HBM)]),  # KV (aliased)
+                  + [pl.BlockSpec(memory_space=pltpu.VMEM)
+                     for _ in vmem_operands]
+                  + [pl.BlockSpec(memory_space=pltpu.HBM)]),  # KV (aliased)
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),            # h out
             pl.BlockSpec(memory_space=pltpu.HBM),
@@ -375,11 +393,8 @@ def _call(parts, h0, vf_bh, KV, meta, *, quantized, n_head, eps,
             pltpu.SemaphoreType.DMA(()),
         ],
     )
-    kernel = functools.partial(
-        _kernel, n_layer=L, batch=B, n_head=n_head, hkv=Hkv, hd=hd,
-        eps=eps, quantized=quantized)
-    n_in = 1 + len(parts) + 3   # meta + weights + (h0, vf, KV)
-    hout, KV = pl.pallas_call(
+    n_in = 1 + len(parts) + len(vmem_operands) + 1
+    return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
@@ -391,8 +406,193 @@ def _call(parts, h0, vf_bh, KV, meta, *, quantized, n_head, eps,
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=110 * 1024 * 1024),
         interpret=interpret,
-    )(meta, *parts, h0, vf_bh, KV)
-    return hout, KV
+    )(meta, *parts, *vmem_operands, KV)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("quantized", "n_head", "eps",
+                                    "interpret"))
+def _call(parts, h0, vf_bh, KV, meta, *, quantized, n_head, eps,
+          interpret):
+    L, B, Hkv, _, hd2 = KV.shape
+    kernel = functools.partial(
+        _kernel, n_layer=L, batch=B, n_head=n_head, hkv=Hkv, hd=hd2 // 2,
+        eps=eps, quantized=quantized)
+    return _build_call(kernel, parts, [h0, vf_bh], KV, meta,
+                       n_head=n_head, interpret=interpret)
+
+
+def llama_eligible(config, max_seq: int) -> bool:
+    """Megakernel eligibility for the llama family: everything GPT-2
+    needs, plus lane-aligned kv-projection and SwiGLU hidden dims."""
+    d = config.n_embd
+    kv = config.n_kv_head * config.head_dim
+    per_layer = (2 * d * d + 2 * d * kv
+                 + 3 * d * config.intermediate_size) * 2
+    return ((2 * config.head_dim) % _LANE == 0
+            and max_seq % BLOCK_S == 0 and max_seq >= BLOCK_S
+            and d % _LANE == 0 and kv % _LANE == 0
+            and config.intermediate_size % _LANE == 0
+            and _vmem_fits(per_layer, config.n_kv_head, config.head_dim))
+
+
+def _rms(h, scale, eps):
+    """f32-stat RMSNorm (mirrors ops.layers.rms_norm incl. the cast
+    BEFORE the scale multiply — HF LlamaRMSNorm order)."""
+    x32 = h.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True)
+                            + eps)
+    return y.astype(h.dtype) * scale.astype(h.dtype)
+
+
+def _rope_rows(x, cos_ref, sin_ref, batch: int, n_heads: int, hd: int):
+    """Rotate [B*n_heads, hd] f32 rows by per-BATCH-row angles
+    ([B, hd] f32 refs). rotate_half is an iota-built permutation on the
+    MXU (a 32-lane shuffle Mosaic would reject as a vector op)."""
+    half = hd // 2
+    row = jax.lax.broadcasted_iota(jnp.int32, (hd, hd), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (hd, hd), 1)
+    # rotate_half(x)[j] = -x[j+half] (j < half) | x[j-half] (j >= half)
+    r = (jnp.where(col < half, -1.0, 0.0) * (row == col + half)
+         + jnp.where(col >= half, 1.0, 0.0) * (row + half == col)
+         ).astype(jnp.float32)
+    rot = jax.lax.dot_general(x, r, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+
+    def widen(a):            # [B, hd] -> [B*n_heads, hd]
+        return jnp.broadcast_to(a[:, None, :],
+                                (batch, n_heads, hd)
+                                ).reshape(batch * n_heads, hd)
+
+    return x * widen(cos_ref[...]) + rot * widen(sin_ref[...])
+
+
+def _llama_kernel(meta_ref,
+                  ln_a, wq, sq, wk, sk, wv, sv, wo, so,
+                  ln_m, wg, sg, wu, su, wd, sd,
+                  h0_ref, vf_ref, cos_ref, sin_ref, kv_hbm,
+                  hout_ref, kv_out,
+                  h_ref, acc_ref, m_ref, l_ref, kvbuf, winbuf, copy_sems,
+                  write_sem,
+                  *, n_layer, batch, n_head, hkv, hd, eps, quantized):
+    """llama-family sibling of ``_kernel``: RMSNorm, separate q/k/v
+    projections, RoPE (in-kernel MXU rotate-half), GQA attention, and
+    SwiGLU — same layer-grid / persistent-h / inlined-attention design."""
+    l = pl.program_id(0)
+    off = meta_ref[0]
+
+    @pl.when(l == 0)
+    def _():
+        h_ref[...] = h0_ref[...]
+
+    h = h_ref[...]
+    d = h.shape[-1]
+    g = n_head // hkv
+
+    a = _rms(h, ln_a[0, 0], eps)
+    q = _matmul(a, wq, sq, None, quantized).astype(jnp.float32)
+    k = _matmul(a, wk, sk, None, quantized).astype(jnp.float32)
+    v = _matmul(a, wv, sv, None, quantized).astype(jnp.float32)
+    q_r = _split_rows(q, n_head, hd)                   # [B*H, hd]
+    k_r = _split_rows(k, hkv, hd)                      # [B*Hkv, hd]
+    q_r = _rope_rows(q_r, cos_ref, sin_ref, batch, n_head, hd)
+    k_r = _rope_rows(k_r, cos_ref, sin_ref, batch, hkv, hd)
+    q3 = q_r.reshape(batch * hkv, g, hd)
+    k3 = k_r.reshape(batch * hkv, 1, hd)
+    v3 = _split_rows(v, hkv, hd).reshape(batch * hkv, 1, hd)
+
+    attn = _attention(l, off, q3, k3, v3, vf_ref, kv_hbm, kv_out,
+                      acc_ref, m_ref, l_ref, kvbuf, winbuf, copy_sems,
+                      write_sem, batch=batch, hkv=hkv, g=g, hd=hd)
+    attn = _merge_rows(attn.reshape(batch * n_head, hd), batch, n_head,
+                       hd).astype(h.dtype)
+
+    h = h + _matmul(attn, wo, so, None, quantized)
+    mm = _rms(h, ln_m[0, 0], eps)
+    gate = _matmul(mm, wg, sg, None, quantized)
+    up = _matmul(mm, wu, su, None, quantized)
+    t = (gate * jax.lax.logistic(gate.astype(jnp.float32)
+                                 ).astype(gate.dtype)) * up   # SwiGLU
+    h = h + _matmul(t, wd, sd, None, quantized)
+    h_ref[...] = h
+
+    @pl.when(l == n_layer - 1)
+    def _():
+        hout_ref[...] = h
+
+
+def _llama_weight_parts(blocks) -> Tuple[list, bool]:
+    from .quant import is_quantized
+
+    def pair(leaf):
+        if is_quantized(leaf):
+            return leaf.q, leaf.scale
+        return leaf, None
+
+    a = blocks["attn"]
+    mlp = blocks["mlp"]
+    wq, sq = pair(a["wq"]["kernel"])
+    wk, sk = pair(a["wk"]["kernel"])
+    wv, sv = pair(a["wv"]["kernel"])
+    wo, so = pair(a["wo"]["kernel"])
+    wg, sg = pair(mlp["gate"]["kernel"])
+    wu, su = pair(mlp["up"]["kernel"])
+    wd, sd = pair(mlp["down"]["kernel"])
+    quantized = sq is not None
+    if any((s is not None) != quantized
+           for s in (sk, sv, so, sg, su, sd)):
+        raise ValueError("mixed quantized/float block kernels")
+    if not quantized:
+        def mk(w):
+            return jnp.ones((w.shape[0], 1), jnp.float32)
+        sq, sk, sv, so = mk(wq), mk(wk), mk(wv), mk(wo)
+        sg, su, sd = mk(wg), mk(wu), mk(wd)
+    parts = [
+        blocks["ln_attn"]["scale"],
+        wq, sq, wk, sk, wv, sv, wo, so,
+        blocks["ln_mlp"]["scale"],
+        wg, sg, wu, su, wd, sd,
+    ]
+    parts = [x[:, None, :] if x.ndim == 2 else x for x in parts]
+    return parts, quantized
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("quantized", "n_head", "eps",
+                                    "interpret"))
+def _llama_call(parts, h0, vf_bh, cos, sin, KV, meta, *, quantized,
+                n_head, eps, interpret):
+    L, B, Hkv, _, hd2 = KV.shape
+    kernel = functools.partial(
+        _llama_kernel, n_layer=L, batch=B, n_head=n_head, hkv=Hkv,
+        hd=hd2 // 2, eps=eps, quantized=quantized)
+    return _build_call(kernel, parts, [h0, vf_bh, cos, sin], KV, meta,
+                       n_head=n_head, interpret=interpret)
+
+
+def decode_layers_llama(blocks, h, KV, offset, cos, sin,
+                        k_valid_from: Optional[jnp.ndarray] = None,
+                        *, n_head: int, eps: float,
+                        interpret: bool = False,
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """llama-family front end of the megakernel. ``cos``/``sin`` are the
+    CURRENT position's per-batch-row rotary angles ``[B, hd]`` f32
+    (computed by the caller — ops.rope convention)."""
+    b, s, d = h.shape
+    if s != 1:
+        raise ValueError(f"megakernel is single-token only, got S={s}")
+    L, _, hkv, _, _ = KV.shape
+    parts, quantized = _llama_weight_parts(blocks)
+    if k_valid_from is None:
+        k_valid_from = jnp.zeros((b,), jnp.int32)
+    vf_bh = jnp.repeat(k_valid_from.astype(jnp.int32), hkv)[:, None, None]
+    meta = jnp.asarray([offset], jnp.int32).reshape(1)
+    hout, KV = _llama_call(parts, h.reshape(b, d), vf_bh,
+                           cos.astype(jnp.float32),
+                           sin.astype(jnp.float32), KV, meta,
+                           quantized=quantized, n_head=n_head, eps=eps,
+                           interpret=interpret)
+    return hout.reshape(b, 1, d), KV
 
 
 def decode_layers(blocks, h, KV, offset,

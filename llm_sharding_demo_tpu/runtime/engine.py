@@ -500,9 +500,13 @@ class DecodeEngine:
         # allocation; an EXPLICIT "interpret" request refuses instead
         # (see the raise below).
         from ..ops import decode_attention as _DA
-        if decode_kernel not in ("auto", "xla", "interpret"):
+        _KERNEL_MODES = ("auto", "xla", "interpret", "layer",
+                         "layer-interpret", "mega", "mega-interpret")
+        if decode_kernel not in _KERNEL_MODES:
             raise ValueError(
-                f"decode_kernel={decode_kernel!r} not auto|xla|interpret")
+                f"decode_kernel={decode_kernel!r} not one of {_KERNEL_MODES}"
+                " ('auto'/'interpret' pick the best kernel; 'layer*' and "
+                "'mega*' force the per-layer / whole-stack kernel)")
         self._cache_seq = max_seq
         self._decode_kernel: Optional[str] = None
         # "auto" engages only for non-fp32 dtypes (fp32 is BASELINE.json's
@@ -512,45 +516,61 @@ class DecodeEngine:
         # partitioning — "auto" quietly resolves to XLA there, while the
         # EXPLICIT kernel request refuses rather than silently running
         # something else).
-        if mesh is not None and decode_kernel == "interpret":
+        explicit_interp = decode_kernel in ("interpret", "layer-interpret",
+                                            "mega-interpret")
+        explicit_kernel = decode_kernel not in ("auto", "xla")
+        if mesh is not None and explicit_kernel:
             raise ValueError(
-                "decode_kernel='interpret' does not compose with a mesh "
-                "(the Pallas decode kernel is unpartitioned); use "
+                f"decode_kernel={decode_kernel!r} does not compose with a "
+                "mesh (the Pallas decode kernels are unpartitioned); use "
                 "'auto' or 'xla'")
         want = mesh is None and (
-            decode_kernel == "interpret"
+            explicit_kernel
             or (decode_kernel == "auto"
                 and jax.default_backend() == "tpu"
                 and dtype != jnp.float32))
         if want:
             rounded = min(-(-max_seq // _DA.BLOCK_S) * _DA.BLOCK_S,
                           config.n_positions)
-            if _DA.eligible(rounded, config.head_dim, 1):
+            base_ok = _DA.eligible(rounded, config.head_dim, 1)
+            # whole-stack megakernel (ops.decode_layer): one launch per
+            # decode step instead of one per op — plain (unstaged)
+            # GPT-2/llama engines with lane-aligned dims inside the VMEM
+            # budget. The model falls back to the per-layer kernel at
+            # trace time for batches past MAX_BATCH.
+            from ..models import gpt2 as _g
+            from ..models import llama as _ll
+            from ..ops import decode_layer as _DL
+            mega_ok = base_ok and self.specs is None and (
+                (self._model is _g and _DL.eligible(config, rounded))
+                or (self._model is _ll
+                    and _DL.llama_eligible(config, rounded)))
+            if decode_kernel in ("mega", "mega-interpret") and not mega_ok:
+                raise ValueError(
+                    f"decode_kernel={decode_kernel!r} requested but the "
+                    "megakernel is ineligible here (needs an unstaged "
+                    "GPT-2/llama engine, lane-aligned dims within the "
+                    "VMEM budget, and a whole-block cache)")
+            if base_ok:
                 self._cache_seq = rounded
-                self._decode_kernel = ("interpret"
-                                       if decode_kernel == "interpret"
-                                       else "device")
-                # whole-stack megakernel upgrade (ops.decode_layer): one
-                # launch per decode step instead of one per op — plain
-                # (unstaged) GPT-2 engines with lane-aligned dims. The
-                # model falls back to the per-layer kernel at trace time
-                # for batches past its VMEM budget.
-                from ..models import gpt2 as _g
-                from ..ops import decode_layer as _DL
-                if (self.specs is None and self._model is _g
-                        and _DL.eligible(config, rounded)):
+                if decode_kernel in ("layer", "layer-interpret"):
+                    self._decode_kernel = ("interpret" if explicit_interp
+                                           else "device")
+                elif mega_ok:
                     self._decode_kernel = ("mega-interpret"
-                                           if decode_kernel == "interpret"
-                                           else "mega")
-            elif decode_kernel == "interpret":
+                                           if explicit_interp else "mega")
+                else:
+                    self._decode_kernel = ("interpret" if explicit_interp
+                                           else "device")
+            elif explicit_kernel:
                 # An EXPLICIT kernel request must never silently run
-                # something else (mirrors the ep-mesh refusal above): a
+                # something else (mirrors the mesh refusal above): a
                 # config slip would otherwise stop exercising the kernel
                 # in tests that forget to assert _decode_kernel. Only
                 # "auto" may quietly resolve to XLA.
                 raise ValueError(
-                    "decode_kernel='interpret' requested but the geometry "
-                    f"is ineligible (head_dim={config.head_dim}, "
+                    f"decode_kernel={decode_kernel!r} requested but the "
+                    f"geometry is ineligible (head_dim={config.head_dim}, "
                     f"cache={rounded}): needs 2*head_dim % 128 == 0 and a "
                     f"whole-{_DA.BLOCK_S}-block cache; use 'auto' or 'xla'")
         # Prefill allocates its cache *inside* the program (zeros are free

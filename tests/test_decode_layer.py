@@ -151,3 +151,36 @@ def test_mega_composes_with_iteration_batching():
         t.join(timeout=300)
     np.testing.assert_array_equal(res["A"], wantA)
     np.testing.assert_array_equal(res["B"], wantB)
+
+
+def test_llama_mega_matches_xla_fp32():
+    from llm_sharding_demo_tpu.models import llama
+    cfg = llama.LlamaConfig(vocab_size=211, n_positions=1024, n_embd=256,
+                            n_layer=2, n_head=4, n_kv_head=2,
+                            intermediate_size=256)
+    params = jax.tree.map(lambda x: x * 4.0,
+                          llama.init_params(cfg, jax.random.PRNGKey(3)))
+    p = np.asarray([[5, 9, 2, 77, 30]])
+    xla = DecodeEngine(params, cfg, max_seq=300, decode_kernel="xla")
+    mega = DecodeEngine(params, cfg, max_seq=300, decode_kernel="interpret")
+    assert mega._decode_kernel == "mega-interpret"
+    a = xla.generate(p, 40)
+    b = mega.generate(p, 40)
+    assert list(a.tokens[0]) == list(b.tokens[0])
+    # GQA ragged through the per-row pad mask + per-row RoPE offsets
+    ar = xla.generate([[5, 9, 2, 77, 30], [42, 3]], 24)
+    br = mega.generate([[5, 9, 2, 77, 30], [42, 3]], 24)
+    assert np.array_equal(ar.tokens, br.tokens)
+
+
+def test_llama_mega_eligibility():
+    from llm_sharding_demo_tpu.models import llama
+    from llm_sharding_demo_tpu.ops.decode_layer import llama_eligible
+    # GQA with an unaligned kv width (1 kv head * 64) stays per-layer
+    cfg = llama.LlamaConfig(vocab_size=97, n_positions=1024, n_embd=128,
+                            n_layer=1, n_head=2, n_kv_head=1,
+                            intermediate_size=128)
+    assert not llama_eligible(cfg, 512)
+    eng = DecodeEngine(llama.init_params(cfg, jax.random.PRNGKey(0)), cfg,
+                       max_seq=300, decode_kernel="interpret")
+    assert eng._decode_kernel == "interpret"   # per-layer kernel
