@@ -65,26 +65,29 @@ MAX_BATCH = 16
 _VMEM_BUDGET = 90 * 1024 * 1024
 
 
-def _vmem_fits(weight_bytes_per_layer: int, hkv: int, hd: int) -> bool:
+def _vmem_fits(weight_elems_per_layer: int, hkv: int, hd: int,
+               itemsize: int) -> bool:
     """The two big VMEM tenants: double-buffered layer weights (BlockSpec
     pipelining) and the double-buffered KV stream at the worst-case
-    batch. Computed for bf16 weights (int8 is smaller)."""
-    kv_stream = 2 * MAX_BATCH * hkv * BLOCK_S * 2 * hd * 2
-    return 2 * weight_bytes_per_layer + kv_stream <= _VMEM_BUDGET
+    batch, at the engine's ACTUAL itemsize (fp32 engines reachable via
+    the explicit 'mega' mode need twice bf16's budget)."""
+    kv_stream = 2 * MAX_BATCH * hkv * BLOCK_S * 2 * hd * itemsize
+    return 2 * weight_elems_per_layer * itemsize + kv_stream <= _VMEM_BUDGET
 
 
-def eligible(config, max_seq: int) -> bool:
+def eligible(config, max_seq: int, itemsize: int = 2) -> bool:
     """Whether the megakernel applies to this GPT-2 geometry: fused rows
     lane-aligned, cache in whole blocks, every matmul dim lane-aligned
     (real-model sizes are; toy test sizes fall back to the per-layer
     kernel), and the per-layer weights + KV stream fit the VMEM budget
-    so "auto" never picks an uncompilable kernel. Batch is a trace-time
-    check (``MAX_BATCH``)."""
+    at the engine dtype's ``itemsize`` so no selection path picks an
+    uncompilable kernel. Batch is a trace-time check (``MAX_BATCH``)."""
     d = config.n_embd
     return ((2 * config.head_dim) % _LANE == 0
             and max_seq % BLOCK_S == 0 and max_seq >= BLOCK_S
             and d % _LANE == 0
-            and _vmem_fits(12 * d * d * 2, config.n_head, config.head_dim))
+            and _vmem_fits(12 * d * d, config.n_head, config.head_dim,
+                           itemsize))
 
 
 def _ln(h, scale, bias, eps):
@@ -310,34 +313,45 @@ def _kernel(meta_ref,
         hout_ref[...] = h
 
 
-def _weight_parts(blocks) -> Tuple[list, bool]:
-    """Flatten the stacked GPT-2 block tree into the kernel's operand
-    order; quantized kernels contribute (q, scale) pairs, float kernels
-    a zero-width scale placeholder (same operand count either way)."""
+def _quant_pairs(kernels: list) -> Tuple[list, bool]:
+    """Shared quantization plumbing for both families' part builders:
+    kernel leaves -> ``[(w, scale), ...]`` plus the all-or-nothing
+    quantized flag. A partially quantized tree would silently treat raw
+    int8 codes as float weights (or drop a real scale) — refuse. Float
+    trees get 1-lane dummy scales so both cases share one kernel
+    signature (the static ``quantized`` flag means they are never
+    read)."""
     from .quant import is_quantized
 
-    def pair(leaf):
-        if is_quantized(leaf):
-            return leaf.q, leaf.scale
-        return leaf, None
-
-    a = blocks["attn"]
-    mlp = blocks["mlp"]
-    wqkv, sqkv = pair(a["c_attn"]["kernel"])
-    wout, sout = pair(a["c_proj"]["kernel"])
-    wfc, sfc = pair(mlp["c_fc"]["kernel"])
-    wproj, sproj = pair(mlp["c_proj"]["kernel"])
-    quantized = sqkv is not None
-    if any((s is not None) != quantized for s in (sout, sfc, sproj)):
-        # a partially quantized tree would silently treat raw int8 codes
-        # as float weights (or drop a real scale) — refuse
+    pairs = [(leaf.q, leaf.scale) if is_quantized(leaf) else (leaf, None)
+             for leaf in kernels]
+    quantized = pairs[0][1] is not None
+    if any((s is not None) != quantized for _, s in pairs):
         raise ValueError("mixed quantized/float block kernels")
     if not quantized:
-        # 1-lane dummy scales keep one kernel signature; the static
-        # ``quantized`` flag means they are never read
-        def mk(w):
-            return jnp.ones((w.shape[0], 1), jnp.float32)
-        sqkv, sout, sfc, sproj = (mk(wqkv), mk(wout), mk(wfc), mk(wproj))
+        pairs = [(w, jnp.ones((w.shape[0], 1), jnp.float32))
+                 for w, _ in pairs]
+    return pairs, quantized
+
+
+def _stack_vectors(parts: list) -> list:
+    """Per-layer VECTORS ride as [L, 1, D]: Mosaic requires a block's
+    last two dims to divide (8, 128) or equal the array's — a (1, D)
+    block of an [L, D] array does neither, a (1, 1, D) block of
+    [L, 1, D] matches exactly."""
+    return [x[:, None, :] if x.ndim == 2 else x for x in parts]
+
+
+def _weight_parts(blocks) -> Tuple[list, bool]:
+    """Flatten the stacked GPT-2 block tree into the kernel's operand
+    order; quantized kernels contribute (q, scale) pairs (dummy scales
+    for float trees — see ``_quant_pairs``)."""
+    a = blocks["attn"]
+    mlp = blocks["mlp"]
+    pairs, quantized = _quant_pairs(
+        [a["c_attn"]["kernel"], a["c_proj"]["kernel"],
+         mlp["c_fc"]["kernel"], mlp["c_proj"]["kernel"]])
+    (wqkv, sqkv), (wout, sout), (wfc, sfc), (wproj, sproj) = pairs
     parts = [
         blocks["ln_1"]["scale"], blocks["ln_1"]["bias"],
         wqkv, sqkv, a["c_attn"]["bias"],
@@ -346,12 +360,7 @@ def _weight_parts(blocks) -> Tuple[list, bool]:
         wfc, sfc, mlp["c_fc"]["bias"],
         wproj, sproj, mlp["c_proj"]["bias"],
     ]
-    # per-layer VECTORS ride as [L, 1, D]: Mosaic requires a block's last
-    # two dims to divide (8, 128) or equal the array's — a (1, D) block
-    # of an [L, D] array does neither, a (1, 1, D) block of [L, 1, D]
-    # matches exactly
-    parts = [x[:, None, :] if x.ndim == 2 else x for x in parts]
-    return parts, quantized
+    return _stack_vectors(parts), quantized
 
 
 def _build_call(kernel, parts, vmem_operands, KV, meta, *, n_head,
@@ -422,18 +431,19 @@ def _call(parts, h0, vf_bh, KV, meta, *, quantized, n_head, eps,
                        n_head=n_head, interpret=interpret)
 
 
-def llama_eligible(config, max_seq: int) -> bool:
+def llama_eligible(config, max_seq: int, itemsize: int = 2) -> bool:
     """Megakernel eligibility for the llama family: everything GPT-2
     needs, plus lane-aligned kv-projection and SwiGLU hidden dims."""
     d = config.n_embd
     kv = config.n_kv_head * config.head_dim
     per_layer = (2 * d * d + 2 * d * kv
-                 + 3 * d * config.intermediate_size) * 2
+                 + 3 * d * config.intermediate_size)
     return ((2 * config.head_dim) % _LANE == 0
             and max_seq % BLOCK_S == 0 and max_seq >= BLOCK_S
             and d % _LANE == 0 and kv % _LANE == 0
             and config.intermediate_size % _LANE == 0
-            and _vmem_fits(per_layer, config.n_kv_head, config.head_dim))
+            and _vmem_fits(per_layer, config.n_kv_head, config.head_dim,
+                           itemsize))
 
 
 def _rms(h, scale, eps):
@@ -486,7 +496,6 @@ def _llama_kernel(meta_ref,
         h_ref[...] = h0_ref[...]
 
     h = h_ref[...]
-    d = h.shape[-1]
     g = n_head // hkv
 
     a = _rms(h, ln_a[0, 0], eps)
@@ -522,39 +531,21 @@ def _llama_kernel(meta_ref,
 
 
 def _llama_weight_parts(blocks) -> Tuple[list, bool]:
-    from .quant import is_quantized
-
-    def pair(leaf):
-        if is_quantized(leaf):
-            return leaf.q, leaf.scale
-        return leaf, None
-
     a = blocks["attn"]
     mlp = blocks["mlp"]
-    wq, sq = pair(a["wq"]["kernel"])
-    wk, sk = pair(a["wk"]["kernel"])
-    wv, sv = pair(a["wv"]["kernel"])
-    wo, so = pair(a["wo"]["kernel"])
-    wg, sg = pair(mlp["gate"]["kernel"])
-    wu, su = pair(mlp["up"]["kernel"])
-    wd, sd = pair(mlp["down"]["kernel"])
-    quantized = sq is not None
-    if any((s is not None) != quantized
-           for s in (sk, sv, so, sg, su, sd)):
-        raise ValueError("mixed quantized/float block kernels")
-    if not quantized:
-        def mk(w):
-            return jnp.ones((w.shape[0], 1), jnp.float32)
-        sq, sk, sv, so = mk(wq), mk(wk), mk(wv), mk(wo)
-        sg, su, sd = mk(wg), mk(wu), mk(wd)
+    pairs, quantized = _quant_pairs(
+        [a["wq"]["kernel"], a["wk"]["kernel"], a["wv"]["kernel"],
+         a["wo"]["kernel"], mlp["gate"]["kernel"], mlp["up"]["kernel"],
+         mlp["down"]["kernel"]])
+    (wq, sq), (wk, sk), (wv, sv), (wo, so), (wg, sg), (wu, su), (wd, sd) \
+        = pairs
     parts = [
         blocks["ln_attn"]["scale"],
         wq, sq, wk, sk, wv, sv, wo, so,
         blocks["ln_mlp"]["scale"],
         wg, sg, wu, su, wd, sd,
     ]
-    parts = [x[:, None, :] if x.ndim == 2 else x for x in parts]
-    return parts, quantized
+    return _stack_vectors(parts), quantized
 
 
 @functools.partial(jax.jit,

@@ -541,22 +541,25 @@ class DecodeEngine:
             from ..models import gpt2 as _g
             from ..models import llama as _ll
             from ..ops import decode_layer as _DL
+            isize = jnp.dtype(dtype).itemsize
             mega_ok = base_ok and self.specs is None and (
-                (self._model is _g and _DL.eligible(config, rounded))
+                (self._model is _g and _DL.eligible(config, rounded, isize))
                 or (self._model is _ll
-                    and _DL.llama_eligible(config, rounded)))
+                    and _DL.llama_eligible(config, rounded, isize)))
             if decode_kernel in ("mega", "mega-interpret") and not mega_ok:
                 raise ValueError(
                     f"decode_kernel={decode_kernel!r} requested but the "
                     "megakernel is ineligible here (needs an unstaged "
                     "GPT-2/llama engine, lane-aligned dims within the "
-                    "VMEM budget, and a whole-block cache)")
+                    "VMEM budget, and a whole-block cache). Note: even "
+                    "an eligible mega engine falls back to the per-layer "
+                    f"kernel at trace time past {_DL.MAX_BATCH} batch "
+                    "rows (its VMEM batch budget)")
             if base_ok:
                 self._cache_seq = rounded
-                if decode_kernel in ("layer", "layer-interpret"):
-                    self._decode_kernel = ("interpret" if explicit_interp
-                                           else "device")
-                elif mega_ok:
+                use_mega = (mega_ok and decode_kernel
+                            not in ("layer", "layer-interpret"))
+                if use_mega:
                     self._decode_kernel = ("mega-interpret"
                                            if explicit_interp else "mega")
                 else:
