@@ -59,6 +59,19 @@ _LANE = 128
 # VMEM budget keeps the whole-stack fusion to decode-sized batches; the
 # model falls back to the per-layer kernel above this (trace-time shape).
 MAX_BATCH = 16
+
+
+def mega_requested(decode_kernel, seq_len: int) -> bool:
+    """Shared dispatch predicate for every megakernel call site (model
+    forwards and the stage runner)."""
+    return (bool(decode_kernel) and decode_kernel.startswith("mega")
+            and seq_len == 1)
+
+
+def mega_downgrade(decode_kernel: str) -> str:
+    """The per-layer-kernel mode a mega engine falls back to at trace
+    time (batch past MAX_BATCH)."""
+    return "interpret" if decode_kernel == "mega-interpret" else "device"
 # Conservative VMEM ceiling for the eligibility estimate: the call sets
 # vmem_limit_bytes=110MB; leave slack for accumulators/activations so
 # "auto" never selects a megakernel Mosaic cannot allocate.

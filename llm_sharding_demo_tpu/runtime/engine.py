@@ -541,18 +541,22 @@ class DecodeEngine:
             from ..models import gpt2 as _g
             from ..models import llama as _ll
             from ..ops import decode_layer as _DL
+            # staged engines compose: each stage's stacked blocks run as
+            # their own whole-stack launch (parallel.partition.
+            # stage_apply's mega route) — n_stages launches per step
+            # instead of one per op
             isize = jnp.dtype(dtype).itemsize
-            mega_ok = base_ok and self.specs is None and (
+            mega_ok = base_ok and (
                 (self._model is _g and _DL.eligible(config, rounded, isize))
                 or (self._model is _ll
                     and _DL.llama_eligible(config, rounded, isize)))
             if decode_kernel in ("mega", "mega-interpret") and not mega_ok:
                 raise ValueError(
                     f"decode_kernel={decode_kernel!r} requested but the "
-                    "megakernel is ineligible here (needs an unstaged "
-                    "GPT-2/llama engine, lane-aligned dims within the "
-                    "VMEM budget, and a whole-block cache). Note: even "
-                    "an eligible mega engine falls back to the per-layer "
+                    "megakernel is ineligible here (needs a GPT-2/llama "
+                    "engine with lane-aligned dims within the VMEM "
+                    "budget and a whole-block cache). Note: even an "
+                    "eligible mega engine falls back to the per-layer "
                     f"kernel at trace time past {_DL.MAX_BATCH} batch "
                     "rows (its VMEM batch budget)")
             if base_ok:

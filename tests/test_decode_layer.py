@@ -91,11 +91,11 @@ def test_mega_eligibility_gates():
     assert not eligible(cfg, 512)
     eng = DecodeEngine(params, cfg, max_seq=300, decode_kernel="interpret")
     assert eng._decode_kernel == "interpret"     # per-layer, not mega
-    # staged engines never take the megakernel
+    # staged engines DO take the megakernel (one launch per stage)
     cfg2, params2 = _setup(n_layer=4)
     staged = DecodeEngine(params2, cfg2, max_seq=300, boundaries=[2],
                           decode_kernel="interpret")
-    assert staged._decode_kernel == "interpret"
+    assert staged._decode_kernel == "mega-interpret"
 
 
 def test_mega_composes_with_chunked_prefill_and_sampling():
@@ -184,3 +184,36 @@ def test_llama_mega_eligibility():
     eng = DecodeEngine(llama.init_params(cfg, jax.random.PRNGKey(0)), cfg,
                        max_seq=300, decode_kernel="interpret")
     assert eng._decode_kernel == "interpret"   # per-layer kernel
+
+
+def test_staged_engine_mega_matches_xla():
+    """DecodeEngine(boundaries=...) + megakernel: one whole-stack launch
+    per stage, streams equal the XLA engine (gpt2 and llama)."""
+    from llm_sharding_demo_tpu.models import llama
+    cfg, params = _setup(n_layer=4)
+    p = np.asarray([[5, 9, 2, 77, 30]])
+    want = DecodeEngine(params, cfg, max_seq=300,
+                        decode_kernel="xla").generate(p, 24)
+    staged = DecodeEngine(params, cfg, max_seq=300, boundaries=[1, 3],
+                          decode_kernel="interpret")
+    assert staged._decode_kernel == "mega-interpret"
+    got = staged.generate(p, 24)
+    assert list(want.tokens[0]) == list(got.tokens[0])
+    # ragged + staged + mega
+    wr = DecodeEngine(params, cfg, max_seq=300,
+                      decode_kernel="xla").generate([[5, 9, 2], [42]], 16)
+    gr = staged.generate([[5, 9, 2], [42]], 16)
+    assert np.array_equal(wr.tokens, gr.tokens)
+    # llama staged + mega (GQA)
+    lcfg = llama.LlamaConfig(vocab_size=211, n_positions=1024, n_embd=256,
+                             n_layer=4, n_head=4, n_kv_head=2,
+                             intermediate_size=256)
+    lparams = jax.tree.map(lambda x: x * 4.0,
+                           llama.init_params(lcfg, jax.random.PRNGKey(6)))
+    lw = DecodeEngine(lparams, lcfg, max_seq=300,
+                      decode_kernel="xla").generate(p, 20)
+    ls = DecodeEngine(lparams, lcfg, max_seq=300, boundaries=[2],
+                      decode_kernel="interpret")
+    assert ls._decode_kernel == "mega-interpret"
+    lg = ls.generate(p, 20)
+    assert list(lw.tokens[0]) == list(lg.tokens[0])
