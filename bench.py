@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
@@ -514,7 +515,7 @@ def measure_uncached_jax(config, prompt_len: int, new_tokens: int,
     return None if m is None else 1.0 / m
 
 
-FULL_MATRIX_FILE = "BENCH_full_r03.json"
+FULL_MATRIX_FILE = "BENCH_full.json"
 _COMPACT_DROP = ("note", "traceback_tail")
 
 
@@ -553,7 +554,8 @@ def emit(payload: dict, write_file: bool = True) -> None:
 
     compact = {k: v for k, v in payload.items() if k != "configs"}
     compact["configs"] = [compact_cfg(c) for c in payload.get("configs", [])]
-    compact["full_matrix_file"] = FULL_MATRIX_FILE
+    if write_file:
+        compact["full_matrix_file"] = FULL_MATRIX_FILE
     print(json.dumps(compact))
 
 
@@ -778,18 +780,129 @@ print(json.dumps({"dp8_step_s": round(t_dp, 4),
     return _json.loads(out.stdout.strip().splitlines()[-1])
 
 
+# ---------------------------------------------------------------------------
+# Capture-proofing (VERDICT r4 missing #1): BENCH_r04.json was voided by a
+# backend-init error that escaped the per-config isolation (rc=1, parsed:
+# null) — and the same tunnel outage can also HANG instead of erroring
+# (jax.devices() blocks forever).  The driver contract is one parseable
+# JSON line no matter what, so the measurement now runs in a CHILD process
+# under a parent that (a) probes the backend with bounded retries before
+# committing to a run, (b) enforces a hard wall-clock watchdog, and
+# (c) on any child failure still emits a line assembled from the rows the
+# child completed (each safe() row is journaled to a progress file).
+# ---------------------------------------------------------------------------
+_CHILD_SENTINEL = "_BENCH_CHILD"
+_PROGRESS_ENV = "_BENCH_PROGRESS_FILE"
+_PROBE_ATTEMPTS = 3
+_PROBE_TIMEOUT_S = 150
+_PROBE_BACKOFF_S = 30
+_HEADLINE_METRIC = "greedy_decode_throughput_gpt2_124m"
+_QUICK_METRIC = "greedy_decode_throughput_tiny"
+
+
+def _journal_row(row: dict) -> None:
+    """Append one finished config row to the parent's progress file (the
+    partial-artifact fallback when the child dies mid-matrix)."""
+    progress = os.environ.get(_PROGRESS_ENV)
+    if not progress:
+        return
+    try:
+        with open(progress, "a") as f:
+            f.write(json.dumps(row) + "\n")
+    except OSError:
+        pass
+
+
+def _probe_backend() -> tuple:
+    """(platform, None) if a default backend answers within bounded time,
+    else (None, reason). Subprocess + timeout via the shared helper:
+    with the tunnel down, in-process jax.devices() can block forever."""
+    from llm_sharding_demo_tpu.utils.backend_probe import (
+        probe_default_backend)
+    return probe_default_backend(_PROBE_TIMEOUT_S, attempts=_PROBE_ATTEMPTS,
+                                 backoff_s=_PROBE_BACKOFF_S)
+
+
+def _parent_main(argv) -> None:
+    """Probe, then run the real bench in a watchdogged child; ALWAYS end
+    with one parseable JSON line on stdout."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    quick = "--quick" in argv
+    metric = _QUICK_METRIC if quick else _HEADLINE_METRIC
+    platform, reason = _probe_backend()
+    if platform is None:
+        emit({"metric": metric, "value": None,
+              "unit": "tokens/sec", "vs_baseline": None,
+              "skipped": f"backend unavailable: {reason}", "configs": []},
+             write_file=False)
+        return
+
+    fd, progress = tempfile.mkstemp(prefix="bench_progress_", suffix=".jsonl")
+    os.close(fd)
+    env = dict(os.environ)
+    env[_CHILD_SENTINEL] = "1"
+    env[_PROGRESS_ENV] = progress
+    here = os.path.abspath(__file__)
+    budget = 1500 if quick else 5400
+    try:
+        r = subprocess.run([sys.executable, here] + list(argv), env=env,
+                           cwd=os.path.dirname(here), timeout=budget)
+        if r.returncode == 0:
+            return  # child printed the line (and wrote the matrix file)
+        reason = f"bench child exited rc={r.returncode}"
+    except subprocess.TimeoutExpired:
+        reason = f"bench child exceeded {budget}s watchdog"
+    finally:
+        rows = []
+        try:
+            with open(progress) as f:
+                rows = [json.loads(ln) for ln in f if ln.strip()]
+        except OSError:
+            pass
+        try:
+            os.unlink(progress)
+        except OSError:
+            pass
+    by_name = {c.get("name"): c for c in rows}
+    head = (by_name.get("cfg1_tiny_gpt2_2shard_20tok", {}) if quick
+            else by_name.get("cfg2_gpt2_124m_2shard_single_prompt", {}))
+    value = (head.get("tokens_per_sec") if quick
+             else head.get("engine_bf16_tokens_per_sec"))
+    vs = (head.get("vs_baseline") if quick
+          else head.get("engine_bf16_vs_baseline"))
+    emit({"metric": metric, "value": value, "unit": "tokens/sec",
+          "vs_baseline": vs, "error": reason, "partial": True,
+          "configs": rows}, write_file=False)
+
+
 def main() -> None:
+    import os
+    import sys
+
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true",
                         help="cfg1 only (tiny model) for a fast smoke run")
     args = parser.parse_args()
+
+    if not os.environ.get(_CHILD_SENTINEL):
+        _parent_main(sys.argv[1:])
+        return
 
     from llm_sharding_demo_tpu.models import gpt2
 
     tiny, g124, gmed = (gpt2.CONFIGS[k]
                         for k in ("tiny-gpt2", "gpt2", "gpt2-medium"))
     configs = []
-    rtt_ms = measure_dispatch_rtt()
+    try:
+        rtt_ms = measure_dispatch_rtt()
+    except Exception as e:  # noqa: BLE001 — a dead rtt probe must not
+        rtt_ms = None       # void the artifact; rtt-dependent rows error
+        configs.append({"name": "dispatch_rtt",  # individually via safe()
+                        "error": f"{type(e).__name__}: {e}"})
 
     # cfg1: tiny-gpt2, 2-shard, 20 tokens — the notebook workload, timed
     # e2e as mandated. With ~2 dispatches x rtt_ms of tunnel latency in a
@@ -800,6 +913,19 @@ def main() -> None:
         pipe_tiny = measure_pipeline(tiny, 2, 4, two_point=False,
                                      new_tokens=20)
         fused = measure_single_program_e2e(tiny, 4, 20)
+        if rtt_ms is None:  # rtt probe died: keep the real measurements,
+            return {        # just drop the rtt-derived context fields
+                "tokens_per_sec": round(pipe_tiny["tokens_per_sec"], 2),
+                "single_program_tokens_per_sec": round(
+                    fused["tokens_per_sec"], 1),
+                "ref_cpu_tokens_per_sec": round(ref_tiny, 2),
+                "vs_baseline": round(
+                    pipe_tiny["tokens_per_sec"] / ref_tiny, 2),
+                "single_program_vs_baseline": round(
+                    fused["tokens_per_sec"] / ref_tiny, 2),
+                "transfer_rtt_ms": None,
+                "note": "rtt probe failed; see dispatch_rtt error row",
+            }
         rtt_bound = 20 / (rtt_ms / 1e3)
         return {
             "tokens_per_sec": round(pipe_tiny["tokens_per_sec"], 2),
@@ -831,20 +957,24 @@ def main() -> None:
     def safe(name: str, fn) -> None:
         import traceback
         try:
-            configs.append({"name": name, **fn()})
+            row = {"name": name, **fn()}
         except Exception as e:  # noqa: BLE001 — report, don't die
-            configs.append({"name": name, "error": f"{type(e).__name__}: {e}",
-                            "traceback_tail":
-                                traceback.format_exc().strip()[-600:]})
+            row = {"name": name, "error": f"{type(e).__name__}: {e}",
+                   "traceback_tail":
+                       traceback.format_exc().strip()[-600:]}
+        configs.append(row)
+        _journal_row(row)
 
     safe("cfg1_tiny_gpt2_2shard_20tok", cfg1)
 
     if args.quick:
+        row = next((c for c in configs
+                    if c["name"] == "cfg1_tiny_gpt2_2shard_20tok"), {})
         emit({
-            "metric": "greedy_decode_throughput_tiny",
-            "value": configs[0].get("tokens_per_sec"),
+            "metric": _QUICK_METRIC,
+            "value": row.get("tokens_per_sec"),
             "unit": "tokens/sec",
-            "vs_baseline": configs[0].get("vs_baseline"),
+            "vs_baseline": row.get("vs_baseline"),
             "configs": configs,
         }, write_file=False)
         return
@@ -1057,7 +1187,7 @@ def main() -> None:
         # THE serving metric (aggregate batched decode) alongside the
         # round-1-compatible single-stream headline
         "batched_bs8_tokens_per_sec": batched.get("tokens_per_sec"),
-        "transfer_rtt_ms": round(rtt_ms, 1),
+        "transfer_rtt_ms": None if rtt_ms is None else round(rtt_ms, 1),
         "configs": configs,
     })
 

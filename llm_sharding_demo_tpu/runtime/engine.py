@@ -50,15 +50,29 @@ REF_TOP_K = 40
 EOS_SEGMENT = 32
 
 
-def _cap_segment(seg, cap: int) -> list:
-    """Split one ``(n, window)`` segment into ``cap``-step chunks (same
-    window — the chunks reuse one compiled body)."""
-    n, w = seg
+# EOS check-cap doubling ceiling: checks land at 32, 64, 128, 256, 256...
+# steps, so a long armed decode pays O(log) + steps/256 syncs instead of
+# steps/32. On the tunneled bench chip a sync is ~100 ms ≈ ~300 decode
+# tokens' worth (ADVICE r4: fixed 32-step checks can cost more than the
+# dead tokens they save); the doubling schedule keeps the early checks
+# (most exits are early) while bounding the sync tax on long tails at
+# <1/256 steps. Worst-case overshoot past the EOS grows with the same
+# schedule and stays ≤ the current check interval.
+_EOS_CAP_MAX = 256
+
+
+def _eos_capped_segments(segs: list) -> list:
+    """Subdivide planner segments for EOS checking with doubling caps.
+    Chunk sizes are planner quanta or powers of two between EOS_SEGMENT
+    and ``_EOS_CAP_MAX`` — a bounded compiled-program set."""
     out = []
-    while n > cap:
-        out.append((cap, w))
-        n -= cap
-    out.append((n, w))
+    cap = EOS_SEGMENT
+    for n, w in segs:
+        while n > 0:
+            take = min(cap, n)
+            out.append((take, w))
+            n -= take
+            cap = min(cap * 2, _EOS_CAP_MAX)
     return out
 
 
@@ -850,14 +864,18 @@ class DecodeEngine:
         prefixes explicitly.
 
         ``eos_id`` arms on-device-work early exit: the decode runs in
-        segments capped at ``EOS_SEGMENT`` steps and stops at the first
+        chunks with DOUBLING caps (``EOS_SEGMENT`` = 32, then 64, 128,
+        up to ``_EOS_CAP_MAX`` = 256 steps) and stops at the first
         boundary where EVERY row has emitted ``eos_id`` — the emitted
         tokens are the byte-exact prefix of the uncapped stream (same
         programs, same prefix-stable per-step keys), but dead tokens
-        past the last row's EOS stop costing device time. Costs one
-        host sync per segment while armed (the unarmed path keeps its
-        zero-sync dispatch pipeline), so serving arms it only for
-        ``stop_at_eos`` requests. May return fewer than
+        past the last row's EOS stop costing device time. Each armed
+        chunk costs one host sync (the unarmed path keeps its zero-sync
+        dispatch pipeline); the doubling schedule bounds that tax on
+        long generations while keeping early exits fine-grained —
+        worst-case overshoot past the EOS equals the current chunk size
+        (up to 256 steps late in a long decode). Serving arms it only
+        for ``stop_at_eos`` requests. May return fewer than
         ``max_new_tokens`` tokens (``GenerateResult.new_tokens``).
         """
         ids, batch, prompt_len, key, pad = prepare_generate(
@@ -908,11 +926,14 @@ class DecodeEngine:
         stop paying for the full ``max_seq`` read. Exact, and the same
         program count as before for short generations.
 
-        ``eos_id`` (see ``generate``) caps segments at ``EOS_SEGMENT``
-        steps and fetches each segment's tokens; the loop exits at the
-        first boundary where every row has emitted the id. No new
-        programs: a capped segment reuses the (n, window) body the cap
-        produces, and caps are multiples of the planner's quantum."""
+        ``eos_id`` (see ``generate``) subdivides segments with DOUBLING
+        caps (32, 64, ... ``_EOS_CAP_MAX``) and fetches each chunk's
+        tokens; the loop exits at the first boundary where every row has
+        emitted the id. Early exits keep their fine granularity while a
+        long armed tail pays logarithmically few syncs (ADVICE r4: on
+        high-RTT tunnels fixed 32-step checks can cost more than the
+        dead tokens they save). Program set stays bounded: chunk sizes
+        are powers of two or planner quanta."""
         t1 = time.perf_counter()
         steps = max_new_tokens
         parts = [first[:, None]]
@@ -920,7 +941,7 @@ class DecodeEngine:
         segs = self._segments(prompt_len, steps)
         done = None
         if eos_id is not None:
-            segs = [s for seg in segs for s in _cap_segment(seg, EOS_SEGMENT)]
+            segs = _eos_capped_segments(segs)
             done = np.asarray(first) == eos_id
         if steps > 1 and not (done is not None and done.all()):
             step_keys = _step_keys(decode_key, steps - 1)

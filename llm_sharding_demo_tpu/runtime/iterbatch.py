@@ -73,6 +73,18 @@ class _Req:
         default_factory=threading.Event)
     payload: Optional[tuple] = None   # (_Slot, eos_at) — caller assembles
     error: Optional[Exception] = None
+    # Set by generate() on timeout: the caller is gone, so the scheduler
+    # drops the request at dequeue and frees its slot at the next
+    # retirement pass instead of decoding dead tokens for nobody.
+    cancelled: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+
+    def fail(self, e: Exception) -> None:
+        """Deliver an error exactly once (idempotent across the several
+        except paths that may observe the same request)."""
+        if not self.done.is_set():
+            self.error = e
+            self.done.set()
 
 
 class _SegOut:
@@ -219,6 +231,11 @@ class IterBatchingEngine:
                    sampling=sampling, key=key, eos_id=eos_id)
         self._queue.put(req)
         if not req.done.wait(timeout):
+            # Cancel, don't just abandon: the scheduler skips cancelled
+            # requests at dequeue and retires a cancelled live row at the
+            # next segment boundary, so repeated timeouts cannot
+            # accumulate dead decode work (ADVICE r4).
+            req.cancelled.set()
             raise TimeoutError("iter-batched generate timed out")
         if req.error is not None:
             raise req.error
@@ -254,12 +271,12 @@ class IterBatchingEngine:
         while True:
             head = self._pending or self._queue.get()
             self._pending = None
+            if head.cancelled.is_set():
+                continue
             try:
                 self._run_batch(head)
             except Exception as e:  # noqa: BLE001 — delivered per-request
-                if not head.done.is_set():
-                    head.error = e
-                    head.done.set()
+                head.fail(e)
 
     def _compatible(self, state: _BatchState, req: _Req) -> bool:
         """Can ``req`` join the live batch right now? Policy must match,
@@ -278,17 +295,19 @@ class IterBatchingEngine:
                 self._advance(state)
         except Exception as e:  # noqa: BLE001
             for s in state.slots:
-                if s is not None and not s.req.done.is_set():
-                    s.req.error = e
-                    s.req.done.set()
+                if s is not None:
+                    s.req.fail(e)
             raise
 
     # -- seeding -------------------------------------------------------------
 
     def _seed(self, head: _Req) -> _BatchState:
         """Start a batch: gather up-to-``max_wait`` same-policy peers
-        that fit together, batched prefill, first tokens."""
-        eng = self.engine
+        that fit together, batched prefill, first tokens.  Any failure
+        past the gathering point (e.g. a prefill OOM) is delivered to
+        EVERY gathered request, not just the head — a gathered peer with
+        ``done`` never set would block its caller forever (ADVICE r4
+        medium)."""
         seed = [head]
         deadline = time.monotonic() + self.max_wait_s
         while len(seed) < self.max_batch:
@@ -299,6 +318,8 @@ class IterBatchingEngine:
                 nxt = self._queue.get(timeout=remaining)
             except queue.Empty:
                 break
+            if nxt.cancelled.is_set():
+                continue
             if nxt.sampling == seed[0].sampling and self._fits(seed + [nxt]):
                 seed.append(nxt)
             else:
@@ -307,6 +328,15 @@ class IterBatchingEngine:
                 # live) and otherwise it seeds the next batch
                 self._pending = nxt
                 break
+        try:
+            return self._seed_batch(seed)
+        except Exception as e:  # noqa: BLE001
+            for r in seed:
+                r.fail(e)
+            raise
+
+    def _seed_batch(self, seed: List[_Req]) -> _BatchState:
+        eng = self.engine
         s_max = self._seed_smax(seed)
 
         b = self.max_batch
@@ -379,6 +409,9 @@ class IterBatchingEngine:
                 return
             if self._pending is not None:
                 req = self._pending
+                if req.cancelled.is_set():
+                    self._pending = None
+                    continue
                 if not self._compatible(state, req):
                     state.closed = True
                     return
@@ -388,11 +421,17 @@ class IterBatchingEngine:
                     req = self._queue.get_nowait()
                 except queue.Empty:
                     return
+                if req.cancelled.is_set():
+                    continue
                 if not self._compatible(state, req):
                     self._pending = req
                     state.closed = True
                     return
-            self._admit_one(state, req, free[0])
+            try:
+                self._admit_one(state, req, free[0])
+            except Exception as e:  # noqa: BLE001 — the popped request is
+                req.fail(e)        # not in state.slots yet; without this
+                raise              # its caller would block forever
 
     def _admit_one(self, state: _BatchState, req: _Req, slot: int):
         eng = self.engine
@@ -475,6 +514,12 @@ class IterBatchingEngine:
                         for s in state.slots)
         for i, s in enumerate(state.slots):
             if s is None:
+                continue
+            if s.req.cancelled.is_set():
+                # Caller timed out and left: free the slot instead of
+                # decoding dead tokens for nobody. Nothing is delivered
+                # (the payload has no reader).
+                state.slots[i] = None
                 continue
             done = s.emitted >= s.req.max_new_tokens
             eos_at = None

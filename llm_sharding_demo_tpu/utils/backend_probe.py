@@ -1,0 +1,49 @@
+"""Bounded default-backend probe, in a subprocess.
+
+With the TPU tunnel down, in-process ``jax.devices()`` can block forever
+(round-4 failure: MULTICHIP_r04.json rc=124 — the parent hung at backend
+init and the driver's timeout voided the artifact). Probing in a child
+process under a hard timeout turns "hang" into a reportable state.
+Shared by ``bench.py``'s pre-flight check and ``__graft_entry__``'s
+mega-mosaic smoke gate so tunnel-behavior fixes land once.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Optional, Tuple
+
+_PROBE_CODE = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+
+
+def probe_default_backend(timeout_s: float, attempts: int = 1,
+                          backoff_s: float = 0.0,
+                          env: Optional[dict] = None,
+                          ) -> Tuple[Optional[str], Optional[str]]:
+    """(platform, None) if the default backend answers within
+    ``timeout_s``, else (None, reason).  ``attempts``/``backoff_s`` add
+    linear-backoff retries for flaky-tunnel windows (sleep grows
+    ``backoff_s * attempt`` between tries)."""
+    env = dict(os.environ if env is None else env)
+    reason = "unknown"
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(backoff_s * attempt)
+        try:
+            r = subprocess.run([sys.executable, "-c", _PROBE_CODE],
+                               capture_output=True, text=True, env=env,
+                               timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            reason = (f"backend probe hung >{timeout_s:g}s "
+                      f"(attempt {attempt + 1}/{attempts}; TPU tunnel down)")
+            continue
+        out = r.stdout or ""
+        if r.returncode == 0 and "PLATFORM=" in out:
+            return out.rsplit("PLATFORM=", 1)[1].split()[0], None
+        reason = (f"backend probe rc={r.returncode} "
+                  f"(attempt {attempt + 1}/{attempts}): "
+                  + (r.stderr or "").strip()[-200:])
+    return None, reason

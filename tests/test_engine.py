@@ -388,3 +388,20 @@ def test_eos_early_exit_sampled_stream_prefix(hf_engine):
     assert early.new_tokens < 40
     np.testing.assert_array_equal(
         early.tokens, plain.tokens[:, :5 + early.new_tokens])
+
+
+def test_eos_caps_double_then_plateau():
+    """ADVICE r4: EOS checks use doubling caps so a long armed decode
+    pays O(log)+n/256 syncs, not n/32; chunks never exceed _EOS_CAP_MAX
+    and always sum to the original step count."""
+    from llm_sharding_demo_tpu.runtime.engine import (
+        EOS_SEGMENT, _EOS_CAP_MAX, _eos_capped_segments)
+    segs = [(640, 1024), (384, 2048)]
+    capped = _eos_capped_segments(segs)
+    sizes = [n for n, _ in capped]
+    assert sizes == [32, 64, 128, 256, 160, 256, 128]
+    assert sum(n for n, _ in capped) == 640 + 384
+    assert all(n <= _EOS_CAP_MAX for n, _ in capped)
+    assert sizes[0] == EOS_SEGMENT
+    # windows preserved per source segment
+    assert [w for _, w in capped] == [1024] * 5 + [2048] * 2

@@ -321,3 +321,104 @@ def test_two_incompatible_arrivals_none_dropped(setup):
     np.testing.assert_array_equal(resG.tokens[0], wantG)
     np.testing.assert_array_equal(res1.tokens[0], want1)
     np.testing.assert_array_equal(res2.tokens[0], want2)
+
+
+def test_seed_failure_delivers_error_to_all_gathered_peers():
+    """ADVICE r4 medium: a prefill failure during seeding must error-out
+    EVERY gathered request — a peer whose done is never set blocks its
+    caller forever (serving calls generate() with no timeout)."""
+    _, _, engine = _setup()
+
+    def boom(*a, **kw):
+        raise RuntimeError("synthetic prefill OOM")
+
+    engine._prefill = boom
+    ib = IterBatchingEngine(engine, max_batch=4, seg_steps=8,
+                            max_wait_ms=400.0)
+    rng = np.random.default_rng(0)
+    jobs = [(rng.integers(0, 211, size=(5,)), 8, 0.0, {}),
+            (rng.integers(0, 211, size=(6,)), 8, 0.05, {}),
+            (rng.integers(0, 211, size=(7,)), 8, 0.1, {})]
+    errs = [None] * len(jobs)
+
+    def run(i, p, n, delay, kw):
+        time.sleep(delay)
+        try:
+            ib.generate(p, n, **kw)
+        except Exception as e:  # noqa: BLE001
+            errs[i] = e
+
+    threads = [threading.Thread(target=run, args=(i, *j))
+               for i, j in enumerate(jobs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for i, e in enumerate(errs):
+        assert isinstance(e, RuntimeError), (i, e)
+        assert "synthetic prefill OOM" in str(e)
+
+
+def test_admit_failure_delivers_error_to_popped_request():
+    """ADVICE r4 medium, second path: _admit_one raising after the
+    request left the queue but before it entered state.slots must error
+    that request, not strand it."""
+    _, _, engine = _setup()
+    ib = IterBatchingEngine(engine, max_batch=4, seg_steps=8,
+                            max_wait_ms=10.0)
+
+    orig = IterBatchingEngine._admit_one
+
+    def boom(self, state, req, slot):
+        raise RuntimeError("synthetic admit failure")
+
+    IterBatchingEngine._admit_one = boom
+    try:
+        rng = np.random.default_rng(1)
+        jobs = [(rng.integers(0, 211, size=(5,)), 48, 0.0, {}),
+                (rng.integers(0, 211, size=(6,)), 8, 0.5, {})]
+        out = [None] * 2
+
+        def run(i, p, n, delay, kw):
+            time.sleep(delay)
+            try:
+                out[i] = ("ok", ib.generate(p, n, **kw))
+            except Exception as e:  # noqa: BLE001
+                out[i] = ("err", e)
+
+        threads = [threading.Thread(target=run, args=(i, *j))
+                   for i, j in enumerate(jobs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert out[0] is not None and out[1] is not None, out
+        # the joiner hit the synthetic failure; nobody blocked forever
+        kinds = {k for k, _ in out}
+        assert "err" in kinds
+        for k, v in out:
+            if k == "err":
+                assert "synthetic admit failure" in str(v)
+    finally:
+        IterBatchingEngine._admit_one = orig
+
+
+def test_timeout_cancels_request_and_frees_slot():
+    """ADVICE r4 low: generate(timeout=...) must CANCEL the request —
+    the scheduler skips it at dequeue / frees its live slot — so
+    repeated timeouts cannot accumulate dead decode work, and the
+    scheduler stays healthy for later requests."""
+    _, _, engine = _setup()
+    ib = IterBatchingEngine(engine, max_batch=2, seg_steps=8,
+                            max_wait_ms=5.0)
+    rng = np.random.default_rng(2)
+    p1 = rng.integers(0, 211, size=(5,))
+    with pytest.raises(TimeoutError):
+        ib.generate(p1, 120, timeout=1e-4)
+    # the cancelled row frees at the next segment boundary; a fresh
+    # request afterwards is served normally and promptly
+    p2 = rng.integers(0, 211, size=(6,))
+    res = ib.generate(p2, 8, timeout=120.0)
+    assert res.new_tokens == 8
+    # the timed-out request must not be counted as served
+    assert ib.stats()["rows"] == 1
