@@ -720,11 +720,15 @@ def _peak_bf16_flops():
 
 
 def measure_gpipe_overhead() -> dict:
-    """GPipe (pp4 x dp2, 4 microbatches) vs pure dp8, same model and
-    global batch, on an 8-device virtual CPU mesh (the only multi-device
-    environment the bench has): the ratio is the pipeline schedule's
-    overhead — the number behind parallel.gpipe's bubble-skip claim.
-    Absolute CPU times are meaningless; only the ratio is reported."""
+    """Pipeline schedules (GPipe and 1F1B, pp4 x dp2) vs pure dp8, same
+    model and global batch, on an 8-device virtual CPU mesh (the only
+    multi-device environment the bench has): the ratios are the
+    schedules' overheads — the numbers behind parallel.gpipe's
+    bubble-skip claim and parallel.pipeline_1f1b's schedule upgrade.
+    Absolute CPU times are meaningless; only the ratios are reported.
+    1F1B runs M=8 microbatches (its bounded stash is what makes large M
+    affordable — the schedule's whole point); GPipe keeps its M=4 row
+    for continuity with earlier rounds."""
     import json as _json
     import subprocess
     import sys
@@ -764,9 +768,17 @@ gp_mesh = spmd.make_mesh({"dp": 2, "pp": 4}, jax.devices())
 gp = train.GPipeTrainStep(cfg, train.adamw(1e-3), gp_mesh, n_microbatches=4)
 pgp, ogp = gp.init(params)
 t_gp = time_steps(gp, pgp, ogp, gp.shard_batch(ids))
+
+fb = train.GPipeTrainStep(cfg, train.adamw(1e-3), gp_mesh, n_microbatches=8,
+                          schedule="1f1b")
+pfb, ofb = fb.init(params)
+t_fb = time_steps(fb, pfb, ofb, fb.shard_batch(ids))
 print(json.dumps({"dp8_step_s": round(t_dp, 4),
                   "pp4dp2_step_s": round(t_gp, 4),
-                  "gpipe_vs_dp": round(t_gp / t_dp, 2)}))
+                  "gpipe_vs_dp": round(t_gp / t_dp, 2),
+                  "pp4dp2_1f1b_step_s": round(t_fb, 4),
+                  "1f1b_vs_dp": round(t_fb / t_dp, 2),
+                  "1f1b_vs_gpipe": round(t_fb / t_gp, 2)}))
 """
     import os
     env = dict(os.environ)
@@ -1147,10 +1159,11 @@ def main() -> None:
             "note": "single-chip jitted train step (fwd+bwd+AdamW, remat), "
                     "GPT-2 124M bf16; MFU = 6N-per-token model FLOPs vs "
                     "the emitted peak_flops (device-kind bf16 peak; "
-                    "omitted when unknown); gpipe_cpu_mesh = pp4xdp2 GPipe "
-                    "vs pure dp8 step-time ratio on the 8-device virtual "
-                    "CPU mesh (schedule overhead; CPU absolute times are "
-                    "not chip numbers)",
+                    "omitted when unknown); gpipe_cpu_mesh = pp4xdp2 "
+                    "pipeline schedules (GPipe M=4, 1F1B M=8) vs pure dp8 "
+                    "step-time ratios on the 8-device virtual CPU mesh "
+                    "(schedule overhead; CPU absolute times are not chip "
+                    "numbers)",
         }
 
     def cfg11():

@@ -210,6 +210,11 @@ class GPipeTrainStep:
     n_microbatches: int = 4
     remat: bool = False
     boundaries: Optional[Any] = None
+    # "gpipe": AD through the forward schedule (all-fwd-then-all-bwd;
+    # stashes M microbatches). "1f1b": hand-scheduled one-forward-one-
+    # backward (parallel.pipeline_1f1b; stash bounded by min(M, 2S-1) —
+    # raise n_microbatches to shrink the bubble without memory blowup).
+    schedule: str = "gpipe"
 
     def __post_init__(self):
         from ..models import is_stage_partitionable
@@ -222,6 +227,9 @@ class GPipeTrainStep:
                 "(MoETrainStep)")
         if "pp" not in self.mesh.axis_names:
             raise ValueError(f"mesh {self.mesh.axis_names} has no 'pp' axis")
+        if self.schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"schedule={self.schedule!r} not one of ('gpipe', '1f1b')")
         pp = self.mesh.shape["pp"]
         bounds = (list(self.boundaries) if self.boundaries is not None
                   else P_.balanced_boundaries(self.config.n_layer, pp))
@@ -231,15 +239,27 @@ class GPipeTrainStep:
                 f"boundaries {bounds} give {len(self._specs)} stages; the "
                 f"mesh's pp axis has {pp} devices")
         self._equal = len({s.n_blocks for s in self._specs}) == 1
-        # valid mask only materializes for uneven partitions; the equal
+        # valid mask only materializes for unequal partitions; the equal
         # case keeps the mask-free (slightly cheaper) program.
         self._valid = None if self._equal else P_.stage_valid_mask(self._specs)
 
+        if self.schedule == "1f1b":
+            from ..parallel.pipeline_1f1b import one_f_one_b_loss_and_grads
+
+            def loss_and_grads(params, ids):
+                return one_f_one_b_loss_and_grads(
+                    params, ids, self.config, self.mesh,
+                    self.n_microbatches, self._valid)
+        else:
+            def loss_and_grads(params, ids):
+                return jax.value_and_grad(gpipe_lm_loss)(
+                    params, ids, self.config, self.mesh,
+                    self.n_microbatches, self.remat, self._valid)
+
         def step(params, opt_state, ids):
-            loss, grads = jax.value_and_grad(gpipe_lm_loss)(
-                params, ids, self.config, self.mesh, self.n_microbatches,
-                self.remat, self._valid)
-            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            loss, grads = loss_and_grads(params, ids)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss
 

@@ -39,12 +39,19 @@ def setup():
 
 
 def _staggered(ib, jobs):
-    """jobs: list of (prompt, steps, delay, kwargs). Returns results in
-    job order."""
+    """jobs: list of (prompt, steps, trigger, kwargs). ``trigger`` is a
+    fixed delay in seconds, or a callable polled until it returns True
+    (event-driven arrival — immune to how fast the warm compilation
+    cache makes the first batch finish). Returns results in job order."""
     res = [None] * len(jobs)
 
-    def run(i, p, n, delay, kw):
-        time.sleep(delay)
+    def run(i, p, n, trigger, kw):
+        if callable(trigger):
+            deadline = time.monotonic() + 120
+            while not trigger() and time.monotonic() < deadline:
+                time.sleep(0.001)
+        else:
+            time.sleep(trigger)
         res[i] = ib.generate(p, n, **kw)
 
     threads = [threading.Thread(target=run, args=(i, p, n, d, kw))
@@ -56,6 +63,12 @@ def _staggered(ib, jobs):
     return res
 
 
+def _after_segments(ib, base, k):
+    """Trigger: the scheduler has run ``k`` more segments than ``base``
+    — i.e. the head batch is live and mid-decode RIGHT NOW."""
+    return lambda: ib.stats()["segments"] >= base + k
+
+
 def test_mid_decode_join_is_exact_and_within_one_segment(setup):
     """The VERDICT r3 #2 'done' bar: a request arriving mid-decode
     starts within one segment (joins the live batch) and its tokens
@@ -64,11 +77,14 @@ def test_mid_decode_join_is_exact_and_within_one_segment(setup):
     rng = np.random.default_rng(1)
     pA = rng.integers(0, 211, size=(5,))
     pB = rng.integers(0, 211, size=(9,))
-    wantA = engine.generate(pA[None, :], 60).tokens[0]
+    wantA = engine.generate(pA[None, :], 96).tokens[0]
     wantB = engine.generate(pB[None, :], 40).tokens[0]
     before = ib.stats()
+    # B arrives once A's decode is demonstrably mid-flight (event-driven:
+    # a fixed sleep breaks when the warm compile cache makes A fast)
     resA, resB = _staggered(ib, [
-        (pA, 60, 0.0, {}), (pB, 40, 0.8, {})])
+        (pA, 96, 0.0, {}),
+        (pB, 40, _after_segments(ib, before["segments"], 1), {})])
     after = ib.stats()
     np.testing.assert_array_equal(resA.tokens[0], wantA)
     np.testing.assert_array_equal(resB.tokens[0], wantB)
@@ -103,12 +119,13 @@ def test_sampled_joiner_stream_byte_equal_solo(setup):
     pB = rng.integers(0, 211, size=(8,))
     s = SamplingConfig(mode="sample", temperature=0.7, top_k=30)
     kA, kB = jax.random.PRNGKey(11), jax.random.PRNGKey(12)
-    wantA = engine.generate(pA[None, :], 50, sampling=s, key=kA).tokens[0]
+    wantA = engine.generate(pA[None, :], 96, sampling=s, key=kA).tokens[0]
     wantB = engine.generate(pB[None, :], 30, sampling=s, key=kB).tokens[0]
     before = ib.stats()
     resA, resB = _staggered(ib, [
-        (pA, 50, 0.0, dict(sampling=s, key=kA)),
-        (pB, 30, 0.8, dict(sampling=s, key=kB))])
+        (pA, 96, 0.0, dict(sampling=s, key=kA)),
+        (pB, 30, _after_segments(ib, before["segments"], 1),
+         dict(sampling=s, key=kB))])
     after = ib.stats()
     np.testing.assert_array_equal(resA.tokens[0], wantA)
     np.testing.assert_array_equal(resB.tokens[0], wantB)
@@ -375,12 +392,18 @@ def test_admit_failure_delivers_error_to_popped_request():
     IterBatchingEngine._admit_one = boom
     try:
         rng = np.random.default_rng(1)
-        jobs = [(rng.integers(0, 211, size=(5,)), 48, 0.0, {}),
-                (rng.integers(0, 211, size=(6,)), 8, 0.5, {})]
+        jobs = [(rng.integers(0, 211, size=(5,)), 120, 0.0, {}),
+                (rng.integers(0, 211, size=(6,)), 8,
+                 _after_segments(ib, ib.stats()["segments"], 1), {})]
         out = [None] * 2
 
-        def run(i, p, n, delay, kw):
-            time.sleep(delay)
+        def run(i, p, n, trigger, kw):
+            if callable(trigger):
+                deadline = time.monotonic() + 120
+                while not trigger() and time.monotonic() < deadline:
+                    time.sleep(0.001)
+            else:
+                time.sleep(trigger)
             try:
                 out[i] = ("ok", ib.generate(p, n, **kw))
             except Exception as e:  # noqa: BLE001
