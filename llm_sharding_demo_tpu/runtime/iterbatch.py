@@ -35,11 +35,18 @@ the next batch, preserving FIFO. MoE is refused: its routing is not
 window-independent (``models.is_window_independent``), so a row's
 tokens could depend on batch composition.
 
+Batches are RIGHT-SIZED (ADVICE r4): a batch compiles at the smallest
+power-of-two width that fits its seed and grows on demand when an
+arrival finds no free slot — a lone request decodes at width 1 instead
+of paying ``max_batch`` x ghost-row FLOPs. Ghost rows (width minus live
+rows) replicate a real row; per-row independence keeps them inert.
+
 Compiled-program inventory (bounded): the engine's prefill programs
 (prompt-bucketed), ONE decode-segment program per (window bucket,
-sampling) at the fixed batch width and segment length (plus cache-tail
-remainders, quantized by construction), and one admit program (slot and
-roll are traced scalars).
+sampling, power-of-two batch width up to ``max_batch``) and segment
+length (plus cache-tail remainders, quantized by construction), one
+admit program per width, and one tiny grow program per adjacent width
+pair.
 """
 
 from __future__ import annotations
@@ -60,6 +67,13 @@ from ..utils.metrics import REGISTRY
 from .batcher import _round_up
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
                      select_token)
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
 
 
 @dataclasses.dataclass
@@ -207,6 +221,7 @@ class IterBatchingEngine:
         self.joins = 0                # admissions into a LIVE batch
         self.segments_run = 0
         self.eos_retires = 0
+        self.grows = 0                # width upgrades of a live batch
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
 
@@ -263,7 +278,7 @@ class IterBatchingEngine:
         with self._stats_lock:
             return {"batches": self.batches_run, "rows": self.rows_served,
                     "joins": self.joins, "segments": self.segments_run,
-                    "eos_retires": self.eos_retires}
+                    "eos_retires": self.eos_retires, "grows": self.grows}
 
     # -- worker side ---------------------------------------------------------
 
@@ -339,7 +354,12 @@ class IterBatchingEngine:
         eng = self.engine
         s_max = self._seed_smax(seed)
 
-        b = self.max_batch
+        # Right-size the compiled width (ADVICE r4: a lone request must
+        # not pay max_batch x prefill/decode FLOPs for ghost rows): the
+        # batch runs at the next power of two that fits the seed, and
+        # _admit grows it on demand. Width set = {1, 2, 4, ..,
+        # max_batch} — a bounded extra-program inventory.
+        b = min(_next_pow2(len(seed)), self.max_batch)
         ids = np.zeros((b, s_max), dtype=np.int32)
         pad = np.zeros((b,), dtype=np.int32)
         for i in range(b):
@@ -402,36 +422,68 @@ class IterBatchingEngine:
         an incompatible head closes admission for this batch and seeds
         the next one). A request parked in ``_pending`` (by ``_seed`` or
         a previous round) is ALWAYS the head — it is reconsidered first
-        and never overwritten, so no request can be dropped."""
+        and never overwritten, so no request can be dropped.  When the
+        right-sized batch has no free slot but is narrower than
+        ``max_batch``, the live batch GROWS to the next power of two
+        (ghost rows replicate row 0; per-row exactness makes them
+        inert) instead of turning the arrival away."""
         while True:
-            free = [i for i, s in enumerate(state.slots) if s is None]
-            if not free:
-                return
-            if self._pending is not None:
-                req = self._pending
-                if req.cancelled.is_set():
-                    self._pending = None
-                    continue
-                if not self._compatible(state, req):
-                    state.closed = True
-                    return
-                self._pending = None
-            else:
+            if self._pending is None:
                 try:
-                    req = self._queue.get_nowait()
+                    self._pending = self._queue.get_nowait()
                 except queue.Empty:
                     return
-                if req.cancelled.is_set():
-                    continue
-                if not self._compatible(state, req):
-                    self._pending = req
-                    state.closed = True
-                    return
+            req = self._pending
+            if req.cancelled.is_set():
+                self._pending = None
+                continue
+            if not self._compatible(state, req):
+                state.closed = True  # req stays parked as the FIFO head
+                return
+            free = [i for i, s in enumerate(state.slots) if s is None]
+            if not free:
+                if len(state.slots) >= self.max_batch:
+                    return  # full batch: req stays parked, retried at
+                    # the next segment boundary (a slot may retire)
+                self._grow(state)
+                free = [i for i, s in enumerate(state.slots) if s is None]
+            self._pending = None
             try:
                 self._admit_one(state, req, free[0])
             except Exception as e:  # noqa: BLE001 — the popped request is
                 req.fail(e)        # not in state.slots yet; without this
                 raise              # its caller would block forever
+
+    def _grow(self, state: _BatchState):
+        """Widen the live batch to the next power of two: pad token /
+        pad_j / cache along the batch axis by replicating row 0 (any
+        live content is valid ghost material — rows are independent).
+        One tiny concat program per (width, cache-shape) pair, from the
+        same bounded width set as the decode programs."""
+        old = len(state.slots)
+        new = min(_next_pow2(old + 1), self.max_batch)
+        pad_rows = new - old
+
+        def rep(x, axis):
+            return jnp.concatenate(
+                [x, jnp.repeat(jax.lax.slice_in_dim(x, 0, 1, axis=axis),
+                               pad_rows, axis=axis)], axis=axis)
+
+        def grow_cache(c):
+            def one(kc: KVCache) -> KVCache:
+                v = kc.v if getattr(kc.v, "ndim", 0) <= 1 else rep(kc.v, 1)
+                return KVCache(k=rep(kc.k, 1), v=v, length=kc.length)
+            if isinstance(c, list):
+                return [one(x) for x in c]
+            return one(c)
+
+        state.token = rep(state.token, 0)
+        state.pad_j = rep(state.pad_j, 0)
+        state.cache = grow_cache(state.cache)
+        state.slots = state.slots + [None] * pad_rows
+        with self._stats_lock:
+            self.grows += 1
+        REGISTRY.inc("iter_grows_total")
 
     def _admit_one(self, state: _BatchState, req: _Req, slot: int):
         eng = self.engine

@@ -48,3 +48,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def pytest_sessionstart(session):
     n = len(jax.devices())
     assert n == 8, f"expected 8 forced host devices, got {n}"
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Bound in-process XLA state: the full suite compiles hundreds of
+    CPU programs in one interpreter, and past ~the-whole-suite volume
+    XLA:CPU segfaulted inside a later compile (reproduced twice at ~99%
+    in jax compiler.py backend_compile_and_load). Dropping executables
+    between modules keeps the live-program population at
+    one-module-scale; the persistent compilation cache makes any
+    cross-module recompiles cheap loads."""
+    yield
+    jax.clear_caches()

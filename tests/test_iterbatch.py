@@ -445,3 +445,32 @@ def test_timeout_cancels_request_and_frees_slot():
     assert res.new_tokens == 8
     # the timed-out request must not be counted as served
     assert ib.stats()["rows"] == 1
+
+
+def test_right_sized_width_grows_on_join():
+    """ADVICE r4: a lone request runs at width 1 (no ghost-row FLOPs —
+    zero grows, zero joins); a mid-decode arrival grows the live batch
+    instead of waiting, and both streams stay exact."""
+    _, _, engine = _setup()
+    ib = IterBatchingEngine(engine, max_batch=4, seg_steps=8,
+                            max_wait_ms=5.0)
+    rng = np.random.default_rng(21)
+    p1 = rng.integers(0, 211, size=(5,))
+    want1 = engine.generate(p1[None, :], 24).tokens[0]
+    res1 = ib.generate(p1, 24)
+    np.testing.assert_array_equal(res1.tokens[0], want1)
+    solo = ib.stats()
+    assert solo["grows"] == 0 and solo["joins"] == 0
+
+    pA = rng.integers(0, 211, size=(5,))
+    pB = rng.integers(0, 211, size=(7,))
+    wantA = engine.generate(pA[None, :], 96).tokens[0]
+    wantB = engine.generate(pB[None, :], 30).tokens[0]
+    resA, resB = _staggered(ib, [
+        (pA, 96, 0.0, {}),
+        (pB, 30, _after_segments(ib, solo["segments"], 1), {})])
+    after = ib.stats()
+    np.testing.assert_array_equal(resA.tokens[0], wantA)
+    np.testing.assert_array_equal(resB.tokens[0], wantB)
+    assert after["joins"] - solo["joins"] >= 1     # joined the live batch
+    assert after["grows"] - solo["grows"] >= 1     # ...by growing width
