@@ -793,12 +793,19 @@ fb = train.GPipeTrainStep(cfg, train.adamw(1e-3), gp_mesh, n_microbatches=8,
                           schedule="1f1b")
 pfb, ofb = fb.init(params)
 t_fb = time_steps(fb, pfb, ofb, fb.shard_batch(ids))
+
+iv = train.GPipeTrainStep(cfg, train.adamw(1e-3), gp_mesh, n_microbatches=8,
+                          schedule="1f1b", virtual_stages=2)
+piv, oiv = iv.init(params)
+t_iv = time_steps(iv, piv, oiv, iv.shard_batch(ids))
 print(json.dumps({"dp8_step_s": round(t_dp, 4),
                   "pp4dp2_step_s": round(t_gp, 4),
                   "gpipe_vs_dp": round(t_gp / t_dp, 2),
                   "pp4dp2_1f1b_step_s": round(t_fb, 4),
                   "1f1b_vs_dp": round(t_fb / t_dp, 2),
-                  "1f1b_vs_gpipe": round(t_fb / t_gp, 2)}))
+                  "1f1b_vs_gpipe": round(t_fb / t_gp, 2),
+                  "pp4dp2_1f1b_v2_step_s": round(t_iv, 4),
+                  "1f1b_interleaved_v2_vs_dp": round(t_iv / t_dp, 2)}))
 """
     import os
     env = dict(os.environ)

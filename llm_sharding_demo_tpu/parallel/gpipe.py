@@ -188,15 +188,16 @@ def _compiled_pipeline(mesh: Mesh, config: GPT2Config, pp_axis: str,
 
 
 def stacked_block_pspecs(mesh: Mesh, pp_axis: str = "pp",
-                         llama: bool = False) -> Params:
+                         llama: bool = False, n_lead: int = 1) -> Params:
     """PartitionSpecs for stage-major stacked blocks: stage axis on ``pp``,
     plus the Megatron tp layout (shifted one axis right of
     ``spmd.param_pspecs`` / ``spmd.llama_param_pspecs`` because of the
-    extra leading stage axis)."""
+    extra leading stage axis). ``n_lead=2`` covers the interleaved
+    ``[S, v, per_chunk, ...]`` layout (an extra unsharded chunk axis)."""
     tp = "tp" if "tp" in mesh.axis_names else None
 
     def s(*tail):
-        return P(pp_axis, None, *tail)
+        return P(pp_axis, *([None] * n_lead), *tail)
 
     if llama:
         return {
@@ -229,13 +230,14 @@ def stacked_block_pspecs(mesh: Mesh, pp_axis: str = "pp",
 
 
 def shard_stacked_blocks(stacked: Params, mesh: Mesh, pp_axis: str = "pp",
-                         config=None) -> Params:
+                         config=None, n_lead: int = 1) -> Params:
     """Place stage-major stacked blocks on the mesh; the family's pspec
     table is chosen from ``config`` (GPT-2 layout when None, for
     pre-llama callers)."""
     from ..models.llama import LlamaConfig
     specs = stacked_block_pspecs(mesh, pp_axis,
-                                 llama=isinstance(config, LlamaConfig))
+                                 llama=isinstance(config, LlamaConfig),
+                                 n_lead=n_lead)
     return jax.tree_util.tree_map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         stacked, specs)

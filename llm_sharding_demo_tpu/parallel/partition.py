@@ -275,6 +275,30 @@ def stack_stage_params(params: Params, specs: Sequence[StageSpec]) -> Params:
     return jax.tree_util.tree_map(reshape, params["blocks"])
 
 
+def stack_virtual_chunks(params: Params, n_stages: int,
+                         n_virtual: int) -> Params:
+    """Interleaved-1F1B re-layout: ``[L, ...]`` block leaves ->
+    ``[n_stages, n_virtual, per_chunk, ...]`` with virtual chunk
+    ``g = j * n_stages + d`` stored at ``[d, j]`` — device d owns every
+    S-th chunk (the Megatron interleaved assignment), so one shard_map
+    over the pp axis hands each device its ``[n_virtual, per_chunk,
+    ...]`` slice. Requires ``L % (n_stages * n_virtual) == 0``.
+    """
+    def reshape(x):
+        n_layer = x.shape[0]
+        total = n_stages * n_virtual
+        if n_layer % total:
+            raise ValueError(
+                f"interleaved stacking needs n_layer divisible by "
+                f"pp * virtual_stages = {total}, got {n_layer}")
+        per = n_layer // total
+        # [L] in chunk-major order = [j, d, per]; devices want [d, j, per]
+        return x.reshape((n_virtual, n_stages, per)
+                         + x.shape[1:]).swapaxes(0, 1)
+
+    return jax.tree_util.tree_map(reshape, params["blocks"])
+
+
 def unstack_stage_params(stacked_blocks: Params) -> Params:
     """Inverse of ``stack_stage_params``: ``[S, per, ...]`` -> ``[L, ...]``."""
     def reshape(x):

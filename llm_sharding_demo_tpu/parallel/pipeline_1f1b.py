@@ -55,20 +55,37 @@ def one_f_one_b_loss_and_grads(params: Params, ids: jnp.ndarray,
                                config: GPT2Config, mesh: Mesh,
                                n_microbatches: int,
                                valid: Optional[jnp.ndarray] = None,
-                               pp_axis: str = "pp"):
+                               pp_axis: str = "pp",
+                               virtual_stages: int = 1):
     """LM loss + grads with blocks run under the 1F1B schedule.
 
     ``params`` uses the gpipe layout (``GPipeTrainStep.init``): family
     embed/head leaves replicated + ``stacked_blocks`` stage-major over
-    ``pp``. ``ids`` [B, S]; B must divide by ``n_microbatches``.
-    Returns ``(loss, grads)`` with ``grads`` shaped exactly like
-    ``params``.
+    ``pp`` (``[S, per, ...]`` for ``virtual_stages=1``, the interleaved
+    ``[S, v, per_chunk, ...]`` layout otherwise). ``ids`` [B, S]; B must
+    divide by ``n_microbatches``. Returns ``(loss, grads)`` with
+    ``grads`` shaped exactly like ``params``.
+
+    ``virtual_stages=v > 1`` selects INTERLEAVED 1F1B (Megatron-style):
+    each device owns every S-th chunk of layers, so a microbatch makes v
+    ring trips and the warm-up/drain bubble shrinks from ``(S-1)/M``
+    fractions toward ``(S-1)/(vM)`` at the cost of v x ppermute volume
+    and a v x wider stash.  CAVEAT: the bubble win needs the per-core
+    ``lax.cond`` skip, which tp/sp meshes disable (collectives inside
+    blocks); there the masked path computes every chunk every tick and
+    interleaving only ADDS ticks (M + 2vS - 2 full-work ticks) — keep
+    ``virtual_stages=1`` on tp/sp meshes.
     """
     if pp_axis not in mesh.axis_names:
         raise ValueError(f"mesh has no {pp_axis!r} axis: {mesh.axis_names}")
+    if virtual_stages > 1 and valid is not None:
+        raise NotImplementedError(
+            "interleaved 1F1B requires equal chunks (n_layer divisible "
+            "by pp * virtual_stages); uneven boundaries are a "
+            "virtual_stages=1 feature")
     ids_m = microbatch(jnp.asarray(ids, jnp.int32), n_microbatches)
     fn = _compiled_1f1b(mesh, config, pp_axis, n_microbatches,
-                        valid is not None)
+                        valid is not None, virtual_stages)
     if valid is None:
         return fn(params, ids_m)
     valid = jax.device_put(valid, NamedSharding(mesh, P(pp_axis)))
@@ -77,7 +94,7 @@ def one_f_one_b_loss_and_grads(params: Params, ids: jnp.ndarray,
 
 @functools.lru_cache(maxsize=64)
 def _compiled_1f1b(mesh: Mesh, config: GPT2Config, pp_axis: str,
-                   n_micro: int, has_valid: bool):
+                   n_micro: int, has_valid: bool, n_virtual: int = 1):
     """Build + jit the 1F1B program once per (mesh, config, schedule).
 
     Same caching rationale as ``gpipe._compiled_pipeline``: jit keys on
@@ -86,12 +103,13 @@ def _compiled_1f1b(mesh: Mesh, config: GPT2Config, pp_axis: str,
     train step's outer jit.
     """
     n_stages = mesh.shape[pp_axis]
-    n_ticks = n_micro + 2 * n_stages - 2
-    # stash depth: in-flight microbatches at stage s are those with
-    # s + m <= t < m + 2(S-1) - s + 1, at most 2(S-1-s)+1 <= 2S-1; one
-    # extra trash slot absorbs writes on inactive ticks (cheaper than a
-    # predicated full-buffer select).
-    k_stash = min(n_micro, 2 * n_stages - 1)
+    vs_total = n_virtual * n_stages     # virtual pipeline depth
+    n_ticks = n_micro + 2 * vs_total - 2
+    # stash depth (per chunk): in-flight microbatches at virtual stage
+    # vs are those with vs + m <= t < m + 2(VS-1) - vs + 1, at most
+    # 2(VS-1-vs)+1 <= 2VS-1; one extra trash slot absorbs writes on
+    # inactive ticks (cheaper than a predicated full-buffer select).
+    k_stash = min(n_micro, 2 * vs_total - 1)
 
     from ..models.llama import LlamaConfig
     is_llama = isinstance(config, LlamaConfig)
@@ -145,8 +163,14 @@ def _compiled_1f1b(mesh: Mesh, config: GPT2Config, pp_axis: str,
     head_keys = ("ln_f", "lm_head") if is_llama else ("ln_f", "wte")
 
     def per_stage(blocks_local, valid_local, emb, head, ids_m):
+        # local layout: [1, v, per_chunk, ...] -> per-chunk trees; chunk
+        # j on device d is virtual stage j*S + d (interleaved; v=1 is
+        # the flat schedule)
         blocks_local = jax.tree_util.tree_map(lambda x: x[0], blocks_local)
-        valid_row = None if valid_local is None else valid_local[0]
+        chunks = [jax.tree_util.tree_map(lambda x, j=j: x[j], blocks_local)
+                  for j in range(n_virtual)]
+        valid_rows = (None if valid_local is None
+                      else [valid_local[0][j] for j in range(n_virtual)])
         stage = jax.lax.axis_index(pp_axis)
         is_first = stage == 0
         is_last = stage == n_stages - 1
@@ -178,123 +202,172 @@ def _compiled_1f1b(mesh: Mesh, config: GPT2Config, pp_axis: str,
         # psum at the end does the cross-stage reduction once.
         head_v = vary(head)
 
-        def fwd_of(x):
-            return run_blocks(blocks_local, x, valid_row)
-
-        def bwd_of(x, dy):
-            _, vjp = jax.vjp(
-                lambda bl, xx: run_blocks(bl, xx, valid_row),
-                blocks_local, x)
-            return vjp(dy)
-
         def head_grads_of(y, tgt):
             (loss_m, (dhead, dy)) = jax.value_and_grad(
                 head_loss, argnums=(0, 1))(head_v, y, tgt)
             return loss_m, dhead, dy
 
-        zero_gb = jax.tree_util.tree_map(jnp.zeros_like, blocks_local)
+        zero_gb = [jax.tree_util.tree_map(jnp.zeros_like, c)
+                   for c in chunks]
         zero_gh = jax.tree_util.tree_map(jnp.zeros_like, head_v)
         zero_ge = jax.tree_util.tree_map(jnp.zeros_like, emb)
 
         init = vary(dict(
-            fwd_in=act,
-            bwd_in=act,
-            stash=jnp.zeros((k_stash + 1, mb, s_in, d), jnp.float32),
+            fwd_in=[act] * n_virtual,
+            bwd_in=[act] * n_virtual,
+            stash=[jnp.zeros((k_stash + 1, mb, s_in, d), jnp.float32)
+                   for _ in range(n_virtual)],
             gb=zero_gb,
             gh=zero_gh,
             ge=zero_ge,
             loss=jnp.float32(0.0),
         ))
 
+        # v=1 keeps OPEN chains (no wrap edges): the wrapped payloads are
+        # always discarded there (embed/dy_last overrides), so the two
+        # wrap transfers per tick would be pure dead traffic. v>1 needs
+        # the full ring — the wrap carries chunk j to chunk j+1.
+        if n_virtual == 1:
+            fwd_ring = [(i, i + 1) for i in range(n_stages - 1)]
+            bwd_ring = [(i, i - 1) for i in range(1, n_stages)]
+        else:
+            fwd_ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            bwd_ring = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
         def tick(carry, t):
-            m_f = t - stage                        # forward microbatch
-            m_b = t - (2 * (n_stages - 1) - stage)  # backward microbatch
-            act_f = (m_f >= 0) & (m_f < n_micro)
-            act_b = (m_b >= 0) & (m_b < n_micro)
-            mf_c = jnp.clip(m_f, 0, n_micro - 1)
-            mb_c = jnp.clip(m_b, 0, n_micro - 1)
+            stash = list(carry["stash"])
+            gb = list(carry["gb"])
+            gh, ge, loss_acc = carry["gh"], carry["ge"], carry["loss"]
+            ys, dxs = [], []
 
-            ids_f = jax.lax.dynamic_index_in_dim(ids_m, mf_c, 0,
-                                                 keepdims=False)
-            ids_b = jax.lax.dynamic_index_in_dim(ids_m, mb_c, 0,
-                                                 keepdims=False)
+            for j in range(n_virtual):
+                bl_j, valid_j = chunks[j], (None if valid_rows is None
+                                            else valid_rows[j])
 
-            # ---- forward slot -------------------------------------------
-            x = jnp.where(is_first, embed_fwd(emb, ids_f[:, :-1]),
-                          carry["fwd_in"])
-            if can_cond:
-                y = jax.lax.cond(act_f, fwd_of, lambda x: x, x)
-            else:
-                y = fwd_of(x)
-            # stash this stage's input; inactive ticks write the trash slot
-            slot = jnp.where(act_f, mf_c % k_stash, k_stash)
-            stash = jax.lax.dynamic_update_index_in_dim(
-                carry["stash"], x, slot, axis=0)
+                def fwd_of(x, bl_j=bl_j, valid_j=valid_j):
+                    return run_blocks(bl_j, x, valid_j)
 
-            # last stage: per-microbatch loss + its cotangent, SAME tick
-            last_work = is_last & act_f
-            if can_cond:
-                # both branches are naturally pp-varying now: grads flow
-                # wrt head_v (varying), zeros derive from varying trees
-                loss_m, dhead, dy_last = jax.lax.cond(
-                    last_work,
-                    lambda y, tgt: head_grads_of(y, tgt),
-                    lambda y, tgt: (vary(jnp.float32(0.0)), zero_gh,
-                                    jnp.zeros_like(y)),
-                    y, ids_f[:, 1:])
-            else:
-                loss_m, dhead, dy_last = head_grads_of(y, ids_f[:, 1:])
-                loss_m = jnp.where(last_work, loss_m, 0.0)
-                dhead = jax.tree_util.tree_map(
-                    lambda g: jnp.where(last_work, g, 0.0), dhead)
-                dy_last = jnp.where(last_work, dy_last, 0.0)
+                def bwd_of(x, dy, bl_j=bl_j, valid_j=valid_j):
+                    _, vjp = jax.vjp(
+                        lambda bl, xx: run_blocks(bl, xx, valid_j),
+                        bl_j, x)
+                    return vjp(dy)
 
-            # ---- backward slot ------------------------------------------
-            xb = jax.lax.dynamic_index_in_dim(stash, mb_c % k_stash, 0,
-                                              keepdims=False)
-            dy = jnp.where(is_last, dy_last, carry["bwd_in"])
-            if can_cond:
-                dbl, dx = jax.lax.cond(
-                    act_b, bwd_of,
-                    lambda x, dy: vary((zero_gb, jnp.zeros_like(x))), xb, dy)
-            else:
-                dbl, dx = bwd_of(xb, dy)
-                dbl = jax.tree_util.tree_map(
-                    lambda g: jnp.where(act_b, g, 0.0), dbl)
-                dx = jnp.where(act_b, dx, 0.0)
+                vs = j * n_stages + stage          # virtual stage index
+                m_f = t - vs                       # forward microbatch
+                m_b = t - (2 * (vs_total - 1) - vs)  # backward microbatch
+                act_f = (m_f >= 0) & (m_f < n_micro)
+                act_b = (m_b >= 0) & (m_b < n_micro)
+                mf_c = jnp.clip(m_f, 0, n_micro - 1)
+                mb_c = jnp.clip(m_b, 0, n_micro - 1)
+                ids_f = jax.lax.dynamic_index_in_dim(ids_m, mf_c, 0,
+                                                     keepdims=False)
+                ids_b = jax.lax.dynamic_index_in_dim(ids_m, mb_c, 0,
+                                                     keepdims=False)
 
-            # stage 0 pushes its input cotangent into the embedding grads
-            first_work = is_first & act_b
-            if can_cond:
-                demb = jax.lax.cond(
-                    first_work,
-                    lambda ids_in, dx: vary(embed_bwd(emb, ids_in, dx)),
-                    lambda ids_in, dx: vary(zero_ge), ids_b[:, :-1], dx)
-            else:
-                demb = embed_bwd(emb, ids_b[:, :-1], dx)
-                demb = jax.tree_util.tree_map(
-                    lambda g: jnp.where(first_work, g, 0.0), demb)
+                # ---- forward slot ---------------------------------------
+                x = carry["fwd_in"][j]
+                if j == 0:  # only virtual stage 0 embeds fresh input
+                    x = jnp.where(is_first,
+                                  embed_fwd(emb, ids_f[:, :-1]), x)
+                if can_cond:
+                    y = jax.lax.cond(act_f, fwd_of, lambda x: x, x)
+                else:
+                    y = fwd_of(x)
+                # stash this chunk's input; inactive ticks hit the trash
+                # slot
+                slot = jnp.where(act_f, mf_c % k_stash, k_stash)
+                stash[j] = jax.lax.dynamic_update_index_in_dim(
+                    stash[j], x, slot, axis=0)
+
+                # final virtual stage: per-microbatch loss + cotangent,
+                # SAME tick
+                if j == n_virtual - 1:
+                    last_work = is_last & act_f
+                    if can_cond:
+                        loss_m, dhead, dy_last = jax.lax.cond(
+                            last_work,
+                            lambda y, tgt: head_grads_of(y, tgt),
+                            lambda y, tgt: (vary(jnp.float32(0.0)),
+                                            zero_gh, jnp.zeros_like(y)),
+                            y, ids_f[:, 1:])
+                    else:
+                        loss_m, dhead, dy_last = head_grads_of(
+                            y, ids_f[:, 1:])
+                        loss_m = jnp.where(last_work, loss_m, 0.0)
+                        dhead = jax.tree_util.tree_map(
+                            lambda g: jnp.where(last_work, g, 0.0), dhead)
+                        dy_last = jnp.where(last_work, dy_last, 0.0)
+                    loss_acc = loss_acc + loss_m
+                    gh = jax.tree_util.tree_map(jnp.add, gh, dhead)
+
+                # ---- backward slot --------------------------------------
+                xb = jax.lax.dynamic_index_in_dim(
+                    stash[j], mb_c % k_stash, 0, keepdims=False)
+                dy = carry["bwd_in"][j]
+                if j == n_virtual - 1:
+                    dy = jnp.where(is_last, dy_last, dy)
+                if can_cond:
+                    dbl, dx = jax.lax.cond(
+                        act_b, bwd_of,
+                        lambda x, dy, j=j: vary((zero_gb[j],
+                                                 jnp.zeros_like(x))),
+                        xb, dy)
+                else:
+                    dbl, dx = bwd_of(xb, dy)
+                    dbl = jax.tree_util.tree_map(
+                        lambda g: jnp.where(act_b, g, 0.0), dbl)
+                    dx = jnp.where(act_b, dx, 0.0)
+                gb[j] = jax.tree_util.tree_map(jnp.add, gb[j], dbl)
+
+                # virtual stage 0 pushes its input cotangent into the
+                # embedding grads
+                if j == 0:
+                    first_work = is_first & act_b
+                    if can_cond:
+                        demb = jax.lax.cond(
+                            first_work,
+                            lambda ids_in, dx: vary(
+                                embed_bwd(emb, ids_in, dx)),
+                            lambda ids_in, dx: vary(zero_ge),
+                            ids_b[:, :-1], dx)
+                    else:
+                        demb = embed_bwd(emb, ids_b[:, :-1], dx)
+                        demb = jax.tree_util.tree_map(
+                            lambda g: jnp.where(first_work, g, 0.0), demb)
+                    ge = jax.tree_util.tree_map(jnp.add, ge, demb)
+
+                ys.append(y)
+                dxs.append(dx)
 
             # ---- ship activations down, cotangents up -------------------
-            fwd_in = jax.lax.ppermute(
-                y, pp_axis, [(j, j + 1) for j in range(n_stages - 1)])
-            bwd_in = jax.lax.ppermute(
-                dx, pp_axis, [(j, j - 1) for j in range(1, n_stages)])
+            # Full rings (wrap included): chunk j's output feeds virtual
+            # stage j*S+d+1 — device d+1's chunk j, except the wrap from
+            # device S-1 to device 0's chunk j+1, handled by the roll
+            # below. Device 0's chunk-0 slot receives the discarded
+            # VS-1 wrap (embed overrides it at use time); mirrored for
+            # cotangents, where the head cotangent overrides the last
+            # device's chunk v-1 slot.
+            recv_f = [jax.lax.ppermute(y, pp_axis, fwd_ring) for y in ys]
+            recv_b = [jax.lax.ppermute(dx, pp_axis, bwd_ring)
+                      for dx in dxs]
+            fwd_in = [jnp.where(is_first, recv_f[(j - 1) % n_virtual],
+                                recv_f[j]) for j in range(n_virtual)]
+            bwd_in = [jnp.where(is_last, recv_b[(j + 1) % n_virtual],
+                                recv_b[j]) for j in range(n_virtual)]
 
-            carry = dict(
-                fwd_in=fwd_in, bwd_in=bwd_in, stash=stash,
-                gb=jax.tree_util.tree_map(jnp.add, carry["gb"], dbl),
-                gh=jax.tree_util.tree_map(jnp.add, carry["gh"], dhead),
-                ge=jax.tree_util.tree_map(jnp.add, carry["ge"], demb),
-                loss=carry["loss"] + loss_m,
-            )
+            carry = dict(fwd_in=fwd_in, bwd_in=bwd_in, stash=stash,
+                         gb=gb, gh=gh, ge=ge, loss=loss_acc)
             return carry, None
 
         final, _ = jax.lax.scan(tick, init, jnp.arange(n_ticks))
 
         inv_m = 1.0 / n_micro
         loss = jax.lax.psum(final["loss"] * inv_m, pp_axis)
-        gb = jax.tree_util.tree_map(lambda g: (g * inv_m)[None], final["gb"])
+        # [v][per_chunk, ...] trees -> one [1, v, per_chunk, ...] tree
+        # (leading axis restored for the P(pp) out_spec)
+        gb = jax.tree_util.tree_map(
+            lambda *gs: (jnp.stack(gs) * inv_m)[None], *final["gb"])
         gh = jax.tree_util.tree_map(
             lambda g: jax.lax.psum(g * inv_m, pp_axis), final["gh"])
         ge = jax.tree_util.tree_map(
@@ -304,6 +377,13 @@ def _compiled_1f1b(mesh: Mesh, config: GPT2Config, pp_axis: str,
     def wrapped(params, valid, ids_m):
         emb = {k: params[k] for k in emb_keys}
         head = {k: params[k] for k in head_keys}
+        blocks = params["stacked_blocks"]
+        if n_virtual == 1:
+            # legacy flat layout [S, per, ...] <-> internal [S, 1, per,
+            # ...]; grads are squeezed back so the tree matches params
+            blocks = jax.tree_util.tree_map(lambda x: x[:, None], blocks)
+            if valid is not None:
+                valid = valid[:, None]
         run = jax.shard_map(
             per_stage if has_valid else
             (lambda b, e, h, i: per_stage(b, None, e, h, i)),
@@ -312,10 +392,11 @@ def _compiled_1f1b(mesh: Mesh, config: GPT2Config, pp_axis: str,
                       if has_valid else (P(pp_axis), P(), P(), P())),
             out_specs=(P(), P(pp_axis), P(), P()),
             axis_names={pp_axis})
-        args = ((params["stacked_blocks"], valid, emb, head, ids_m)
-                if has_valid else
-                (params["stacked_blocks"], emb, head, ids_m))
+        args = ((blocks, valid, emb, head, ids_m) if has_valid
+                else (blocks, emb, head, ids_m))
         loss, gb, gh, ge = run(*args)
+        if n_virtual == 1:
+            gb = jax.tree_util.tree_map(lambda x: x[:, 0], gb)
         grads = {"stacked_blocks": gb}
         for k in emb_keys:
             grads[k] = ge[k]

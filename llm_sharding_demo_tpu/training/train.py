@@ -215,6 +215,13 @@ class GPipeTrainStep:
     # backward (parallel.pipeline_1f1b; stash bounded by min(M, 2S-1) —
     # raise n_microbatches to shrink the bubble without memory blowup).
     schedule: str = "gpipe"
+    # >1 selects INTERLEAVED 1F1B: each device owns every pp-th chunk of
+    # layers (Megatron virtual stages), shrinking the bubble by the
+    # interleave factor at the cost of v x ring traffic. Requires
+    # schedule="1f1b", default boundaries, n_layer % (pp * v) == 0.
+    # On tp/sp meshes the bubble skip is disabled (collectives inside
+    # blocks) and interleaving only adds ticks — keep v=1 there.
+    virtual_stages: int = 1
 
     def __post_init__(self):
         from ..models import is_stage_partitionable
@@ -231,6 +238,22 @@ class GPipeTrainStep:
             raise ValueError(
                 f"schedule={self.schedule!r} not one of ('gpipe', '1f1b')")
         pp = self.mesh.shape["pp"]
+        if self.virtual_stages < 1:
+            raise ValueError(
+                f"virtual_stages={self.virtual_stages} must be >= 1")
+        if self.virtual_stages > 1:
+            if self.schedule != "1f1b":
+                raise ValueError(
+                    "virtual_stages > 1 (interleaved scheduling) "
+                    "requires schedule='1f1b'")
+            if self.boundaries is not None:
+                raise ValueError(
+                    "interleaved 1F1B uses equal chunks; explicit "
+                    "boundaries are a virtual_stages=1 feature")
+            if self.config.n_layer % (pp * self.virtual_stages):
+                raise ValueError(
+                    f"n_layer={self.config.n_layer} must divide by "
+                    f"pp * virtual_stages = {pp * self.virtual_stages}")
         bounds = (list(self.boundaries) if self.boundaries is not None
                   else P_.balanced_boundaries(self.config.n_layer, pp))
         self._specs = P_.make_stage_specs(self.config.n_layer, bounds)
@@ -249,7 +272,8 @@ class GPipeTrainStep:
             def loss_and_grads(params, ids):
                 return one_f_one_b_loss_and_grads(
                     params, ids, self.config, self.mesh,
-                    self.n_microbatches, self._valid)
+                    self.n_microbatches, self._valid,
+                    virtual_stages=self.virtual_stages)
         else:
             def loss_and_grads(params, ids):
                 return jax.value_and_grad(gpipe_lm_loss)(
@@ -269,10 +293,18 @@ class GPipeTrainStep:
     def init(self, params: Params):
         from ..parallel import gpipe, partition as P_
 
-        if self._equal:
+        if self.virtual_stages > 1:
+            # interleaved layout [S, v, per_chunk, ...]: device d owns
+            # every S-th chunk (chunk j*S + d at [d, j])
+            stacked = P_.stack_virtual_chunks(
+                params, self.mesh.shape["pp"], self.virtual_stages)
+            n_lead = 2
+        elif self._equal:
             stacked = P_.stack_stage_params(params, self._specs)
+            n_lead = 1
         else:
             stacked, _ = P_.stack_stage_params_padded(params, self._specs)
+            n_lead = 1
         # embed/head params run under plain GSPMD outside the manual
         # program; which ones exist depends on the family tree (llama:
         # untied lm_head, no wpe)
@@ -282,7 +314,7 @@ class GPipeTrainStep:
             for k in ("wte", "wpe", "ln_f", "lm_head") if k in params
         }
         gp_params["stacked_blocks"] = gpipe.shard_stacked_blocks(
-            stacked, self.mesh, config=self.config)
+            stacked, self.mesh, config=self.config, n_lead=n_lead)
         opt_state = self.optimizer.init(gp_params)
         return gp_params, opt_state
 

@@ -154,3 +154,135 @@ def test_1f1b_grads_match_gpipe_exactly_at_init(gpt2_setup):
         np.testing.assert_allclose(
             np.asarray(g), np.asarray(w), rtol=2e-4, atol=1e-6,
             err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}")
+
+
+def test_interleaved_1f1b_v2_tracks_single_device(gpt2_setup):
+    """Interleaved schedule (virtual_stages=2 on pp2: device d owns
+    chunks d and d+2): trajectory matches the single-device step."""
+    cfg, params, ids, ref = gpt2_setup
+    mesh = spmd.make_mesh({"pp": 2}, jax.devices()[:2])
+    step = train.GPipeTrainStep(cfg, train.adamw(1e-3), mesh,
+                                n_microbatches=4, schedule="1f1b",
+                                virtual_stages=2)
+    p, o = step.init(params)
+    got = []
+    for _ in range(STEPS):
+        p, o, loss = step(p, o, step.shard_batch(ids))
+        got.append(float(loss))
+    _assert_tracks(got, ref, "interleaved 1f1b v2 pp2")
+
+
+def test_interleaved_1f1b_v2_dp_mesh(gpt2_setup):
+    cfg, params, ids, ref = gpt2_setup
+    mesh = spmd.make_mesh({"dp": 2, "pp": 2}, jax.devices()[:4])
+    step = train.GPipeTrainStep(cfg, train.adamw(1e-3), mesh,
+                                n_microbatches=4, schedule="1f1b",
+                                virtual_stages=2)
+    p, o = step.init(params)
+    got = []
+    for _ in range(STEPS):
+        p, o, loss = step(p, o, step.shard_batch(ids))
+        got.append(float(loss))
+    _assert_tracks(got, ref, "interleaved 1f1b v2 dp2 pp2")
+
+
+def test_interleaved_1f1b_llama():
+    cfg = llama.LlamaConfig(vocab_size=128, n_positions=32, n_embd=16,
+                            n_layer=4, n_head=2, n_kv_head=1,
+                            intermediate_size=32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(2))
+    ids = jax.random.randint(jax.random.PRNGKey(3), (8, 17), 0,
+                             cfg.vocab_size, dtype=jnp.int32)
+    ref = _trajectory_single(cfg, params, ids, family="llama")
+    mesh = spmd.make_mesh({"pp": 2}, jax.devices()[:2])
+    step = train.GPipeTrainStep(cfg, train.adamw(1e-3), mesh,
+                                n_microbatches=4, schedule="1f1b",
+                                virtual_stages=2)
+    p, o = step.init(params)
+    got = []
+    for _ in range(STEPS):
+        p, o, loss = step(p, o, step.shard_batch(ids))
+        got.append(float(loss))
+    _assert_tracks(got, ref, "interleaved 1f1b llama v2 pp2")
+
+
+def test_interleaved_validation_gates(gpt2_setup):
+    cfg, params, ids, _ = gpt2_setup
+    mesh = spmd.make_mesh({"pp": 2}, jax.devices()[:2])
+    with pytest.raises(ValueError, match="schedule='1f1b'"):
+        train.GPipeTrainStep(cfg, train.adamw(1e-3), mesh,
+                             virtual_stages=2)  # gpipe + interleave
+    with pytest.raises(ValueError, match="divide"):
+        train.GPipeTrainStep(cfg, train.adamw(1e-3), mesh,
+                             schedule="1f1b", virtual_stages=3)
+    with pytest.raises(ValueError, match="boundaries"):
+        train.GPipeTrainStep(cfg, train.adamw(1e-3), mesh,
+                             schedule="1f1b", virtual_stages=2,
+                             boundaries=[3])
+
+
+def test_virtual_chunk_stacking_roundtrip():
+    """stack_virtual_chunks places chunk j*S+d at [d, j] (every S-th
+    chunk per device, the Megatron interleaved assignment)."""
+    from llm_sharding_demo_tpu.parallel.partition import (
+        stack_virtual_chunks)
+    import numpy as _np
+    L, S, v = 8, 2, 2
+    per = L // (S * v)
+    x = jnp.arange(L * 3.0).reshape(L, 3)
+    stacked = stack_virtual_chunks({"blocks": {"w": x}}, S, v)["w"]
+    assert stacked.shape == (S, v, per, 3)
+    for d in range(S):
+        for j in range(v):
+            g = j * S + d
+            _np.testing.assert_array_equal(
+                stacked[d, j], x[g * per:(g + 1) * per])
+
+
+def test_interleaved_grads_match_flat_exactly_at_init(gpt2_setup):
+    """Per-leaf grad oracle for the interleaved layout: unstacked to
+    layer order, interleaved-v2 grads equal the flat 1F1B schedule's
+    (same math, different chunk placement and routing)."""
+    cfg, params, ids, _ = gpt2_setup
+    from llm_sharding_demo_tpu.parallel.pipeline_1f1b import (
+        one_f_one_b_loss_and_grads)
+    mesh = spmd.make_mesh({"pp": 2}, jax.devices()[:2])
+
+    flat = train.GPipeTrainStep(cfg, train.adamw(1e-3), mesh,
+                                n_microbatches=4, schedule="1f1b")
+    fp, _ = flat.init(params)
+    ids_s = flat.shard_batch(ids)
+    loss_f, grads_f = one_f_one_b_loss_and_grads(fp, ids_s, cfg, mesh, 4)
+
+    inter = train.GPipeTrainStep(cfg, train.adamw(1e-3), mesh,
+                                 n_microbatches=4, schedule="1f1b",
+                                 virtual_stages=2)
+    ip, _ = inter.init(params)
+    loss_i, grads_i = one_f_one_b_loss_and_grads(ip, ids_s, cfg, mesh, 4,
+                                                 virtual_stages=2)
+    assert abs(float(loss_f) - float(loss_i)) < 1e-6
+
+    def to_layers_flat(x):      # [S, per, ...] -> [L, ...]
+        return np.asarray(x).reshape((-1,) + x.shape[2:])
+
+    def to_layers_inter(x):     # [S, v, per, ...] -> [L, ...]
+        return np.asarray(jnp.swapaxes(x, 0, 1)).reshape(
+            (-1,) + x.shape[3:])
+
+    bf = jax.tree_util.tree_map(to_layers_flat, grads_f["stacked_blocks"])
+    bi = jax.tree_util.tree_map(to_layers_inter,
+                                grads_i["stacked_blocks"])
+    for path, gf in jax.tree_util.tree_flatten_with_path(bf)[0]:
+        gi = dict(jax.tree_util.tree_flatten_with_path(bi)[0])[path]
+        np.testing.assert_allclose(
+            gi, gf, rtol=2e-4, atol=1e-6,
+            err_msg=f"block grad mismatch at {jax.tree_util.keystr(path)}")
+    for k in ("wte", "wpe", "ln_f"):
+        flat_leaves = jax.tree_util.tree_flatten_with_path(grads_f[k])[0]
+        inter_leaves = dict(
+            jax.tree_util.tree_flatten_with_path(grads_i[k])[0])
+        for path, gf in flat_leaves:
+            np.testing.assert_allclose(
+                np.asarray(inter_leaves[path]), np.asarray(gf),
+                rtol=2e-4, atol=1e-6,
+                err_msg=f"{k}{jax.tree_util.keystr(path)} grad mismatch")
