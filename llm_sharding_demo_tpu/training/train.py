@@ -254,6 +254,15 @@ class GPipeTrainStep:
                 raise ValueError(
                     f"n_layer={self.config.n_layer} must divide by "
                     f"pp * virtual_stages = {pp * self.virtual_stages}")
+            bad_axes = [ax for ax in ("tp", "sp")
+                        if self.mesh.shape.get(ax, 1) > 1]
+            if bad_axes:
+                raise ValueError(
+                    f"interleaved 1F1B on a {'/'.join(bad_axes)} mesh is "
+                    "strictly slower: collectives inside blocks disable "
+                    "the per-core bubble skip, so every tick computes "
+                    "every chunk and interleaving only adds ticks — use "
+                    "virtual_stages=1 (see parallel.pipeline_1f1b)")
         bounds = (list(self.boundaries) if self.boundaries is not None
                   else P_.balanced_boundaries(self.config.n_layer, pp))
         self._specs = P_.make_stage_specs(self.config.n_layer, bounds)

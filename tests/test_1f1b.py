@@ -286,3 +286,13 @@ def test_interleaved_grads_match_flat_exactly_at_init(gpt2_setup):
                 np.asarray(inter_leaves[path]), np.asarray(gf),
                 rtol=2e-4, atol=1e-6,
                 err_msg=f"{k}{jax.tree_util.keystr(path)} grad mismatch")
+
+
+def test_interleaved_rejected_on_tp_mesh(gpt2_setup):
+    """tp/sp meshes disable the bubble skip, where interleaving is
+    strictly slower — the step must refuse, not silently pessimize."""
+    cfg, params, ids, _ = gpt2_setup
+    mesh = spmd.make_mesh({"pp": 2, "tp": 2}, jax.devices()[:4])
+    with pytest.raises(ValueError, match="strictly slower"):
+        train.GPipeTrainStep(cfg, train.adamw(1e-3), mesh,
+                             schedule="1f1b", virtual_stages=2)
