@@ -1249,9 +1249,12 @@ def main() -> None:
     safe("cfg6_moe_8e_top2_124m_geometry", cfg6)
     safe("cfg8_speculative_decode_124m", cfg8)
     safe("cfg9_llama_124m_gqa", cfg9)
-    safe("cfg12_megakernel_batch_crossover", cfg12)
     safe("cfg7_flash_attention_vs_xla", cfg7)
     safe("cfg10_training_gpt2_124m", cfg10)
+    # last: the 4-engine crossover sweep is the longest single row — if
+    # an external timeout cuts the run short, the classic matrix rows
+    # above are already journaled
+    safe("cfg12_megakernel_batch_crossover", cfg12)
 
     by_name = {c["name"]: c for c in configs}
     head = by_name.get("cfg2_gpt2_124m_2shard_single_prompt", {})
