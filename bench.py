@@ -852,13 +852,14 @@ def _journal_row(row: dict) -> None:
         pass
 
 
-def _probe_backend() -> tuple:
+def _probe_backend(attempts: int = _PROBE_ATTEMPTS) -> tuple:
     """(platform, None) if a default backend answers within bounded time,
     else (None, reason). Subprocess + timeout via the shared helper:
-    with the tunnel down, in-process jax.devices() can block forever."""
+    with the tunnel down, in-process jax.devices() can block forever.
+    ``attempts=1`` is the smoke-run mode (fail fast, no retry tax)."""
     from llm_sharding_demo_tpu.utils.backend_probe import (
         probe_default_backend)
-    return probe_default_backend(_PROBE_TIMEOUT_S, attempts=_PROBE_ATTEMPTS,
+    return probe_default_backend(_PROBE_TIMEOUT_S, attempts=attempts,
                                  backoff_s=_PROBE_BACKOFF_S)
 
 
@@ -872,7 +873,9 @@ def _parent_main(argv) -> None:
 
     quick = "--quick" in argv
     metric = _QUICK_METRIC if quick else _HEADLINE_METRIC
-    platform, reason = _probe_backend()
+    # a smoke run fails fast (one probe attempt, no ~9-minute retry tax)
+    platform, reason = _probe_backend(attempts=1 if quick else
+                                      _PROBE_ATTEMPTS)
     if platform is None:
         emit({"metric": metric, "value": None,
               "unit": "tokens/sec", "vs_baseline": None,

@@ -22,7 +22,7 @@ def _last_json_line(capsys):
 
 def test_skip_line_when_backend_unavailable(monkeypatch, capsys):
     monkeypatch.setattr(bench, "_probe_backend",
-                        lambda: (None, "backend probe hung >150s"))
+                        lambda *a, **k: (None, "backend probe hung >150s"))
     bench._parent_main(["--quick"])
     d = _last_json_line(capsys)
     assert d["metric"] == bench._QUICK_METRIC  # quick run, quick headline
@@ -32,7 +32,8 @@ def test_skip_line_when_backend_unavailable(monkeypatch, capsys):
 
 
 def test_partial_line_when_child_dies_mid_matrix(monkeypatch, capsys):
-    monkeypatch.setattr(bench, "_probe_backend", lambda: ("cpu", None))
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda *a, **k: ("cpu", None))
 
     row = {"name": "cfg2_gpt2_124m_2shard_single_prompt",
            "engine_bf16_tokens_per_sec": 123.0,
@@ -57,7 +58,8 @@ def test_partial_line_when_child_dies_mid_matrix(monkeypatch, capsys):
 
 
 def test_partial_line_when_child_hits_watchdog(monkeypatch, capsys):
-    monkeypatch.setattr(bench, "_probe_backend", lambda: ("cpu", None))
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda *a, **k: ("cpu", None))
 
     def fake_run(cmd, **kw):
         raise subprocess.TimeoutExpired(cmd, kw.get("timeout"))
