@@ -839,6 +839,14 @@ _HEADLINE_METRIC = "greedy_decode_throughput_gpt2_124m"
 _QUICK_METRIC = "greedy_decode_throughput_tiny"
 
 
+def _run_child(cmd, *, env, cwd, timeout_s) -> int:
+    """Run the measurement child, streaming its output through the
+    shared AOT-spew filter + watchdog (utils.subproc) — the driver's
+    output-tail capture must keep the final JSON line in view."""
+    from llm_sharding_demo_tpu.utils.subproc import run_filtered
+    return run_filtered(cmd, env=env, cwd=cwd, timeout_s=timeout_s)
+
+
 def _journal_row(row: dict) -> None:
     """Append one finished config row to the parent's progress file (the
     partial-artifact fallback when the child dies mid-matrix)."""
@@ -866,8 +874,6 @@ def _probe_backend(attempts: int = _PROBE_ATTEMPTS) -> tuple:
 def _parent_main(argv) -> None:
     """Probe, then run the real bench in a watchdogged child; ALWAYS end
     with one parseable JSON line on stdout."""
-    import os
-    import subprocess
     import sys
     import tempfile
 
@@ -891,12 +897,12 @@ def _parent_main(argv) -> None:
     here = os.path.abspath(__file__)
     budget = 1500 if quick else 5400
     try:
-        r = subprocess.run([sys.executable, here] + list(argv), env=env,
-                           cwd=os.path.dirname(here), timeout=budget)
-        if r.returncode == 0:
+        rc = _run_child([sys.executable, here] + list(argv), env=env,
+                        cwd=os.path.dirname(here), timeout_s=budget)
+        if rc == 0:
             return  # child printed the line (and wrote the matrix file)
-        reason = f"bench child exited rc={r.returncode}"
-    except subprocess.TimeoutExpired:
+        reason = f"bench child exited rc={rc}"
+    except TimeoutError:
         reason = f"bench child exceeded {budget}s watchdog"
     finally:
         rows = []

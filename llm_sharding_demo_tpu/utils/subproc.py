@@ -1,0 +1,52 @@
+"""Watchdogged child processes with output-tail hygiene.
+
+The round driver captures the TAIL of bench/dryrun output; XLA's AOT
+cache loader logs a multi-KB machine-feature diff at ERROR level per
+cache hit (``TF_CPP_MIN_LOG_LEVEL`` does not reliably silence it), so a
+child's combined output streams through a line filter before reaching
+stdout. A kill timer enforces the wall-clock budget (blocking readline
+cannot time out by itself), and parent-side stream failures kill the
+child so it can never orphan-block on a full pipe. Shared by
+``__graft_entry__`` (dryrun bootstrap) and ``bench.py`` (matrix child).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+AOT_SPEW_MARKERS = ("cpu_aot_loader", "machine feature")
+
+
+def run_filtered(cmd: Sequence[str], *, env: Optional[dict] = None,
+                 cwd: Optional[str] = None, timeout_s: float,
+                 drop: Sequence[str] = AOT_SPEW_MARKERS) -> int:
+    """Run ``cmd`` streaming its combined output to stdout minus lines
+    containing any ``drop`` marker. Returns the exit code; raises
+    ``TimeoutError`` when the watchdog killed the child."""
+    proc = subprocess.Popen(list(cmd), env=env, cwd=cwd,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            errors="replace")
+    timer = threading.Timer(timeout_s, proc.kill)
+    timer.start()
+    try:
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            if any(marker in line for marker in drop):
+                continue
+            print(line, end="", flush=True)
+        rc = proc.wait()
+    except BaseException:
+        # parent-side failure mid-stream (SIGINT, encoding, broken
+        # pipe): never orphan a child that would block on a full pipe
+        # with no watchdog left
+        proc.kill()
+        raise
+    finally:
+        expired = not timer.is_alive()
+        timer.cancel()
+    if rc != 0 and expired:  # a clean exit racing the timer lands below
+        raise TimeoutError(f"child exceeded the {timeout_s:g}s watchdog")
+    return rc

@@ -10,7 +10,6 @@ design isolates).
 """
 
 import json
-import subprocess
 
 import bench
 
@@ -39,15 +38,12 @@ def test_partial_line_when_child_dies_mid_matrix(monkeypatch, capsys):
            "engine_bf16_tokens_per_sec": 123.0,
            "engine_bf16_vs_baseline": 9.9}
 
-    def fake_run(cmd, **kw):
-        with open(kw["env"][bench._PROGRESS_ENV], "w") as f:
+    def fake_child(cmd, *, env, cwd, timeout_s):
+        with open(env[bench._PROGRESS_ENV], "w") as f:
             f.write(json.dumps(row) + "\n")
+        return 7
 
-        class R:
-            returncode = 7
-        return R()
-
-    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_run_child", fake_child)
     bench._parent_main([])
     d = _last_json_line(capsys)
     assert d["value"] == 123.0
@@ -61,10 +57,10 @@ def test_partial_line_when_child_hits_watchdog(monkeypatch, capsys):
     monkeypatch.setattr(bench, "_probe_backend",
                         lambda *a, **k: ("cpu", None))
 
-    def fake_run(cmd, **kw):
-        raise subprocess.TimeoutExpired(cmd, kw.get("timeout"))
+    def fake_child(cmd, *, env, cwd, timeout_s):
+        raise TimeoutError(f"child exceeded the {timeout_s:g}s watchdog")
 
-    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_run_child", fake_child)
     bench._parent_main([])
     d = _last_json_line(capsys)
     assert d["value"] is None

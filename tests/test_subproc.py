@@ -1,0 +1,45 @@
+"""utils.subproc.run_filtered: the shared watchdogged child runner that
+keeps AOT-loader spew out of driver output-tail captures."""
+
+import sys
+import time
+
+import pytest
+
+from llm_sharding_demo_tpu.utils.subproc import run_filtered
+
+
+def test_filters_spew_and_passes_rc(capfd):
+    rc = run_filtered(
+        [sys.executable, "-c",
+         "import sys;"
+         "print('keep this line');"
+         "print('E0000 cpu_aot_loader.cc:210] giant machine feature diff');"
+         "print('also keep');"
+         "sys.exit(3)"],
+        timeout_s=60)
+    assert rc == 3
+    out = capfd.readouterr().out
+    assert "keep this line" in out and "also keep" in out
+    assert "cpu_aot_loader" not in out
+
+
+def test_watchdog_kills_and_raises():
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="watchdog"):
+        run_filtered([sys.executable, "-c", "import time; time.sleep(60)"],
+                     timeout_s=1.0)
+    assert time.monotonic() - t0 < 30  # killed, not waited out
+
+
+def test_stderr_merged_and_filtered(capfd):
+    rc = run_filtered(
+        [sys.executable, "-c",
+         "import sys;"
+         "sys.stderr.write('machine feature spew\\n');"
+         "sys.stderr.write('real error context\\n')"],
+        timeout_s=60)
+    assert rc == 0
+    out = capfd.readouterr().out
+    assert "real error context" in out
+    assert "machine feature" not in out
