@@ -87,7 +87,7 @@ class BatchingEngine:
 
     def __init__(self, engine: DecodeEngine, max_batch: int = 8,
                  max_wait_ms: float = 5.0, prompt_bucket: int = 16,
-                 steps_bucket: int = 32, prefix=None):
+                 steps_bucket: int = 32, prefix=None, spec=None):
         """``prefix`` (optional ``PrefixCachingEngine`` wrapping the SAME
         underlying engine) composes cross-request KV reuse with batching:
         each row prefills solo through the prefix store (hit or miss at
@@ -96,11 +96,27 @@ class BatchingEngine:
         positions, so the merged state is exactly what a batched prefill
         would have produced), and ONE batched decode serves all rows.
         Single-request rounds route through ``prefix.generate`` directly,
-        preserving the solo path's speculation composition."""
+        preserving the solo path's speculation composition.
+
+        ``spec`` (optional ``SpecDecodeEngine`` wrapping the SAME engine)
+        composes speculation with batching: requests whose policy carries
+        ``SamplingConfig.spec`` gather into their own rounds (the flag is
+        part of policy equality, so the existing FIFO-preserving
+        policy-change handling applies unchanged) and decode through the
+        spec engine's BATCHED verify loop — per-row acceptance with
+        uniform-depth re-sync, every row byte-equal to its solo
+        speculative run (greedy and seeded sample; see
+        runtime.spec_decode). Spec rounds reserve ``draft_len`` cache
+        slots of verify-write headroom when bucketing shapes, and bypass
+        the prefix store (its first-token merge is solo-round-only)."""
         if prefix is not None and prefix.plain is not engine:
             raise ValueError("prefix must wrap the same engine instance")
+        if spec is not None and spec.plain is not engine:
+            raise ValueError("spec must wrap the same DecodeEngine (shared "
+                             "weights/programs), got a different instance")
         self.engine = engine
         self.prefix = prefix
+        self.spec = spec
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1e3
         self.prompt_bucket = prompt_bucket
@@ -140,6 +156,16 @@ class BatchingEngine:
             # later anyway, from the worker thread)
             raise ValueError(
                 "sample-mode requests must carry a per-request PRNG key")
+        if sampling.spec:
+            # caller-thread eligibility: a flagged request speculation
+            # cannot serve exactly must be refused HERE with its own
+            # numbers, not mid-round (rule defined once, on the engine)
+            if self.spec is None:
+                raise ValueError(
+                    "sampling.spec requested but this batcher has no "
+                    "speculative engine attached (pass spec= at "
+                    "construction)")
+            self.spec.check_request(len(prompt), max_new_tokens)
         req = _Request(prompt=prompt, max_new_tokens=max_new_tokens,
                        sampling=sampling, key=key)
         self._queue.put(req)
@@ -221,16 +247,19 @@ class BatchingEngine:
 
         Prompt bucketing is capped so bucket padding alone never pushes
         past max_seq; a batch is feasible iff the capped bucket still
-        covers its longest prompt.
+        covers its longest prompt. Spec rounds additionally reserve
+        ``draft_len`` slots of verify-write headroom (the spec engine's
+        own generate guard, applied to the round's shared shape).
         """
         raw_s = max(len(r.prompt) for r in batch)
         need = max(r.max_new_tokens for r in batch)
+        reserve = self.spec.draft_len if batch[0].sampling.spec else 0
         s_max = min(_round_up(raw_s, self.prompt_bucket),
-                    self.engine.max_seq - need)
+                    self.engine.max_seq - need - reserve)
         if s_max < raw_s:
             return None
         steps = min(_round_up(need, self.steps_bucket),
-                    self.engine.max_seq - s_max)
+                    self.engine.max_seq - s_max - reserve)
         return s_max, steps
 
     def _loop(self):
@@ -300,6 +329,15 @@ class BatchingEngine:
             batch[0].sampling, ids.shape[1], _monotonic() - t0)
 
     def _run(self, batch: List[_Request]):
+        if batch[0].sampling.spec:
+            # spec-flagged rounds (any size, solo included — the stream
+            # must be a pure function of the request, never of whether a
+            # prefix store happened to be attached) decode through the
+            # spec engine's batched verify loop: per-row acceptance +
+            # uniform-depth re-sync, each row byte-equal to its solo
+            # speculative run (greedy and seeded sample).
+            self._run_spec(batch)
+            return
         if self.prefix is not None and len(batch) == 1:
             # solo rounds keep the full single-stream prefix path
             # (including its speculation composition) and true shapes
@@ -311,13 +349,7 @@ class BatchingEngine:
 
         s_max, steps = self._shapes(batch)  # planned feasible: not None
         b = _bucket_batch(len(batch), self.max_batch)
-
-        ids = np.zeros((b, s_max), dtype=np.int32)
-        pad = np.zeros((b,), dtype=np.int32)
-        for i in range(b):
-            r = batch[min(i, len(batch) - 1)]  # dummy rows replicate last
-            ids[i, s_max - len(r.prompt):] = r.prompt
-            pad[i] = s_max - len(r.prompt)
+        ids, pad = self._bucket_rows(batch, b, s_max)
 
         greedy = batch[0].sampling.mode == "greedy"
         if self.prefix is not None and greedy:
@@ -326,18 +358,60 @@ class BatchingEngine:
             if greedy:
                 key = batch[0].key  # never consumed by greedy draws
             else:
-                # per-row key stack: row i's stream derives only from its
-                # own request key (dummy rows replicate the last real
-                # key — their draws are dropped), so batched rows are
-                # byte-equal to solo runs (engine._split_keys contract).
                 # Sample rounds bypass the prefix store: its first-token
                 # merge is argmax-only.
-                keys = [r.key for r in batch]
-                keys += [keys[-1]] * (b - len(batch))
-                key = jnp.stack([jnp.asarray(k) for k in keys])
+                key = self._row_keys(batch, b)
             result = self.engine.generate(ids, steps,
                                           sampling=batch[0].sampling, key=key,
                                           pad=pad)
+        self._deliver(batch, result, padded_rows=b - len(batch))
+
+    @staticmethod
+    def _bucket_rows(batch: List[_Request], b: int, s_max: int):
+        """Right-aligned [b, s_max] prompt matrix + per-row left-pad for
+        one bucketed round; dummy rows replicate the last real request.
+        THE round-shape builder — plain and spec rounds share it, so a
+        change to dummy-row policy cannot diverge between them."""
+        ids = np.zeros((b, s_max), dtype=np.int32)
+        pad = np.zeros((b,), dtype=np.int32)
+        for i in range(b):
+            r = batch[min(i, len(batch) - 1)]
+            ids[i, s_max - len(r.prompt):] = r.prompt
+            pad[i] = s_max - len(r.prompt)
+        return ids, pad
+
+    @staticmethod
+    def _row_keys(batch: List[_Request], b: int):
+        """Per-row key stack: row i's stream derives only from its own
+        request key (dummy rows replicate the last real key — their
+        draws are dropped), so batched rows are byte-equal to solo runs
+        (engine._split_keys contract)."""
+        keys = [r.key for r in batch]
+        keys += [keys[-1]] * (b - len(batch))
+        return jnp.stack([jnp.asarray(k) for k in keys])
+
+    def _run_spec(self, batch: List[_Request]):
+        """One bucketed round through ``SpecDecodeEngine.generate``'s
+        batched path. Shapes bucket exactly like plain rounds (power-of-
+        two width, prompt/steps buckets — with draft_len headroom, see
+        ``_shapes``); rows past a request's own ``max_new_tokens`` are
+        bucket over-decode and truncated in ``_deliver``, leaving the
+        kept prefix byte-equal to the solo spec run (per-verify RNG
+        consumption is budget-independent, and verify writes never touch
+        slots before the row's existing content)."""
+        s_max, steps = self._shapes(batch)  # planned feasible: not None
+        b = _bucket_batch(len(batch), self.max_batch)
+        ids, pad = self._bucket_rows(batch, b, s_max)
+        if batch[0].sampling.mode == "greedy":
+            key = None
+        else:
+            key = self._row_keys(batch, b)
+        result = self.spec.generate(
+            ids, steps, sampling=batch[0].sampling, key=key, pad=pad,
+            # acceptance stats count what callers are SERVED: dummy
+            # rows and bucket over-decode are shape tax, not traffic
+            delivered=(len(batch),
+                       sum(r.max_new_tokens for r in batch)))
         self._deliver(batch, result, padded_rows=b - len(batch))
 
     def _deliver(self, batch: List[_Request], result: GenerateResult,

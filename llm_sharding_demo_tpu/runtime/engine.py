@@ -90,6 +90,15 @@ class SamplingConfig:
     temperature: float = REF_TEMPERATURE
     top_k: int = REF_TOP_K
     top_p: float = 1.0
+    # Speculative-decoding routing flag: a spec-enabled policy batches
+    # only with itself (SamplingConfig equality drives batch grouping,
+    # so the existing FIFO policy-change handling applies unchanged) and
+    # the batching front ends (runtime.batcher, runtime.iterbatch) route
+    # such batches through the speculative engine. Pure routing
+    # metadata: it never changes the sampler math — the spec engine
+    # normalizes it away before compiling, so greedy stays token-exact
+    # and sample keeps the same distribution.
+    spec: bool = False
 
     def __post_init__(self):
         if self.mode not in ("greedy", "sample"):

@@ -47,6 +47,34 @@ sampling, power-of-two batch width up to ``max_batch``) and segment
 length (plus cache-tail remainders, quantized by construction), one
 admit program per width, and one tiny grow program per adjacent width
 pair.
+
+Speculative segments (``spec=``): a batch whose policy carries the
+``SamplingConfig.spec`` flag advances through the speculative engine's
+draft-verify SEGMENT program (runtime.spec_decode.``_seg_b``) instead of
+the single-token segment scan: each segment runs up to
+``seg_steps // (draft_len + 1)`` verify forwards, every row accepting
+its own ``k_i in [0, draft_len]`` drafts per verify with a per-row
+cache rewind (uniform-depth re-sync — rows stay mergeable, so admission
+and retirement keep working mid-speculation). Per-row emission within a
+segment is ragged, so a spec segment costs ONE host sync (fetching
+per-row counts + the new depth) — the price of data-dependent progress,
+same class as EOS-armed batches. Exactness bar unchanged: every row —
+seeded sample rows included — is byte-equal to its solo
+``SpecDecodeEngine.generate`` run (per-row key chains resume across
+segments; joiners start their chain at their own step 0). Spec batches
+admit only rows speculation is exact for (prompt >= ngram, draft_len
+slots of headroom); the ``spec`` flag is part of policy equality, so a
+spec arrival during a plain batch (or vice versa) closes admission and
+seeds the next batch — the same FIFO-preserving policy-change handling
+as any sampling change. One spec-segment program per (width, policy):
+acceptance counts are traced, never program keys.
+
+Prefix-cache composition (``prefix=``): admissions prefill through the
+prefix store (``PrefixCachingEngine.prefill_state``) — a joiner whose
+prompt shares a cached prefix forwards only its suffix before merging
+into the live batch at the current depth. Exact (store replay is
+byte-identical to a cold prefill) and compile-bounded by the store's
+chunk programs.
 """
 
 from __future__ import annotations
@@ -135,6 +163,12 @@ class _Slot:
     dk: Optional[jax.Array]       # per-row decode key (sample mode)
     emitted: int = 1              # tokens generated so far (incl. first)
     segs: List = dataclasses.field(default_factory=list)  # (_SegOut, n)
+    # Spec-mode delivery state: the latest segment's [B, buflen] token
+    # buffer (prompt + everything emitted, per row, left-aligned at the
+    # row's pad) and this row's pad at that moment — _row_tokens reads
+    # the stream straight out of it, no per-segment part list needed.
+    spec_buf: Optional["_SegOut"] = None
+    spec_pad: int = 0
     t0: float = 0.0
     done_t: float = 0.0
 
@@ -174,6 +208,12 @@ class _BatchState:
         self.depth = depth            # uniform cache depth (host int)
         self.slots: List[Optional[_Slot]] = []
         self.closed = False           # True: no more admissions (FIFO)
+        # speculative batches only: device token buffer [B, buflen]
+        # (prompt + emitted per row, content ending at depth + 1) and
+        # the per-row verify key chains [B, 2] (sample mode)
+        self.spec_mode = False
+        self.buf = None
+        self.keys = None
 
     def active(self):
         return any(s is not None for s in self.slots)
@@ -191,7 +231,14 @@ class IterBatchingEngine:
 
     def __init__(self, engine: DecodeEngine, max_batch: int = 8,
                  seg_steps: int = 32, max_wait_ms: float = 2.0,
-                 prompt_bucket: int = 16):
+                 prompt_bucket: int = 16, spec=None, prefix=None):
+        """``spec`` (optional ``SpecDecodeEngine`` wrapping THIS engine)
+        enables speculative segments: batches whose policy carries
+        ``SamplingConfig.spec`` advance by draft-verify forwards instead
+        of single-token steps (see module docstring). ``prefix``
+        (optional ``PrefixCachingEngine`` wrapping THIS engine) routes
+        admission prefills through the prefix store, so a joiner with a
+        warm prefix forwards only its suffix."""
         from ..models import is_window_independent
         if not is_window_independent(engine.config):
             raise NotImplementedError(
@@ -208,7 +255,14 @@ class IterBatchingEngine:
             raise NotImplementedError(
                 "iteration-level batching drives the single-device "
                 "engine; mesh decode (tp/ep) uses the admission batcher")
+        if spec is not None and spec.plain is not engine:
+            raise ValueError("spec must wrap the same DecodeEngine (shared "
+                             "weights/programs), got a different instance")
+        if prefix is not None and prefix.plain is not engine:
+            raise ValueError("prefix must wrap the same engine instance")
         self.engine = engine
+        self.spec = spec
+        self.prefix = prefix
         self.max_batch = max_batch
         self.seg_steps = seg_steps
         self.max_wait_s = max_wait_ms / 1e3
@@ -220,6 +274,7 @@ class IterBatchingEngine:
         self.rows_served = 0
         self.joins = 0                # admissions into a LIVE batch
         self.segments_run = 0
+        self.spec_segments_run = 0    # draft-verify segments (spec mode)
         self.eos_retires = 0
         self.grows = 0                # width upgrades of a live batch
         self._worker = threading.Thread(target=self._loop, daemon=True)
@@ -242,6 +297,17 @@ class IterBatchingEngine:
         if sampling.mode != "greedy" and key is None:
             raise ValueError(
                 "sample-mode requests must carry a per-request PRNG key")
+        if sampling.spec:
+            # caller-thread eligibility: a spec-flagged request the
+            # verify loop cannot serve exactly must be refused HERE with
+            # its own numbers, not discovered mid-batch (rule defined
+            # once, on the engine)
+            if self.spec is None:
+                raise ValueError(
+                    "sampling.spec requested but this scheduler has no "
+                    "speculative engine attached (pass spec= at "
+                    "construction)")
+            self.spec.check_request(len(prompt), max_new_tokens)
         req = _Req(prompt=prompt, max_new_tokens=max_new_tokens,
                    sampling=sampling, key=key, eos_id=eos_id)
         self._queue.put(req)
@@ -278,6 +344,7 @@ class IterBatchingEngine:
         with self._stats_lock:
             return {"batches": self.batches_run, "rows": self.rows_served,
                     "joins": self.joins, "segments": self.segments_run,
+                    "spec_segments": self.spec_segments_run,
                     "eos_retires": self.eos_retires, "grows": self.grows}
 
     # -- worker side ---------------------------------------------------------
@@ -294,12 +361,17 @@ class IterBatchingEngine:
                 head.fail(e)
 
     def _compatible(self, state: _BatchState, req: _Req) -> bool:
-        """Can ``req`` join the live batch right now? Policy must match,
-        its prompt must fit the current depth (content at
-        ``[d - plen, d)``), and its generation must fit the cache."""
+        """Can ``req`` join the live batch right now? Policy must match
+        (the ``spec`` flag included — a spec arrival never joins a plain
+        batch or vice versa), its prompt must fit the current depth
+        (content at ``[d - plen, d)``), and its generation must fit the
+        cache — with ``draft_len`` extra slots of verify-write headroom
+        when the batch speculates."""
+        reserve = self.spec.draft_len if state.spec_mode else 0
         return (req.sampling == state.sampling
                 and len(req.prompt) <= state.depth
-                and state.depth + req.max_new_tokens <= self.engine.max_seq)
+                and state.depth + req.max_new_tokens + reserve
+                <= self.engine.max_seq)
 
     def _run_batch(self, head: _Req):
         state = self._seed(head)
@@ -352,6 +424,7 @@ class IterBatchingEngine:
 
     def _seed_batch(self, seed: List[_Req]) -> _BatchState:
         eng = self.engine
+        spec_mode = seed[0].sampling.spec
         s_max = self._seed_smax(seed)
 
         # Right-size the compiled width (ADVICE r4: a lone request must
@@ -377,6 +450,20 @@ class IterBatchingEngine:
             last_logits, sampling, [r.key for r in seed], b)
 
         state = _BatchState(sampling, first, cache, pad_j, s_max)
+        if spec_mode:
+            # verify-loop entry state (spec_decode._seg_b invariant): the
+            # token buffer holds prompt + the unforwarded first token per
+            # row, content at [pad_b, depth + 1); the per-row key chains
+            # are the dks the solo loop would carry (split(key)[1]).
+            buf = jnp.zeros((b, eng.max_seq + self.spec.draft_len + 1),
+                            jnp.int32)
+            buf = jax.lax.dynamic_update_slice(buf, ids_j, (0, 0))
+            buf = jax.lax.dynamic_update_slice(buf, first[:, None],
+                                               (0, s_max))
+            state.spec_mode = True
+            state.buf = buf
+            state.keys = (dks if dks is not None
+                          else jnp.zeros((b, 2), jnp.uint32))
         first_ref = _SegOut(first)          # one shared [B] fetch
         state.slots = [None] * b
         for i, r in enumerate(seed):
@@ -392,14 +479,22 @@ class IterBatchingEngine:
 
     def _fits(self, reqs: List[_Req]) -> bool:
         s_max = self._seed_smax(reqs)
-        return all(s_max + r.max_new_tokens <= self.engine.max_seq
+        reserve = self._reserve(reqs[0])
+        return all(s_max + r.max_new_tokens + reserve <= self.engine.max_seq
                    and len(r.prompt) <= s_max for r in reqs)
+
+    def _reserve(self, req: _Req) -> int:
+        """Cache slots held back beyond the generation: speculative
+        batches need ``draft_len`` of verify-write headroom past the
+        deepest content slot (the spec engine's own guard, applied to
+        the batch's shared shape)."""
+        return self.spec.draft_len if req.sampling.spec else 0
 
     def _seed_smax(self, reqs: List[_Req]) -> int:
         raw = max(len(r.prompt) for r in reqs)
         need = max(r.max_new_tokens for r in reqs)
         return min(_round_up(raw, self.prompt_bucket),
-                   self.engine.max_seq - need)
+                   self.engine.max_seq - need - self._reserve(reqs[0]))
 
     def _first_tokens(self, last_logits, sampling, keys, b):
         """First-token selection + per-row (prefill, decode) key split.
@@ -480,6 +575,11 @@ class IterBatchingEngine:
         state.token = rep(state.token, 0)
         state.pad_j = rep(state.pad_j, 0)
         state.cache = grow_cache(state.cache)
+        if state.spec_mode:
+            # ghost rows clone row 0's buffer/key lane; their zero
+            # budgets keep them inert through every verify (n_emit = 0)
+            state.buf = rep(state.buf, 0)
+            state.keys = rep(state.keys, 0)
         state.slots = state.slots + [None] * pad_rows
         with self._stats_lock:
             self.grows += 1
@@ -488,15 +588,24 @@ class IterBatchingEngine:
     def _admit_one(self, state: _BatchState, req: _Req, slot: int):
         eng = self.engine
         plen = len(req.prompt)
-        sp = min(_round_up(plen, self.prompt_bucket), state.depth)
-        if sp < plen:       # bucket would overshoot current depth: exact
-            sp = plen       # length (rare; costs one extra prefill program)
-        ids = np.zeros((1, sp), dtype=np.int32)
-        ids[0, sp - plen:] = req.prompt
         t0 = time.monotonic()
-        logits, solo = eng._prefill(eng._run_params(),
-                                    jnp.asarray(ids),
-                                    jnp.asarray([sp - plen], jnp.int32))
+        if self.prefix is not None:
+            # admission prefill through the prefix store: a joiner whose
+            # prompt shares a cached prefix forwards only its suffix (and
+            # warms the store for the next one). The store's cache is
+            # right-aligned — content at [0, plen), no pad — so the merge
+            # roll below uses sp = plen. Byte-exact: store replay equals
+            # a cold prefill (pinned by tests/test_prefix_cache.py).
+            logits, solo, sp = self.prefix.prefill_state(req.prompt)
+        else:
+            sp = min(_round_up(plen, self.prompt_bucket), state.depth)
+            if sp < plen:   # bucket would overshoot current depth: exact
+                sp = plen   # length (rare; costs one extra prefill program)
+            ids = np.zeros((1, sp), dtype=np.int32)
+            ids[0, sp - plen:] = req.prompt
+            logits, solo = eng._prefill(eng._run_params(),
+                                        jnp.asarray(ids),
+                                        jnp.asarray([sp - plen], jnp.int32))
         sampling = state.sampling
         if sampling.mode == "greedy":
             first = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
@@ -509,6 +618,21 @@ class IterBatchingEngine:
                                    jnp.asarray(slot, jnp.int32), roll)
         state.pad_j = state.pad_j.at[slot].set(state.depth - plen)
         state.token = state.token.at[slot].set(first)
+        if state.spec_mode:
+            # splice the joiner's stream into its buffer lane: prompt at
+            # [depth - plen, depth), first token at depth — the verify
+            # invariant every live row already satisfies. Host-built row
+            # + traced-offset writes: no program minted per depth.
+            rowbuf = np.zeros((state.buf.shape[1],), np.int32)
+            rowbuf[state.depth - plen:state.depth] = req.prompt
+            row_j = jax.lax.dynamic_update_slice(
+                jnp.asarray(rowbuf), first[None],
+                (jnp.asarray(state.depth, jnp.int32),))
+            state.buf = state.buf.at[slot].set(row_j)
+            if sampling.mode != "greedy":
+                # the row's verify key chain starts at its own split(key)[1]
+                # — exactly where its solo spec run's loop would start
+                state.keys = state.keys.at[slot].set(dk)
         state.slots[slot] = _Slot(req=req, plen=plen, row=slot,
                                   first_ref=_SegOut(first[None]),
                                   first_idx=0, dk=dk, t0=t0)
@@ -521,6 +645,8 @@ class IterBatchingEngine:
     # -- the segment step ----------------------------------------------------
 
     def _advance(self, state: _BatchState):
+        if state.spec_mode:
+            return self._advance_spec(state)
         eng = self.engine
         d = state.depth
         n = min(self.seg_steps, eng.max_seq - d)
@@ -540,6 +666,66 @@ class IterBatchingEngine:
             if s is not None:
                 s.segs.append((seg, n))
                 s.emitted += n
+        self._retire_finished(state)
+
+    def _advance_spec(self, state: _BatchState):
+        """One draft-verify SEGMENT (spec batches): up to
+        ``seg_steps // (draft_len + 1)`` verify forwards — the same
+        device-work quantum as ``seg_steps`` single-token steps — with
+        per-row acceptance, rewind, and uniform-depth re-sync all inside
+        ONE compiled program (spec_decode._seg_b). Each row's emission
+        is capped at its own remaining budget, so a short row never
+        over-decodes and ghost rows (budget 0) stay inert.
+
+        Costs ONE host sync per segment: the scheduler must read the
+        per-row emission counts, the new per-row pads, and the new
+        uniform depth to retire/admit (the price of data-dependent
+        progress — same class as EOS-armed batches); the token buffer's
+        device->host copy rides the same window and MUST materialize
+        here, before the next segment donates the buffer."""
+        eng = self.engine
+        K = self.spec.draft_len
+        b = len(state.slots)
+        budgets = np.zeros((b,), np.int32)
+        for i, s in enumerate(state.slots):
+            if s is not None:
+                budgets[i] = max(s.req.max_new_tokens - s.emitted, 0)
+        max_verify = max(1, self.seg_steps // (K + 1))
+        # the spec flag is routing metadata: normalize it out of the
+        # static sampling arg so the segment program is shared with (and
+        # byte-identical to) the solo spec engine's acceptance math
+        sampling = dataclasses.replace(state.sampling, spec=False)
+        buf, total, cache, pad, emitted, steps, keys = self.spec._seg_b(
+            eng._run_params(), state.buf, state.cache,
+            jnp.asarray(state.depth + 1, jnp.int32), state.pad_j,
+            state.keys, jnp.asarray(budgets),
+            max_verify=max_verify, sampling=sampling)
+        state.buf, state.cache = buf, cache
+        state.pad_j, state.keys = pad, keys
+        seg = _SegOut(buf)
+        emitted_np = np.asarray(emitted)          # THE per-segment sync
+        pad_np = np.asarray(pad)
+        steps_i = int(steps)
+        state.depth = int(total) - 1
+        _ = seg.np  # materialize: the next segment donates ``buf``
+        with self._stats_lock:
+            self.segments_run += 1
+            self.spec_segments_run += 1
+        # acceptance stats flow to the spec engine too, so /healthz's
+        # spec_decode_stats stays live under the iteration scheduler
+        with self.spec._stats_lock:
+            self.spec._verifies += steps_i
+            self.spec._emitted += int(emitted_np.sum())
+        REGISTRY.inc("iter_segments_total")
+        REGISTRY.inc("iter_spec_segments_total")
+        REGISTRY.inc("spec_verify_steps_total", value=steps_i)
+        REGISTRY.inc("spec_emitted_tokens_total",
+                     value=int(emitted_np.sum()))
+        for s in state.slots:
+            if s is not None:
+                s.emitted += int(emitted_np[s.row])
+                s.spec_buf = seg
+                s.spec_pad = int(pad_np[s.row])
         self._retire_finished(state)
 
     def _segment_keys(self, state: _BatchState, n: int):
@@ -587,6 +773,13 @@ class IterBatchingEngine:
                 self._deliver(state, i, s, eos_at)
 
     def _row_tokens(self, s: _Slot) -> np.ndarray:
+        if s.spec_buf is not None:
+            # spec rows: the buffer IS the stream — prompt at
+            # [pad, pad + plen), everything emitted right after it
+            row = s.spec_buf.np[s.row]
+            start = s.spec_pad + s.plen
+            n = min(s.emitted, s.req.max_new_tokens)
+            return row[start:start + n]
         parts = [s.first_ref.np[s.first_idx:s.first_idx + 1]]
         parts += [seg.np[s.row] for seg, _ in s.segs]
         return np.concatenate(parts)[:s.req.max_new_tokens]
@@ -605,4 +798,7 @@ class IterBatchingEngine:
         state.slots[i] = None
         with self._stats_lock:
             self.rows_served += 1
+        if state.spec_mode:
+            with self.spec._stats_lock:
+                self.spec._requests += 1
         REGISTRY.inc("iter_rows_total")

@@ -36,14 +36,37 @@ itself — no second network:
 
 The whole generation after prefill is ONE compiled program: a
 ``lax.while_loop`` whose body is draft-match (vectorized n-gram scan, no
-host work) + verify forward + buffer/cache bookkeeping. Single-stream
-(batch=1) by design: per-row acceptance counts would need per-row cache
-offsets, and speculation is a latency feature for exactly the
-single-stream case (batched throughput is served by ``runtime.batcher``).
+host work) + verify forward + buffer/cache bookkeeping.
+
+Batched speculation (the spec x batching composition): rows accept
+*different* draft counts per verify, which would naively need per-row
+cache write offsets — impossible under one ``dynamic_update_slice``. The
+batched loop keeps every row at ONE uniform cache depth instead
+(the iterbatch trick, inverted): row i's content occupies slots
+``[pad_i, total)`` with per-row left-pad slack, every verify forwards
+``[t_last_i, drafts_i]`` for all rows at the shared offset, and after
+per-row acceptance the batch RE-SYNCS — each row's cache/buffer rolls by
+a signed per-row shift so all rows end at the new uniform depth
+``max_i(content_len_i)``, the slack landing in the masked pad prefix.
+The roll is a pure permutation (values bitwise intact, positions =
+slot - pad_i unchanged), so each row's stream is byte-equal to its solo
+single-stream spec run — greedy AND seeded sample (per-row key chains
+advance one split per verify, exactly like the solo loop). Acceptance
+counts are traced values inside one ``lax.while_loop`` program: the
+compiled-program set stays one loop per (batch width, policy), never one
+per acceptance pattern. The minimal uniform depth also preserves the
+single-stream headroom bound: writes never pass
+``max_i(plen_i + max_new) + draft_len <= max_seq``.
+
+``seg_verify`` exposes the same body as a bounded SEGMENT program
+(per-row budgets, at most ``max_verify`` verifies) so the iteration-level
+scheduler (runtime.iterbatch) can run speculative segments on a live
+batch — rows join/retire between segments without draining the batch.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import Optional
 
@@ -52,6 +75,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.gpt2 import GPT2Config, Params
+from ..ops.attention import KVCache
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
                      prepare_generate, sampler_pmf, select_token)
 
@@ -109,6 +133,15 @@ class SpecDecodeEngine:
         self._loop = jax.jit(self._loop_impl,
                              static_argnames=("max_new", "sampling"),
                              donate_argnums=(2,))
+        # Batched variants (one program per batch width + policy, never
+        # per acceptance pattern): the full-generation loop and the
+        # bounded segment program the iteration scheduler drives.
+        self._loop_b = jax.jit(self._loop_b_impl,
+                               static_argnames=("max_new", "sampling"),
+                               donate_argnums=(2, 3))
+        self._seg_b = jax.jit(self._seg_b_impl,
+                              static_argnames=("max_verify", "sampling"),
+                              donate_argnums=(1, 2))
 
     @property
     def plain(self) -> DecodeEngine:
@@ -116,14 +149,35 @@ class SpecDecodeEngine:
         serving layer routes ineligible requests here."""
         return self._eng
 
+    def check_request(self, prompt_len: int, max_new_tokens: int) -> None:
+        """Raising form of the speculation-eligibility predicate, THE
+        single definition of the rule: the batching front ends
+        (runtime.batcher, runtime.iterbatch) call it on the caller
+        thread so a spec-flagged request the verify loop cannot serve
+        exactly is refused with its own numbers, never discovered
+        mid-batch — and a future change to the rule (e.g. an alignment
+        reserve) cannot silently diverge between front ends."""
+        if prompt_len < self.ngram:
+            raise ValueError(
+                f"prompt_len={prompt_len} shorter than ngram={self.ngram}")
+        total = prompt_len + max_new_tokens + self.draft_len
+        if total > self.max_seq:
+            raise ValueError(
+                f"prompt_len={prompt_len} + max_new_tokens="
+                f"{max_new_tokens} + draft_len={self.draft_len} "
+                f"exceeds max_seq={self.max_seq}; verify writes need "
+                "draft_len slots of headroom")
+
     def eligible(self, prompt_len: int, max_new_tokens: int) -> bool:
-        """THE speculation-eligibility predicate: prompt long enough for
-        an n-gram and ``draft_len`` slots of cache headroom for verify
+        """Boolean form of ``check_request``: prompt long enough for an
+        n-gram and ``draft_len`` slots of cache headroom for verify
         writes. The serving router and the prefix-cache front end both
         consult this (a request that fails it decodes plain)."""
-        return (prompt_len >= self.ngram
-                and prompt_len + max_new_tokens + self.draft_len
-                <= self.max_seq)
+        try:
+            self.check_request(prompt_len, max_new_tokens)
+            return True
+        except ValueError:
+            return False
 
     def stats(self) -> dict:
         """Cumulative speculation effectiveness (served at /healthz)."""
@@ -134,6 +188,71 @@ class SpecDecodeEngine:
                     "draft_len": self.draft_len,
                     "tokens_per_verify": round(self._emitted
                                                / max(self._verifies, 1), 2)}
+
+    # -- shared verify-step pieces (solo loop + batched loop/segment) --------
+
+    def _draft_row(self, buf, low, total, t_last):
+        """Propose K tokens for ONE row via most-recent n-gram match over
+        ``buf[low:total)`` (``low`` excludes the left-pad prefix — pad
+        garbage must never become draft material). THE draft definition:
+        the solo loop calls it with scalars, the batched paths vmap it
+        with per-row ``low``/``t_last`` — same ops, so a batched row's
+        drafts are bitwise its solo run's."""
+        K, ngram = self.draft_len, self.ngram
+        buflen = buf.shape[0]
+        j_arr = jnp.arange(buflen, dtype=jnp.int32)
+        last = jax.lax.dynamic_slice(buf, (total - ngram,), (ngram,))
+        match = jnp.ones((buflen,), dtype=bool)
+        for t in range(ngram):
+            match = match & (jnp.roll(buf, -t) == last[t])
+        # exclude the current occurrence itself, anything past it,
+        # and the left-pad prefix
+        match = match & (j_arr < total - ngram) & (j_arr >= low)
+        cand = jnp.where(match, j_arr, -1)
+        best = cand.max()
+        found = best >= 0
+        start = jnp.where(found, best + ngram, 0)
+        got = jax.lax.dynamic_slice(buf, (start,), (K,))
+        # fallback: repeat the last token (catches token-loop output)
+        return jnp.where(found, got, jnp.full((K,), t_last, jnp.int32))
+
+    def _accept_patch(self, logits, drafts, step_key,
+                      sampling: SamplingConfig):
+        """[K+1, V] verify logits -> (n_accept, patch_tokens [K+1]).
+
+        ``patch_tokens[j]`` is meaningful for ``j <= n_accept``:
+        accepted drafts then the bonus token. One row's acceptance —
+        shared verbatim between the solo loop and the vmapped batched
+        paths (vmapped per-row RNG draws consume the same bits a solo
+        call with that row's key would — the select_token per-row-key
+        contract)."""
+        K = self.draft_len
+        if sampling.mode == "greedy":
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            hits = (drafts == greedy[:K]).astype(jnp.int32)
+            # greedy[j] is the token after x[j]; the bonus at the first
+            # mismatch position is greedy itself, so patch == greedy
+            return jnp.cumprod(hits).sum(), greedy
+        # THE sampler distribution (engine.sampler_pmf: temperature +
+        # top-k + optional nucleus) — shared with select_token so
+        # acceptance probabilities and the plain sampler cannot drift
+        probs, top_idx = sampler_pmf(logits, sampling)   # [K+1, k]
+        k_acc, k_res = jax.random.split(step_key)
+        in_topk = top_idx[:K] == drafts[:, None]         # [K, k]
+        p_d = (probs[:K] * in_topk).sum(-1)              # [K]
+        u = jax.random.uniform(k_acc, (K,))
+        n_accept = jnp.cumprod((u < p_d).astype(jnp.int32)).sum()
+        # bonus from row n_accept: the residual when a rejection
+        # happened there, the plain pmf when every draft was accepted
+        row_p, row_i = probs[n_accept], top_idx[n_accept]
+        d_rej = drafts[jnp.minimum(n_accept, K - 1)]
+        zero_d = (n_accept < K) & (row_i == d_rej)
+        resid = jnp.where(zero_d, 0.0, row_p)
+        choice = jax.random.categorical(k_res, jnp.log(resid))
+        bonus = row_i[choice].astype(jnp.int32)
+        dr_ext = jnp.concatenate([drafts, jnp.zeros((1,), jnp.int32)])
+        return n_accept, jnp.where(jnp.arange(K + 1) < n_accept,
+                                   dr_ext, bonus)
 
     # -- compiled verify loop ------------------------------------------------
 
@@ -162,71 +281,19 @@ class SpecDecodeEngine:
         is therefore distributed exactly as the plain sampler's — only the
         RNG consumption pattern differs, so seeded streams differ while
         the distribution does not (pinned by the pmf test)."""
-        K, ngram = self.draft_len, self.ngram
-        buflen = buf.shape[0]
-        j_arr = jnp.arange(buflen, dtype=jnp.int32)
+        K = self.draft_len
 
         low = jnp.int32(0) if pad is None else pad[0]
-
-        def draft(buf, total, t_last):
-            """Propose K tokens via most-recent n-gram match."""
-            last = jax.lax.dynamic_slice(buf, (total - ngram,), (ngram,))
-            match = jnp.ones((buflen,), dtype=bool)
-            for t in range(ngram):
-                match = match & (jnp.roll(buf, -t) == last[t])
-            # exclude the current occurrence itself, anything past it,
-            # and the left-pad prefix
-            match = match & (j_arr < total - ngram) & (j_arr >= low)
-            cand = jnp.where(match, j_arr, -1)
-            best = cand.max()
-            found = best >= 0
-            start = jnp.where(found, best + ngram, 0)
-            got = jax.lax.dynamic_slice(buf, (start,), (K,))
-            # fallback: repeat the last token (catches token-loop output)
-            return jnp.where(found, got, jnp.full((K,), t_last, jnp.int32))
-
-        def accept_and_patch(logits, drafts, step_key):
-            """[K+1, V] verify logits -> (n_accept, patch_tokens [K+1]).
-
-            ``patch_tokens[j]`` is meaningful for ``j <= n_accept``:
-            accepted drafts then the bonus token.
-            """
-            if sampling.mode == "greedy":
-                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                hits = (drafts == greedy[:K]).astype(jnp.int32)
-                # greedy[j] is the token after x[j]; the bonus at the first
-                # mismatch position is greedy itself, so patch == greedy
-                return jnp.cumprod(hits).sum(), greedy
-            # THE sampler distribution (engine.sampler_pmf: temperature +
-            # top-k + optional nucleus) — shared with select_token so
-            # acceptance probabilities and the plain sampler cannot drift
-            probs, top_idx = sampler_pmf(logits, sampling)   # [K+1, k]
-            k_acc, k_res = jax.random.split(step_key)
-            in_topk = top_idx[:K] == drafts[:, None]         # [K, k]
-            p_d = (probs[:K] * in_topk).sum(-1)              # [K]
-            u = jax.random.uniform(k_acc, (K,))
-            n_accept = jnp.cumprod((u < p_d).astype(jnp.int32)).sum()
-            # bonus from row n_accept: the residual when a rejection
-            # happened there, the plain pmf when every draft was accepted
-            row_p, row_i = probs[n_accept], top_idx[n_accept]
-            d_rej = drafts[jnp.minimum(n_accept, K - 1)]
-            zero_d = (n_accept < K) & (row_i == d_rej)
-            resid = jnp.where(zero_d, 0.0, row_p)
-            choice = jax.random.categorical(k_res, jnp.log(resid))
-            bonus = row_i[choice].astype(jnp.int32)
-            dr_ext = jnp.concatenate([drafts, jnp.zeros((1,), jnp.int32)])
-            return n_accept, jnp.where(jnp.arange(K + 1) < n_accept,
-                                       dr_ext, bonus)
 
         def body(carry):
             buf, total, cache, emitted, steps, key = carry
             key, step_key = jax.random.split(key)
             t_last = buf[total - 1]
-            drafts = draft(buf, total, t_last)
+            drafts = self._draft_row(buf, low, total, t_last)
             x = jnp.concatenate([t_last[None], drafts])[None, :]  # [1, K+1]
             logits, cache = self._eng._forward_cached(params, x, cache, pad)
-            n_accept, patch_tokens = accept_and_patch(logits[0], drafts,
-                                                      step_key)
+            n_accept, patch_tokens = self._accept_patch(logits[0], drafts,
+                                                        step_key, sampling)
             n_emit = jnp.minimum(n_accept + 1, max_new - emitted)
             # splice the emitted tokens into buf at `total`
             old = jax.lax.dynamic_slice(buf, (total,), (K + 1,))
@@ -249,35 +316,201 @@ class SpecDecodeEngine:
         buf, _, cache, _, steps, _ = jax.lax.while_loop(cond, body, carry)
         return buf, steps, cache
 
+    # -- batched verify loop -------------------------------------------------
+
+    @staticmethod
+    def _roll_cache_rows(cache, shifts):
+        """Per-row signed roll of every cache buffer along the slot axis
+        (``out[.., b, .., j, :] = in[.., b, .., j - shifts[b], :]``, mod
+        buffer size) — the batched rewind/re-sync permutation. A pure
+        gather: values stay bitwise intact, and since a row's positions
+        are ``slot - pad`` with pad shifted by the same amount, the
+        row's math is untouched. Handles plain, fused (placeholder
+        ``v``), and staged (list) cache forms. ``shifts`` is traced —
+        one compiled gather serves every acceptance pattern."""
+        def g(x):
+            if getattr(x, "ndim", 0) <= 1:
+                return x                           # fused placeholder v
+            s = x.shape[-2]
+            idx = (jnp.arange(s)[None, :] - shifts[:, None]) % s  # [B, S]
+            shape = (1, idx.shape[0]) + (1,) * (x.ndim - 4) + (s, 1)
+            return jnp.take_along_axis(x, idx.reshape(shape), axis=-2)
+
+        def one(c: KVCache) -> KVCache:
+            return KVCache(k=g(c.k), v=g(c.v), length=c.length)
+
+        if isinstance(cache, list):
+            return [one(c) for c in cache]
+        return one(cache)
+
+    def _step_b(self, params, sampling: SamplingConfig, budgets, carry):
+        """One batched verify step + per-row rewind/re-sync: the body of
+        both batched programs (full loop and iterbatch segment).
+
+        Carry: ``(buf [B, buflen], total, cache, pad [B], emitted [B],
+        steps, keys [B, 2])``. Invariant (the solo loop's, per row at
+        ONE uniform depth): row b's content is ``buf[b, pad_b:total]``,
+        ``cache.length == total - 1`` with slots ``[pad_b, total - 1)``
+        valid for row b, and the last emitted token is unforwarded.
+
+        ``budgets`` [B] cap each row's TOTAL emission (ghost/finished
+        rows run n_emit = 0 and just carry garbage nobody reads); the
+        cap is the same ``min(n_accept + 1, remaining)`` the solo loop
+        applies at max_new, so a capped row's stream is byte-equal to a
+        solo run with that budget. After acceptance the batch re-syncs
+        at the MINIMAL uniform depth ``max_b(content_len_b)`` — pads
+        absorb the per-row slack, so depth never exceeds the longest
+        row's content and verify writes keep the single-stream headroom
+        bound (``max(plen + budget) + draft_len``)."""
+        buf, total, cache, pad, emitted, steps, keys = carry
+        K = self.draft_len
+        b, buflen = buf.shape
+        if sampling.mode == "greedy":
+            step_keys = keys                       # program never reads them
+        else:
+            pair = jax.vmap(jax.random.split)(keys)        # [B, 2, 2]
+            keys, step_keys = pair[:, 0], pair[:, 1]
+        t_last = buf[:, total - 1]                         # [B]
+        drafts = jax.vmap(
+            lambda bf, lo, tl: self._draft_row(bf, lo, total, tl))(
+                buf, pad, t_last)                          # [B, K]
+        x = jnp.concatenate([t_last[:, None], drafts], axis=1)  # [B, K+1]
+        logits, cache = self._eng._forward_cached(params, x, cache, pad)
+        n_accept, patch = jax.vmap(
+            lambda lg, dr, sk: self._accept_patch(lg, dr, sk, sampling))(
+                logits, drafts, step_keys)
+        n_emit = jnp.clip(n_accept + 1, 0, budgets - emitted)     # [B]
+        old = jax.lax.dynamic_slice(buf, (0, total), (b, K + 1))
+        write = jnp.where(jnp.arange(K + 1)[None, :] < n_emit[:, None],
+                          patch, old)
+        buf = jax.lax.dynamic_update_slice(buf, write, (0, total))
+        # rewind + re-sync: row b keeps n_emit_b of the K+1 verify slots
+        # (t_last + accepted prefix — the solo loop's length formula),
+        # then every row rolls by a signed per-row shift so content ends
+        # at the new uniform depth; the slack lands in the masked pad
+        # prefix and stale verify slots sit beyond the new length until
+        # the next verify overwrites them.
+        content = (total - pad) + n_emit                   # [B] new lens
+        new_total = content.max()
+        new_pad = new_total - content                      # [B] >= 0
+        shifts = new_pad - pad                             # signed
+        bidx = (jnp.arange(buflen)[None, :] - shifts[:, None]) % buflen
+        buf = jnp.take_along_axis(buf, bidx, axis=1)
+        cache = self._roll_cache_rows(cache, shifts)
+        new_len = (new_total - 1).astype(jnp.int32)
+        if isinstance(cache, list):
+            cache = [c._replace(length=new_len) for c in cache]
+        else:
+            cache = cache._replace(length=new_len)
+        return (buf, new_total, cache, new_pad, emitted + n_emit,
+                steps + 1, keys)
+
+    def _loop_b_impl(self, params, first, cache, buf, total, keys, pad, *,
+                     max_new: int, sampling: SamplingConfig):
+        """Batched full-generation loop: ``(buf, pad, total, steps,
+        cache)`` after prefill -> completion. Entry state mirrors the
+        solo loop per row: ``buf[b, pad_b:total]`` holds row b's prompt,
+        ``cache.length == total`` from prefill, ``first`` [B] are the
+        prefill-selected tokens (appended here, making ``cache.length ==
+        total' - 1``). Runs until EVERY row emitted ``max_new``; rows
+        that finish early keep verifying as ghosts (n_emit = 0, content
+        frozen) — harmless by row independence."""
+        b = buf.shape[0]
+        first = first.reshape((b,)).astype(jnp.int32)
+        buf = jax.lax.dynamic_update_slice(buf, first[:, None], (0, total))
+        budgets = jnp.full((b,), max_new, jnp.int32)
+        carry = (buf, total + 1, cache, pad,
+                 jnp.ones((b,), jnp.int32), jnp.int32(0), keys)
+
+        def cond(c):
+            return jnp.any(c[4] < max_new)
+
+        def body(c):
+            return self._step_b(params, sampling, budgets, c)
+
+        buf, total, cache, pad, _, steps, _ = jax.lax.while_loop(
+            cond, body, carry)
+        return buf, pad, total, steps, cache
+
+    def _seg_b_impl(self, params, buf, cache, total, pad, keys, budgets, *,
+                    max_verify: int, sampling: SamplingConfig):
+        """Bounded draft-verify SEGMENT over a live batch (the
+        iteration-level scheduler's spec segment type): up to
+        ``max_verify`` verify steps, stopping early when every row's
+        remaining ``budgets`` [B] are spent. Returns ``(buf, total,
+        cache, pad, emitted [B], steps, keys)`` — the same carry it
+        takes, so segments resume exactly where the last one stopped
+        (per-row key chains included: a row's verify sequence across
+        segments is identical to its uninterrupted solo run)."""
+        b = buf.shape[0]
+        carry = (buf, total, cache, pad,
+                 jnp.zeros((b,), jnp.int32), jnp.int32(0), keys)
+
+        def cond(c):
+            return (c[5] < max_verify) & jnp.any(c[4] < budgets)
+
+        def body(c):
+            return self._step_b(params, sampling, budgets, c)
+
+        return jax.lax.while_loop(cond, body, carry)
+
     # -- public API ----------------------------------------------------------
 
     def generate(self, prompt_ids, max_new_tokens: int,
                  sampling: SamplingConfig = SamplingConfig(),
-                 key: Optional[jax.Array] = None) -> GenerateResult:
-        """Speculative generate: token-exact vs ``DecodeEngine.generate``
-        in greedy mode, distribution-exact (rejection sampling, see
-        ``_loop_impl``) in sample mode. Single-stream only (batches go
-        through DecodeEngine / runtime.batcher).
+                 key: Optional[jax.Array] = None,
+                 pad: Optional[np.ndarray] = None,
+                 delivered: Optional[tuple] = None) -> GenerateResult:
+        """Speculative generate: per row token-exact vs
+        ``DecodeEngine.generate`` in greedy mode, distribution-exact
+        (rejection sampling, see ``_loop_impl``) in sample mode.
+
+        Accepts ``[S]`` / ``[1, S]`` single streams (the original loop,
+        byte-for-byte unchanged), ``[B, S]`` batches, and ragged prompt
+        lists (left-padded); ``pad`` lets pre-padded callers
+        (runtime.batcher) declare their left-pad prefixes, exactly like
+        the plain engine. Batched rows are byte-equal to their solo
+        spec runs: greedy by construction, seeded sampling via per-row
+        key chains (``key`` must then be a ``[B, 2]`` per-row stack —
+        each row's stream is a function of its own key only).
+
+        ``delivered`` (optional ``(requests, tokens)``) overrides the
+        acceptance-stats accounting for bucketing front ends
+        (runtime.batcher): a bucketed round decodes dummy rows and
+        over-decodes short requests to the shared step count, but
+        /healthz's ``tokens_per_verify`` must count what callers were
+        actually served, or the admission and iteration schedulers
+        would report incompatible numbers for the same metric.
         """
+        # the spec flag is routing metadata for the batching front ends;
+        # normalize it away so flagged and unflagged requests share the
+        # same compiled programs (and identical token streams)
+        sampling = dataclasses.replace(sampling, spec=False)
         ids, batch, prompt_len, key, pad = prepare_generate(
             prompt_ids, max_new_tokens, self.max_seq, sampling, key,
-            allow_ragged=False)
-        if batch != 1:
-            raise ValueError("speculative decoding is single-stream "
-                             "(batch=1); batched throughput goes through "
-                             "DecodeEngine / runtime.batcher")
-        if prompt_len < self.ngram:
+            allow_ragged=True, pad=pad)
+        min_plen = prompt_len - (int(pad.max()) if pad.any() else 0)
+        if min_plen < self.ngram:
             raise ValueError(
-                f"prompt_len={prompt_len} shorter than ngram={self.ngram}")
+                f"prompt_len={min_plen} shorter than ngram={self.ngram}")
         # Verify steps write up to draft_len tokens past the final length,
         # so the cache/position headroom check is stricter than the
-        # engine's prompt+new <= max_seq guard.
+        # engine's prompt+new <= max_seq guard. (The batched loop's
+        # uniform depth never exceeds the longest row's content length —
+        # see _step_b — so the single-stream bound covers batches too.)
         total_max = prompt_len + max_new_tokens + self.draft_len
         if total_max > self.max_seq:
             raise ValueError(
                 f"prompt_len + max_new_tokens + draft_len = {total_max} "
                 f"exceeds max_seq={self.max_seq}; verify writes need "
                 "draft_len slots of headroom")
+        if (batch > 1 and sampling.mode != "greedy"
+                and getattr(key, "ndim", 1) != 2):
+            raise ValueError(
+                "batched sample-mode speculation needs a [B, 2] per-row "
+                "key stack (one key per row — the engine._split_keys "
+                "contract; a single joint key cannot be byte-equal to "
+                "per-row solo runs)")
 
         # Chunk-align through the inner engine's shared helper; reserve
         # covers upcoming tokens AND the verify write headroom.
@@ -289,29 +522,95 @@ class SpecDecodeEngine:
         run_params = self._eng._run_params()
 
         t0 = time.perf_counter()
-        prefill_key, loop_key = jax.random.split(key)
+        if batch == 1:
+            if getattr(key, "ndim", 1) == 2:
+                key = key[0]     # a 1-row per-row stack == the solo key
+            prefill_key, loop_key = jax.random.split(key)
+        elif sampling.mode == "greedy":
+            prefill_key = key                    # never consumed by greedy
+            loop_key = jnp.zeros((batch, 2), jnp.uint32)
+        else:
+            pair = jax.vmap(jax.random.split)(key)       # [B, 2, 2]
+            prefill_key, loop_key = pair[:, 0], pair[:, 1]
         if chunk:
             n_chunks = ids_j.shape[1] // chunk
-            chunks = ids_j.reshape(1, n_chunks, chunk).transpose(1, 0, 2)
+            chunks = ids_j.reshape(batch, n_chunks, chunk).transpose(1, 0, 2)
             last_logits, cache = self._eng._prefill_chunked(
                 run_params, chunks,
-                pad_j if pad_j is not None else jnp.zeros((1,), jnp.int32))
+                pad_j if pad_j is not None
+                else jnp.zeros((batch,), jnp.int32))
         else:
             last_logits, cache = self._eng._prefill(run_params, ids_j, pad_j)
         first = select_token(last_logits, sampling, prefill_key)
         first.block_until_ready()
         t1 = time.perf_counter()
 
-        return self.run_loop(run_params, ids_j[0], first, cache, prompt_len,
-                             loop_key, max_new_tokens, sampling,
-                             prefill_seconds=t1 - t0,
-                             pad=pad if pad.any() else None)
+        if batch == 1:
+            return self.run_loop(run_params, ids_j[0], first, cache,
+                                 prompt_len, loop_key, max_new_tokens,
+                                 sampling, prefill_seconds=t1 - t0,
+                                 pad=pad if pad.any() else None,
+                                 delivered=delivered)
+        return self._run_loop_batched(run_params, ids_j, first, cache,
+                                      prompt_len, loop_key, pad,
+                                      max_new_tokens, sampling,
+                                      prefill_seconds=t1 - t0,
+                                      delivered=delivered)
+
+    def _run_loop_batched(self, run_params, ids_j, first, cache,
+                          prompt_len: int, loop_keys, pad,
+                          max_new_tokens: int, sampling: SamplingConfig,
+                          prefill_seconds: float = 0.0,
+                          delivered: Optional[tuple] = None
+                          ) -> GenerateResult:
+        """Run the batched verify loop off a prepared batched prefill
+        state and assemble the result. ``pad`` [B] numpy is each row's
+        left-pad prefix (bucket pad and/or ragged left_pad). The loop's
+        re-syncs keep the batch at the MINIMAL uniform depth, so a pad
+        shared by every row is slid out: the final pad is
+        ``pad_b - min(pad)`` (row content still exactly
+        ``prompt + max_new`` tokens) — the RETURNED pads are the ones
+        reported for output stripping, never the input ones."""
+        batch = ids_j.shape[0]
+        t1 = time.perf_counter()
+        buf = jnp.zeros((batch, self.max_seq + self.draft_len + 1),
+                        jnp.int32)
+        buf = jax.lax.dynamic_update_slice(buf, ids_j, (0, 0))
+        buf, pad_out, total, steps, _ = self._loop_b(
+            run_params, first, cache, buf, jnp.int32(prompt_len),
+            loop_keys, jnp.asarray(pad, dtype=jnp.int32),
+            max_new=max_new_tokens, sampling=sampling)
+        buf = np.asarray(jax.block_until_ready(buf))
+        pad_np = np.asarray(pad_out).astype(np.int32)
+        total_i = int(total)
+        t2 = time.perf_counter()
+
+        steps_i = int(steps)
+        n_req, n_tok = (delivered if delivered is not None
+                        else (batch, batch * max_new_tokens))
+        with self._stats_lock:
+            self._requests += n_req
+            self._verifies += steps_i
+            self._emitted += n_tok
+        from ..utils.metrics import REGISTRY
+        REGISTRY.inc("spec_verify_steps_total", value=steps_i)
+        REGISTRY.inc("spec_emitted_tokens_total", value=n_tok)
+
+        tokens = buf[:, :total_i]
+        return GenerateResult(tokens=tokens, prompt_len=prompt_len,
+                              prefill_seconds=prefill_seconds,
+                              decode_seconds=t2 - t1,
+                              new_tokens=max_new_tokens,
+                              decode_steps=max_new_tokens - 1,
+                              verify_steps=steps_i,
+                              pad=pad_np if pad_np.any() else None)
 
     def run_loop(self, run_params, prompt_row, first, cache,
                  prompt_len: int, loop_key, max_new_tokens: int,
                  sampling: SamplingConfig,
                  prefill_seconds: float = 0.0,
-                 pad=None) -> GenerateResult:
+                 pad=None,
+                 delivered: Optional[tuple] = None) -> GenerateResult:
         """Run the compiled verify loop off a prepared prefill state and
         assemble the result — shared by ``generate`` and the prefix-cache
         front end (runtime.prefix_cache), which produces (first, cache)
@@ -320,7 +619,18 @@ class SpecDecodeEngine:
         ``pad`` ([1] numpy, optional) is the single source of the
         left-pad prefix: the loop's device-side mask derives from it, and
         the result reports it for output stripping — one value, no way to
-        desync the two uses."""
+        desync the two uses.
+
+        ``delivered`` is the same served-(requests, tokens) stats
+        override ``generate`` documents: a bucketing front end's SOLO
+        spec round lands here (batch == 1), and its over-decode past the
+        request's own max_new_tokens is shape tax exactly like the
+        batched path's — without the override /healthz would count the
+        bucketed step total."""
+        # front ends (prefix cache, batchers) may pass a spec-flagged
+        # policy through; the flag is routing metadata — normalize so
+        # flagged and plain calls share one compiled loop per policy
+        sampling = dataclasses.replace(sampling, spec=False)
         pad_j = jnp.asarray(pad) if pad is not None and pad.any() else None
         t1 = time.perf_counter()
         buf = jnp.zeros((self.max_seq + self.draft_len + 1,), jnp.int32)
@@ -333,13 +643,15 @@ class SpecDecodeEngine:
         t2 = time.perf_counter()
 
         steps_i = int(steps)
+        n_req, n_tok = (delivered if delivered is not None
+                        else (1, max_new_tokens))
         with self._stats_lock:
-            self._requests += 1
+            self._requests += n_req
             self._verifies += steps_i
-            self._emitted += max_new_tokens
+            self._emitted += n_tok
         from ..utils.metrics import REGISTRY
         REGISTRY.inc("spec_verify_steps_total", value=steps_i)
-        REGISTRY.inc("spec_emitted_tokens_total", value=max_new_tokens)
+        REGISTRY.inc("spec_emitted_tokens_total", value=n_tok)
 
         tokens = buf[None, :prompt_len + max_new_tokens]
         return GenerateResult(tokens=tokens, prompt_len=prompt_len,

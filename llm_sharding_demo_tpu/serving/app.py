@@ -165,20 +165,16 @@ def create_app(cfg: Optional[ServingConfig] = None,
         raise ValueError(
             f"SPEC_DECODE={cfg.spec_decode} applies to the coordinator's "
             "local decode path only")
-    if cfg.spec_decode > 0 and cfg.max_batch > 1:
-        raise ValueError(
-            "SPEC_DECODE and MAX_BATCH>1 are mutually exclusive: "
-            "speculation is a single-stream latency feature, continuous "
-            "batching a multi-stream throughput one")
     if cfg.prefix_cache > 0:
         if not (cfg.shard_role == "coordinator" and cfg.dispatch == "local"):
             raise ValueError(
                 f"PREFIX_CACHE={cfg.prefix_cache} applies to the "
                 "coordinator's local decode path only")
         # prefix+batching composes (per-row store prefills merged into
-        # one batched decode, runtime.batcher._run_prefix), and
-        # prefix+speculation composes single-stream; the triple is
-        # already refused by the SPEC_DECODE x MAX_BATCH guard above.
+        # one batched decode in admission mode, store-backed admission
+        # prefills in iter mode), and prefix+speculation composes
+        # single-stream AND batched (spec-flagged rounds/batches decode
+        # through the batched verify loop).
     if cfg.ep_decode:
         if not (cfg.shard_role == "coordinator" and cfg.dispatch == "local"):
             raise ValueError("EP_DECODE applies to the coordinator's local "
@@ -205,12 +201,16 @@ def create_app(cfg: Optional[ServingConfig] = None,
         if not (cfg.shard_role == "coordinator" and cfg.dispatch == "local"):
             raise ValueError("BATCH_MODE=iter applies to the coordinator's "
                              "local decode path only")
-        if (cfg.prefix_cache > 0 or cfg.prefill_chunk > 0 or cfg.pp_decode
-                or cfg.ep_decode or cfg.tp_decode or cfg.spec_decode > 0):
+        if (cfg.prefill_chunk > 0 or cfg.pp_decode
+                or cfg.ep_decode or cfg.tp_decode):
+            # SPEC_DECODE composes (draft-verify segments) and
+            # PREFIX_CACHE composes (store-backed admission prefills);
+            # chunked prefill and the mesh/pipeline decoders still own
+            # other program structures
             raise ValueError(
                 "BATCH_MODE=iter drives the single-device engine's "
-                "segment loop; PREFIX_CACHE/PREFILL_CHUNK/PP/EP/"
-                "TP_DECODE/SPEC_DECODE use BATCH_MODE=admission")
+                "segment loop; PREFILL_CHUNK/PP/EP/TP_DECODE use "
+                "BATCH_MODE=admission")
         from ..models import is_window_independent
         if not is_window_independent(config):
             raise ValueError(
@@ -364,22 +364,27 @@ def create_app(cfg: Optional[ServingConfig] = None,
                 chunk=cfg.prefill_chunk or 64, spec=spec_runner)
             runner = prefix_runner
         if cfg.max_batch > 1:
+            base = (prefix_runner.plain if prefix_runner is not None
+                    else runner)
             if cfg.batch_mode == "iter":
                 # iteration-level scheduling: requests join the live
                 # batch at the next decode segment; early-EOS rows free
                 # their slot (runtime.iterbatch; exclusions validated
-                # above, so ``runner`` here is always a DecodeEngine)
+                # above, so ``base`` here is always a DecodeEngine).
+                # SPEC_DECODE batches advance by draft-verify segments;
+                # PREFIX_CACHE backs admission prefills with the store.
                 from ..runtime.iterbatch import IterBatchingEngine
-                runner = IterBatchingEngine(runner,
+                runner = IterBatchingEngine(base,
                                             max_batch=cfg.max_batch,
-                                            max_wait_ms=cfg.batch_wait_ms)
+                                            max_wait_ms=cfg.batch_wait_ms,
+                                            spec=spec_runner,
+                                            prefix=prefix_runner)
             else:
                 from ..runtime.batcher import BatchingEngine
-                base = (prefix_runner.plain if prefix_runner is not None
-                        else runner)
                 runner = BatchingEngine(base, max_batch=cfg.max_batch,
                                         max_wait_ms=cfg.batch_wait_ms,
-                                        prefix=prefix_runner)
+                                        prefix=prefix_runner,
+                                        spec=spec_runner)
     if not partitionable:
         compat_specs = compat_params = None
     else:
@@ -406,7 +411,11 @@ def create_app(cfg: Optional[ServingConfig] = None,
         from ..runtime.iterbatch import IterBatchingEngine as _IB
         if isinstance(runner, _IB):
             # iteration-level scheduler: joins/segments/eos-retires
+            # (spec_segments counts draft-verify segments when
+            # SPEC_DECODE composes)
             live["iter_batch_stats"] = runner.stats()
+            if runner.prefix is not None:
+                live["prefix_cache_stats"] = runner.prefix.stats()
         else:
             # prefix cache: live hit/miss/entries — directly, or through
             # the batcher when PREFIX_CACHE composes with MAX_BATCH>1
@@ -475,17 +484,27 @@ def create_app(cfg: Optional[ServingConfig] = None,
         # prompt at least ngram long and draft_len slots of cache headroom
         # left (greedy is token-exact, sample distribution-exact via
         # rejection sampling). Everything else uses the plain engine —
-        # same weights, just one token per forward. With PREFIX_CACHE on,
-        # the prefix engine IS the entry point and applies the same spec
-        # eligibility internally (runtime.prefix_cache.generate).
+        # same weights, just one token per forward. With PREFIX_CACHE on
+        # (solo), the prefix engine IS the entry point and applies the
+        # same spec eligibility internally (runtime.prefix_cache).
+        # Behind a batching front end (MAX_BATCH>1), routing is the
+        # ``SamplingConfig.spec`` flag: flagged requests gather into
+        # spec-only rounds/batches (policy equality keeps FIFO) and
+        # decode through the batched verify loop.
         eng = runner
-        if (spec_runner is not None and cfg.prefix_cache == 0
-                and spec_runner.eligible(len(prompt_ids),
-                                         req.max_new_tokens)):
-            eng = spec_runner
-        kw = {}
+        import dataclasses as _dc
+
+        from ..runtime.batcher import BatchingEngine as _BE
         from ..runtime.engine import DecodeEngine as _DE
         from ..runtime.iterbatch import IterBatchingEngine as _IB
+        eligible = (spec_runner is not None
+                    and spec_runner.eligible(len(prompt_ids),
+                                             req.max_new_tokens))
+        if eligible and isinstance(runner, (_BE, _IB)):
+            sampling = _dc.replace(sampling, spec=True)
+        elif eligible and cfg.prefix_cache == 0:
+            eng = spec_runner
+        kw = {}
         if eos_id is not None and isinstance(eng, (_DE, _IB)):
             # segment-boundary early exit: stop_at_eos requests stop
             # paying device time for dead tokens past the stop (tokens

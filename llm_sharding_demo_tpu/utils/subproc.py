@@ -29,7 +29,21 @@ def run_filtered(cmd: Sequence[str], *, env: Optional[dict] = None,
                             stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True,
                             errors="replace")
-    timer = threading.Timer(timeout_s, proc.kill)
+    # The callback sets ``killed`` BEFORE the kill, and TimeoutError is
+    # raised only when the flag is set: a child that exited nonzero on
+    # its own just as the timer fired (timer dead, but it never killed
+    # anything) reports its real failure code instead of being
+    # misattributed to the watchdog. ``timer.is_alive()`` alone cannot
+    # distinguish the two — the test pins the race.
+    killed = threading.Event()
+
+    def _watchdog_kill():
+        if proc.poll() is None:   # only a LIVE child can be watchdog-
+            killed.set()          # killed: a child that already exited
+            proc.kill()           # on its own keeps its real rc even
+                                  # when the timer fires before cancel()
+
+    timer = threading.Timer(timeout_s, _watchdog_kill)
     timer.start()
     try:
         assert proc.stdout is not None
@@ -45,8 +59,7 @@ def run_filtered(cmd: Sequence[str], *, env: Optional[dict] = None,
         proc.kill()
         raise
     finally:
-        expired = not timer.is_alive()
         timer.cancel()
-    if rc != 0 and expired:  # a clean exit racing the timer lands below
+    if rc != 0 and killed.is_set():
         raise TimeoutError(f"child exceeded the {timeout_s:g}s watchdog")
     return rc

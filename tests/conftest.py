@@ -52,6 +52,38 @@ def pytest_sessionstart(session):
 
 import pytest  # noqa: E402
 
+# Two-tier suite (ADVICE item 7): ``-m quick`` runs the core serving
+# exactness oracles — the engine/scheduler/speculation/prefix/batching
+# token-exactness contracts every runtime change must hold — in well
+# under 10 minutes cold. The full (unmarked) invocation is the tier-1
+# gate and still runs everything; marking is centralized HERE (by
+# module) so test files don't each carry boilerplate and the tier
+# membership is one reviewable list.
+_QUICK_MODULES = {
+    "test_engine",          # decode engine: streams, EOS, sampling
+    "test_batcher",         # admission batching per-row exactness
+    "test_iterbatch",       # continuous batching + spec/prefix segments
+    "test_spec_decode",     # speculation: solo + batched verify loops
+    "test_prefix_cache",    # cross-request KV reuse byte-exactness
+    "test_chunked_prefill", # chunked ≡ monolithic prefill
+    "test_subproc",         # watchdog attribution (bench/CI harness)
+    "test_tokenizer",       # offline BPE round-trips
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "quick: core exactness oracles (fast tier; "
+                   "run with -m quick, full suite runs unmarked)")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 gate (-m 'not slow')")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module.__name__.rpartition(".")[2] in _QUICK_MODULES:
+            item.add_marker(pytest.mark.quick)
+
 
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():

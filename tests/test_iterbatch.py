@@ -224,6 +224,179 @@ def test_composes_with_staged_engine():
     np.testing.assert_array_equal(resB.tokens[0], wantB)
 
 
+def _spec_setup(max_seq=200, draft_len=5, seg_steps=12, max_batch=4):
+    """A speculative engine + iteration scheduler sharing ONE plain
+    engine (the composition's wiring contract: spec.plain IS the
+    scheduler's engine)."""
+    from llm_sharding_demo_tpu.runtime.spec_decode import SpecDecodeEngine
+    cfg = gpt2.GPT2Config(vocab_size=211, n_positions=256, n_embd=32,
+                          n_layer=2, n_head=4)
+    params = jax.tree.map(lambda x: x * 8.0,
+                          gpt2.init_params(cfg, jax.random.PRNGKey(0)))
+    spec = SpecDecodeEngine(params, cfg, max_seq=max_seq,
+                            draft_len=draft_len)
+    ib = IterBatchingEngine(spec.plain, max_batch=max_batch,
+                            seg_steps=seg_steps, max_wait_ms=50.0,
+                            spec=spec)
+    return spec, ib
+
+
+@pytest.fixture(scope="module")
+def spec_setup():
+    return _spec_setup()
+
+
+SPEC = SamplingConfig(spec=True)
+
+
+def test_spec_segments_mid_flight_join_exact(spec_setup):
+    """THE tentpole bar (ISSUE 1): speculative decoding composes with
+    continuous batching — a spec request arriving mid-decode joins the
+    LIVE speculating batch at a segment boundary, and every row is
+    byte-equal to its solo ``SpecDecodeEngine.generate`` run, whatever
+    per-row acceptance the draft-verify segments produced."""
+    spec, ib = spec_setup
+    rng = np.random.default_rng(31)
+    pA = np.tile(np.asarray([5, 17, 3, 42], np.int32), 6)  # accepts drafts
+    pB = rng.integers(0, 211, size=(9,))                   # mostly rejects
+    wantA = spec.generate(pA, 96).tokens[0]
+    wantB = spec.generate(pB, 40).tokens[0]
+    before = ib.stats()
+    resA, resB = _staggered(ib, [
+        (pA, 96, 0.0, dict(sampling=SPEC)),
+        (pB, 40, _after_segments(ib, before["segments"], 1),
+         dict(sampling=SPEC))])
+    after = ib.stats()
+    np.testing.assert_array_equal(resA.tokens[0], wantA)
+    np.testing.assert_array_equal(resB.tokens[0], wantB)
+    # B joined A's live speculating batch; segments were draft-verify
+    assert after["joins"] - before["joins"] >= 1
+    assert after["batches"] - before["batches"] == 1
+    assert after["spec_segments"] - before["spec_segments"] >= 2
+
+
+def test_spec_sampled_rows_byte_equal_solo_across_segments(spec_setup):
+    """Seeded sample-mode speculation under the scheduler: per-row
+    verify key chains resume across segment boundaries, so a row's
+    stream is byte-equal to its uninterrupted solo run (not merely
+    same-distribution) — the joiner starting its chain at its own
+    step 0."""
+    spec, ib = spec_setup
+    s = SamplingConfig(mode="sample", temperature=0.7, top_k=30, spec=True)
+    pA = np.tile(np.asarray([7, 3], np.int32), 8)
+    pB = np.tile(np.asarray([9, 2, 11], np.int32), 4)
+    kA, kB = jax.random.PRNGKey(61), jax.random.PRNGKey(62)
+    wantA = spec.generate(pA, 60, sampling=s, key=kA).tokens[0]
+    wantB = spec.generate(pB, 24, sampling=s, key=kB).tokens[0]
+    before = ib.stats()
+    resA, resB = _staggered(ib, [
+        (pA, 60, 0.0, dict(sampling=s, key=kA)),
+        (pB, 24, _after_segments(ib, before["segments"], 1),
+         dict(sampling=s, key=kB))])
+    after = ib.stats()
+    np.testing.assert_array_equal(resA.tokens[0], wantA)
+    np.testing.assert_array_equal(resB.tokens[0], wantB)
+    assert after["spec_segments"] - before["spec_segments"] >= 1
+
+
+def test_spec_and_plain_batches_stay_separate(spec_setup):
+    """The ``spec`` flag is part of policy equality: a plain arrival
+    during a spec batch seeds its OWN batch (FIFO preserved) instead of
+    joining — and both finish exact."""
+    spec, ib = spec_setup
+    rng = np.random.default_rng(33)
+    pS = np.tile(np.asarray([4, 19], np.int32), 6)
+    pP = rng.integers(0, 211, size=(7,))
+    wantS = spec.generate(pS, 60).tokens[0]
+    wantP = spec.plain.generate(pP[None, :], 20).tokens[0]
+    before = ib.stats()
+    resS, resP = _staggered(ib, [
+        (pS, 60, 0.0, dict(sampling=SPEC)),
+        (pP, 20, _after_segments(ib, before["segments"], 1), {})])
+    after = ib.stats()
+    np.testing.assert_array_equal(resS.tokens[0], wantS)
+    np.testing.assert_array_equal(resP.tokens[0], wantP)
+    assert after["batches"] - before["batches"] == 2
+
+
+def test_spec_segment_compile_space_bounded(spec_setup):
+    """Acceptance criterion (ISSUE 1): the spec verify/rewind segment
+    program set stays FINITE under varying per-row acceptance — one
+    program per (batch width, max_verify, policy), acceptance counts
+    and budgets being traced values. Several requests with wildly
+    different acceptance profiles at width 1 must share ONE program."""
+    spec, ib = _spec_setup()
+    rng = np.random.default_rng(34)
+    prompts = [np.tile(np.asarray([5, 17, 3, 42], np.int32), 5),
+               rng.integers(0, 211, size=(13,)),
+               np.asarray([8] * 10, np.int32)]
+    for p in prompts:
+        ib.generate(p, 30, sampling=SPEC)
+    widths = 1   # sequential solo requests all ran at right-sized width 1
+    assert spec._seg_b._cache_size() == widths, (
+        f"{spec._seg_b._cache_size()} spec-segment programs for "
+        f"{widths} (width, policy) combo(s) — a shape is being minted "
+        "per acceptance pattern")
+
+
+def test_prefix_cache_admission_prefill_exact():
+    """Satellite (ISSUE 1): iterbatch admission prefills through the
+    prefix store — a joiner whose prompt shares a cached prefix
+    forwards only its suffix, hits the store, and its stream is
+    byte-equal to the solo run."""
+    from llm_sharding_demo_tpu.runtime.prefix_cache import (
+        PrefixCachingEngine)
+    cfg, params, engine = _setup()
+    prefix = PrefixCachingEngine(engine, capacity=4, chunk=16)
+    ib = IterBatchingEngine(engine, max_batch=4, seg_steps=8,
+                            max_wait_ms=50.0, prefix=prefix)
+    rng = np.random.default_rng(35)
+    shared = rng.integers(0, 211, size=(40,))
+    # warm the store (2 chunks of 16 cached; public admission-prefill API)
+    prefix.prefill_state(shared)
+    h0 = prefix.stats()
+    pA = rng.integers(0, 211, size=(45,))   # seeds: depth 48 >= len(shared)
+    pB = shared                             # joiner: warm-prefix admission
+    wantA = engine.generate(pA[None, :], 60).tokens[0]
+    wantB = engine.generate(pB[None, :], 30).tokens[0]
+    before = ib.stats()
+    resA, resB = _staggered(ib, [
+        (pA, 60, 0.0, {}),
+        (pB, 30, _after_segments(ib, before["segments"], 1), {})])
+    after = ib.stats()
+    h1 = prefix.stats()
+    np.testing.assert_array_equal(resA.tokens[0], wantA)
+    np.testing.assert_array_equal(resB.tokens[0], wantB)
+    assert after["joins"] - before["joins"] >= 1
+    assert h1["hits"] > h0["hits"], (
+        "the joiner's admission prefill never consulted the prefix store")
+
+
+def test_spec_validation_gates():
+    """Spec-flagged requests the verify loop cannot serve exactly are
+    refused on the CALLER thread with their own numbers; miswired
+    engines are refused at construction."""
+    from llm_sharding_demo_tpu.runtime.spec_decode import SpecDecodeEngine
+    spec, ib = _spec_setup(max_seq=64, draft_len=4)
+    with pytest.raises(ValueError, match="speculative engine"):
+        IterBatchingEngine(spec.plain, max_batch=2).generate(
+            np.arange(8, dtype=np.int32), 4, sampling=SPEC)
+    with pytest.raises(ValueError, match="shorter than ngram"):
+        ib.generate(np.asarray([5], np.int32), 4, sampling=SPEC)
+    with pytest.raises(ValueError, match="headroom"):
+        ib.generate(np.arange(8, dtype=np.int32), 64 - 8, sampling=SPEC)
+    # spec engine must wrap the SAME DecodeEngine instance
+    cfg, params, other = _setup()
+    with pytest.raises(ValueError, match="same DecodeEngine"):
+        IterBatchingEngine(other, max_batch=2,
+                           spec=_spec_setup(max_seq=64)[0])
+    with pytest.raises(ValueError, match="same engine"):
+        from llm_sharding_demo_tpu.runtime.prefix_cache import (
+            PrefixCachingEngine)
+        IterBatchingEngine(other, max_batch=2,
+                           prefix=PrefixCachingEngine(_setup()[2]))
+
+
 def test_validation_gates():
     from llm_sharding_demo_tpu.models import moe
     cfg, params, engine = _setup()
@@ -309,9 +482,15 @@ def test_serving_batch_mode_iter():
     with _pytest.raises(ValueError, match="MAX_BATCH"):
         create_app(SC(model_id="t", max_seq=48, batch_mode="iter"),
                    model=model, tokenizer=ByteTokenizer())
+    # PREFIX_CACHE now COMPOSES with iter mode (store-backed admission
+    # prefills, ISSUE 1 satellite) — it must construct, while chunked
+    # prefill still refuses loudly (different program structure)
+    create_app(SC(model_id="t", max_seq=48, batch_mode="iter",
+                  max_batch=4, prefix_cache=2),
+               model=model, tokenizer=ByteTokenizer())
     with _pytest.raises(ValueError, match="admission"):
         create_app(SC(model_id="t", max_seq=48, batch_mode="iter",
-                      max_batch=4, prefix_cache=2),
+                      max_batch=4, prefill_chunk=8),
                    model=model, tokenizer=ByteTokenizer())
 
 

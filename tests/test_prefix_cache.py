@@ -124,11 +124,15 @@ def test_serving_prefix_cache_knob(params):
     c1 = combo.post("/generate", json=body)
     assert c1.status_code == 200 and c1.json() == r1.json()
     assert "prefix_cache_stats" in combo.get("/healthz").json()
-    # the triple is refused by the standing SPEC_DECODE x MAX_BATCH guard
-    with pytest.raises(ValueError, match="mutually exclusive"):
-        create_app(ServingConfig(model_id="t", max_seq=64, prefix_cache=2,
-                                 max_batch=4, spec_decode=4),
-                   model=(CFG, params), tokenizer=ByteTokenizer())
+    # the triple composes now (ISSUE 1): spec rounds bypass the store
+    # (batched verify loop), plain solo rounds keep the prefix path —
+    # output identical either way
+    triple = TestClient(create_app(
+        ServingConfig(model_id="t", max_seq=64, prefix_cache=2,
+                      max_batch=4, spec_decode=4),
+        model=(CFG, params), tokenizer=ByteTokenizer()))
+    t1 = triple.post("/generate", json=body)
+    assert t1.status_code == 200 and t1.json() == r1.json()
     with pytest.raises(ValueError, match="local decode path"):
         create_app(ServingConfig(model_id="t", prefix_cache=2,
                                  shard_role="a"),

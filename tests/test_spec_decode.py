@@ -85,13 +85,105 @@ def test_spec_guards(params):
     prompt = np.arange(8, dtype=np.int32)
     with pytest.raises(ValueError, match="PRNG key"):
         spec.generate(prompt, 5, sampling=SamplingConfig(mode="sample"))
-    with pytest.raises(ValueError, match="single-stream"):
-        spec.generate(np.stack([prompt, prompt]), 5)
+    # batched sample-mode needs a [B, 2] per-row key stack: a single
+    # joint key cannot be byte-equal to per-row solo runs
+    with pytest.raises(ValueError, match="per-row"):
+        spec.generate(np.stack([prompt, prompt]), 5,
+                      sampling=SamplingConfig(mode="sample"),
+                      key=jax.random.PRNGKey(0))
     with pytest.raises(ValueError, match="headroom"):
         spec.generate(prompt, 64 - 8)  # fits max_seq but not + draft_len
     with pytest.raises(ValueError, match="shorter than ngram"):
         SpecDecodeEngine(params, CFG, max_seq=64, ngram=3).generate(
             np.arange(2, dtype=np.int32), 5)
+
+
+def _rows(result):
+    """Per-row streams of a batched GenerateResult: strip each row's
+    final left-pad prefix (the batched loop re-syncs at the minimal
+    uniform depth, so the REPORTED pads are the ones to strip)."""
+    b = result.tokens.shape[0]
+    pad = (result.pad if result.pad is not None
+           else np.zeros((b,), dtype=np.int32))
+    return [result.tokens[i, int(pad[i]):] for i in range(b)]
+
+
+def test_spec_batched_greedy_rows_equal_solo_runs(params):
+    """THE composition exactness bar (ISSUE 1): every row of a
+    batch >= 2 speculative generate is byte-equal to its SOLO
+    speculative run (itself pinned equal to plain greedy above). The
+    per-row acceptance + uniform-depth re-sync is a pure permutation of
+    cache slots — never a numeric change — whatever mix of acceptance
+    patterns the rows produce (repetitive rows accept, random rows
+    mostly reject, the mix exercises ragged per-row rewinds)."""
+    spec = SpecDecodeEngine(params, CFG, max_seq=128, draft_len=5)
+    rng = np.random.default_rng(7)
+    prompts = [np.asarray([5, 17, 3, 42] * 4, dtype=np.int32),  # accepts
+               rng.integers(0, CFG.vocab_size, size=(11,))
+                  .astype(np.int32),                            # rejects
+               np.asarray([9] * 6, dtype=np.int32)]             # degenerate
+    want = [spec.generate(p, max_new_tokens=22).tokens[0] for p in prompts]
+    got = spec.generate(prompts, max_new_tokens=22)
+    assert got.tokens.shape[0] == 3
+    for i, (r, w) in enumerate(zip(_rows(got), want)):
+        np.testing.assert_array_equal(r, w, err_msg=f"row {i}")
+
+
+def test_spec_batched_equal_len_rows_equal_solo_runs(params):
+    """Rectangular batch (no ragged pads): same bar, and the reported
+    pads must be all-zero/None so callers strip nothing."""
+    spec = SpecDecodeEngine(params, CFG, max_seq=128, draft_len=4)
+    rng = np.random.default_rng(8)
+    prompts = np.stack([np.asarray([4, 11, 4, 11, 4, 11, 4, 11], np.int32),
+                        rng.integers(0, CFG.vocab_size,
+                                     size=(8,)).astype(np.int32)])
+    want = [spec.generate(p, max_new_tokens=18).tokens[0] for p in prompts]
+    got = spec.generate(prompts, max_new_tokens=18)
+    assert got.pad is None
+    for i, w in enumerate(want):
+        np.testing.assert_array_equal(got.tokens[i], w, err_msg=f"row {i}")
+
+
+def test_spec_batched_seeded_sample_rows_equal_solo_runs(params):
+    """Seeded sample-mode batch: per-row key chains make each row's
+    stream a function of its own key only — byte-equal to the solo
+    speculative run with that key (not merely same-distribution)."""
+    spec = SpecDecodeEngine(params, CFG, max_seq=128, draft_len=4)
+    s = SamplingConfig(mode="sample", temperature=0.8, top_k=12)
+    prompts = [np.asarray([5, 9, 5, 9, 5, 9, 5], dtype=np.int32),
+               np.asarray([3, 1, 4, 1, 5, 9, 2, 6, 5, 3], dtype=np.int32)]
+    keys = [jax.random.PRNGKey(101), jax.random.PRNGKey(202)]
+    want = [spec.generate(p, 15, sampling=s, key=k).tokens[0]
+            for p, k in zip(prompts, keys)]
+    got = spec.generate(prompts, 15, sampling=s, key=jnp.stack(keys))
+    for i, (r, w) in enumerate(zip(_rows(got), want)):
+        np.testing.assert_array_equal(r, w, err_msg=f"row {i}")
+
+
+def test_spec_batched_compile_space_bounded(params):
+    """Acceptance counts are TRACED values: however per-row acceptance
+    varies across requests, the batched verify loop compiles ONE
+    program per (batch width, max_new, policy) — never one per
+    acceptance pattern or prompt content/length (prompt_len enters as a
+    traced scalar). The jit cache size is the direct observable."""
+    spec = SpecDecodeEngine(params, CFG, max_seq=128, draft_len=4)
+    rng = np.random.default_rng(9)
+    batches = [
+        [np.asarray([5, 17, 3, 42] * 3, np.int32),          # high accept
+         rng.integers(0, CFG.vocab_size, size=(12,)).astype(np.int32)],
+        [rng.integers(0, CFG.vocab_size, size=(7,)).astype(np.int32),
+         np.asarray([2] * 9, np.int32)],                    # other mix
+        [np.asarray([8, 3] * 5, np.int32),
+         np.asarray([1, 2, 3] * 4, np.int32)],
+    ]
+    for b in batches:
+        spec.generate(b, max_new_tokens=16)
+    assert spec._loop_b._cache_size() == 1, (
+        f"{spec._loop_b._cache_size()} batched-loop programs for one "
+        "(width, max_new, policy) — a shape is being minted per request")
+    # a different static config (max_new) legitimately adds ONE more
+    spec.generate(batches[0], max_new_tokens=8)
+    assert spec._loop_b._cache_size() == 2
 
 
 def test_spec_sample_topk1_equals_greedy(params, plain):

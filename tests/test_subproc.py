@@ -32,6 +32,90 @@ def test_watchdog_kills_and_raises():
     assert time.monotonic() - t0 < 30  # killed, not waited out
 
 
+def test_expired_timer_that_killed_nothing_reports_child_rc(monkeypatch):
+    """Pins the watchdog-misattribution race (ISSUE 1 satellite): the
+    old code inferred 'watchdog fired' from ``not timer.is_alive()``,
+    so a child exiting nonzero ON ITS OWN just as the timer expired was
+    reported as a TimeoutError, hiding the real failure. The fake timer
+    below reproduces the race deterministically: it looks expired but
+    never killed anything — the child's own rc must come through."""
+    from llm_sharding_demo_tpu.utils import subproc
+
+    class _ExpiredNeverFired:
+        def __init__(self, t, cb):
+            pass
+
+        def start(self):
+            pass
+
+        def cancel(self):
+            pass
+
+        def is_alive(self):
+            return False    # the old misattribution signal
+
+    monkeypatch.setattr(subproc.threading, "Timer", _ExpiredNeverFired)
+    rc = run_filtered([sys.executable, "-c", "import sys; sys.exit(7)"],
+                      timeout_s=60)
+    assert rc == 7          # the child's real failure, not a TimeoutError
+
+
+def test_watchdog_kill_raises_even_while_timer_looks_alive(monkeypatch):
+    """The opposite direction of the same race: when the watchdog DID
+    kill the child, TimeoutError must be raised even if the timer
+    thread still reports alive at cleanup (callback mid-flight). The
+    fake timer fires synchronously inside start() and keeps claiming
+    alive — only the explicit ``killed`` flag can get this right."""
+    from llm_sharding_demo_tpu.utils import subproc
+
+    class _FiresInsideStart:
+        def __init__(self, t, cb):
+            self._cb = cb
+
+        def start(self):
+            self._cb()      # kill immediately: the watchdog "fired"
+
+        def cancel(self):
+            pass
+
+        def is_alive(self):
+            return True     # old code: not expired -> child-rc path
+
+    monkeypatch.setattr(subproc.threading, "Timer", _FiresInsideStart)
+    with pytest.raises(TimeoutError, match="watchdog"):
+        run_filtered([sys.executable, "-c", "import time; time.sleep(60)"],
+                     timeout_s=60)
+
+
+def test_timer_firing_after_own_exit_keeps_child_rc(monkeypatch):
+    """The real-Timer shape of the race: the child exits nonzero ON ITS
+    OWN, and only afterwards does the timer callback run (fired before
+    ``cancel()`` could win). The callback's liveness gate
+    (``proc.poll() is None``) must leave the flag unset — the child's
+    own rc comes through, not a TimeoutError."""
+    from llm_sharding_demo_tpu.utils import subproc
+
+    class _FiresAfterChildExit:
+        def __init__(self, t, cb):
+            self._cb = cb
+
+        def start(self):
+            import time
+            time.sleep(1.5)     # the instant child is certainly dead now
+            self._cb()          # timer fires against an exited child
+
+        def cancel(self):
+            pass
+
+        def is_alive(self):
+            return False
+
+    monkeypatch.setattr(subproc.threading, "Timer", _FiresAfterChildExit)
+    rc = run_filtered([sys.executable, "-c", "import sys; sys.exit(5)"],
+                      timeout_s=60)
+    assert rc == 5
+
+
 def test_stderr_merged_and_filtered(capfd):
     rc = run_filtered(
         [sys.executable, "-c",
