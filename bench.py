@@ -520,7 +520,35 @@ def measure_uncached_jax(config, prompt_len: int, new_tokens: int,
 
 
 FULL_MATRIX_FILE = "BENCH_full.json"
-_COMPACT_DROP = ("note", "traceback_tail")
+_COMPACT_DROP = ("note", "traceback_tail", "metrics_delta")
+
+
+def _metrics_delta(before: dict, after: dict, limit: int = 60) -> dict:
+    """Changed series between two ``REGISTRY.snapshot()`` calls, per
+    bench config row: counters/histograms as deltas, gauges at their
+    final value. Journaled alongside each row's timing so acceptance
+    rates, cache hits, and compile events per config become part of the
+    perf trajectory instead of being lost when the process exits. Kept
+    out of the compact driver line (``_COMPACT_DROP``) — the full
+    matrix file and the progress journal carry it."""
+    from llm_sharding_demo_tpu.utils.metrics import METRIC_CATALOG
+    changed = {}
+    for k, v in sorted(after.items()):
+        if not isinstance(v, (int, float)) or k.endswith("_avg"):
+            continue
+        base = k.split("{", 1)[0]
+        if METRIC_CATALOG.get(base) == "gauge":
+            if before.get(k) != v:
+                changed[k] = v
+        else:
+            d = v - before.get(k, 0)
+            if d:
+                changed[k] = round(d, 6)
+    if len(changed) <= limit:  # exactly-limit rows must not claim truncation
+        return changed
+    out = dict(list(changed.items())[:limit])
+    out["truncated"] = True
+    return out
 
 
 def emit(payload: dict, write_file: bool = True) -> None:
@@ -1106,12 +1134,18 @@ def main() -> None:
     # and the rest of the matrix still reports.
     def safe(name: str, fn) -> None:
         import traceback
+
+        from llm_sharding_demo_tpu.utils.metrics import REGISTRY
+        before = REGISTRY.snapshot()
         try:
             row = {"name": name, **fn()}
         except Exception as e:  # noqa: BLE001 — report, don't die
             row = {"name": name, "error": f"{type(e).__name__}: {e}",
                    "traceback_tail":
                        traceback.format_exc().strip()[-600:]}
+        delta = _metrics_delta(before, REGISTRY.snapshot())
+        if delta:
+            row["metrics_delta"] = delta
         configs.append(row)
         _journal_row(row)
 

@@ -43,12 +43,14 @@ from __future__ import annotations
 import dataclasses
 import queue
 import threading
+import time
 from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import tracing
 from ..utils.metrics import REGISTRY
 from .engine import DecodeEngine, GenerateResult, SamplingConfig
 
@@ -74,6 +76,11 @@ class _Request:
     result: Optional[np.ndarray] = None   # [prompt+new] tokens
     timing: Optional[GenerateResult] = None  # the batch's engine result
     error: Optional[Exception] = None
+    # request-trace propagation: the caller's ambient RequestTrace rides
+    # the queue so the worker can attribute queue wait and the shared
+    # round phases (via tracing.fanout) to every row it serves
+    trace: Optional[object] = None
+    t_submit: float = 0.0
 
 
 class BatchingEngine:
@@ -167,8 +174,12 @@ class BatchingEngine:
                     "construction)")
             self.spec.check_request(len(prompt), max_new_tokens)
         req = _Request(prompt=prompt, max_new_tokens=max_new_tokens,
-                       sampling=sampling, key=key)
+                       sampling=sampling, key=key,
+                       trace=tracing.current_trace(),
+                       t_submit=time.perf_counter())
         self._queue.put(req)
+        REGISTRY.gauge("queue_depth", self._queue.qsize(),
+                       scheduler="admission")
         if not req.done.wait(timeout):
             raise TimeoutError("batched generate timed out")
         if req.error is not None:
@@ -310,7 +321,11 @@ class BatchingEngine:
         t0 = _monotonic()
         states = []
         for req in batch:
-            logits, cache, _ = self.prefix.prefill_state(req.prompt)
+            # per-row store prefill: attribute THIS row's span to its own
+            # trace, not the whole round's (the batched decode below
+            # still fans out to everyone)
+            with tracing.use_trace(req.trace):
+                logits, cache, _ = self.prefix.prefill_state(req.prompt)
             states.append((logits, cache))
         while len(states) < ids.shape[0]:        # dummy rows replicate last
             # (their pad/ids were already replicated from the same source
@@ -329,6 +344,21 @@ class BatchingEngine:
             batch[0].sampling, ids.shape[1], _monotonic() - t0)
 
     def _run(self, batch: List[_Request]):
+        """Trace plumbing around ``_run_inner``: queue wait is stamped
+        per request, then the round's shared device phases (the engine's
+        prefill/decode spans) fan out into every row's trace."""
+        t_now = time.perf_counter()
+        traces = [r.trace for r in batch if r.trace is not None]
+        for r in batch:
+            if r.trace is not None:
+                r.trace.add_span("queue_wait", r.t_submit, t_now,
+                                 scheduler="admission")
+        ctx = (tracing.use_trace(tracing.fanout(traces)) if traces
+               else tracing.use_trace(None))
+        with ctx:
+            self._run_inner(batch)
+
+    def _run_inner(self, batch: List[_Request]):
         if batch[0].sampling.spec:
             # spec-flagged rounds (any size, solo included — the stream
             # must be a pure function of the request, never of whether a
@@ -422,6 +452,11 @@ class BatchingEngine:
         REGISTRY.inc("decode_batches_total")
         REGISTRY.inc("batched_requests_total", value=len(batch))
         REGISTRY.inc("batched_rows_padded_total", value=padded_rows)
+        REGISTRY.gauge("batch_occupancy",
+                       round(len(batch) / (len(batch) + padded_rows), 4),
+                       scheduler="admission")
+        REGISTRY.gauge("queue_depth", self._queue.qsize(),
+                       scheduler="admission")
         for i, req in enumerate(batch):
             # row_tokens strips the engine-reported pad — OUR bucket pad
             # plus any chunk-alignment pad the engine added on top

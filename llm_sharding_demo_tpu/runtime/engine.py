@@ -40,6 +40,8 @@ import numpy as np
 
 from ..models.gpt2 import GPT2Config, Params
 from ..ops.attention import KVCache
+from ..utils import tracing
+from ..utils.metrics import REGISTRY, CompileWatch
 
 # Reference sampler constants (server.py:188, 191).
 REF_TEMPERATURE = 0.6
@@ -615,6 +617,24 @@ class DecodeEngine:
         # shape).
         self._decode_seg = jax.jit(self._decode_seg_impl, donate_argnums=(2,),
                                    static_argnames=("sampling", "window"))
+        # compile-event accounting (utils.metrics.CompileWatch): every NEW
+        # program entering these caches increments compile_events_total
+        # with a phase label — checked after invocations, off the hot
+        # device path, so compile storms are observable as counter bursts.
+        self._compile_watches = (CompileWatch("prefill", self._prefill),
+                                 CompileWatch("prefill",
+                                              self._prefill_chunked),
+                                 CompileWatch("decode", self._decode_seg))
+
+    def _note_compiles(self) -> None:
+        """Diff the jitted program caches into ``compile_events_total``
+        and refresh the program-count gauge. Called after generate phases
+        (and by the iteration scheduler after its segment dispatches)."""
+        for w in self._compile_watches:
+            w.check()
+        REGISTRY.gauge("jit_program_cache_size",
+                       sum(w._seen for w in self._compile_watches),
+                       component="engine")
 
     # -- compiled programs ---------------------------------------------------
 
@@ -914,6 +934,11 @@ class DecodeEngine:
         first = select_token(last_logits, sampling, prefill_key)
         first.block_until_ready()
         t1 = time.perf_counter()
+        tracing.record("prefill", t0, t1, batch=batch,
+                       prompt_len=prompt_len, chunked=bool(chunk))
+        REGISTRY.gauge("kv_cache_slots_in_use",
+                       batch * (prompt_len + max_new_tokens),
+                       component="engine")
         return self._decode_and_pack(run_params, ids, pad, pad_j, first,
                                      cache, decode_key, max_new_tokens,
                                      sampling, prompt_len, t1 - t0,
@@ -970,6 +995,12 @@ class DecodeEngine:
         del cache  # last segment's output aliases the donated prefill cache
         new = np.asarray(jax.block_until_ready(jnp.concatenate(parts, axis=1)))
         t2 = time.perf_counter()
+        tracing.record("decode", t1, t2, batch=new.shape[0],
+                       steps=new.shape[1], segments=len(segs))
+        self._note_compiles()
+        # generation done: its cache reservation is released (an idle
+        # server must not keep reporting the last request's slots)
+        REGISTRY.gauge("kv_cache_slots_in_use", 0, component="engine")
 
         tokens = np.concatenate([ids, new], axis=1)
         return GenerateResult(tokens=tokens, prompt_len=prompt_len,

@@ -91,6 +91,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.attention import KVCache
+from ..utils import tracing
 from ..utils.metrics import REGISTRY
 from .batcher import _round_up
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
@@ -120,6 +121,11 @@ class _Req:
     # retirement pass instead of decoding dead tokens for nobody.
     cancelled: threading.Event = dataclasses.field(
         default_factory=threading.Event)
+    # request-trace propagation (caller's ambient RequestTrace): the
+    # scheduler stamps queue wait, the admission prefill, and every
+    # decode segment the row rode into it
+    trace: Optional[object] = None
+    t_submit: float = 0.0
 
     def fail(self, e: Exception) -> None:
         """Deliver an error exactly once (idempotent across the several
@@ -309,8 +315,12 @@ class IterBatchingEngine:
                     "construction)")
             self.spec.check_request(len(prompt), max_new_tokens)
         req = _Req(prompt=prompt, max_new_tokens=max_new_tokens,
-                   sampling=sampling, key=key, eos_id=eos_id)
+                   sampling=sampling, key=key, eos_id=eos_id,
+                   trace=tracing.current_trace(),
+                   t_submit=time.perf_counter())
         self._queue.put(req)
+        REGISTRY.gauge("queue_depth", self._queue.qsize(),
+                       scheduler="iter")
         if not req.done.wait(timeout):
             # Cancel, don't just abandon: the scheduler skips cancelled
             # requests at dequeue and retires a cancelled live row at the
@@ -443,11 +453,19 @@ class IterBatchingEngine:
         pad_j = jnp.asarray(pad)
 
         t0 = time.monotonic()
+        sp0 = time.perf_counter()
         run_params = eng._run_params()
         last_logits, cache = eng._prefill(run_params, ids_j, pad_j)
         sampling = seed[0].sampling
         first, pks, dks = self._first_tokens(
             last_logits, sampling, [r.key for r in seed], b)
+        sp1 = time.perf_counter()
+        for r in seed:
+            if r.trace is not None:
+                r.trace.add_span("queue_wait", r.t_submit, sp0,
+                                 scheduler="iter")
+                r.trace.add_span("prefill", sp0, sp1, kind="seed",
+                                 width=b, prompt_len=len(r.prompt))
 
         state = _BatchState(sampling, first, cache, pad_j, s_max)
         if spec_mode:
@@ -474,7 +492,9 @@ class IterBatchingEngine:
         with self._stats_lock:
             self.batches_run += 1
         REGISTRY.inc("iter_batches_total")
+        self.engine._note_compiles()
         self._retire_finished(state)      # max_new_tokens == 1 rows
+        self._set_gauges(state)
         return state
 
     def _fits(self, reqs: List[_Req]) -> bool:
@@ -589,6 +609,10 @@ class IterBatchingEngine:
         eng = self.engine
         plen = len(req.prompt)
         t0 = time.monotonic()
+        p0 = time.perf_counter()
+        if req.trace is not None:
+            req.trace.add_span("queue_wait", req.t_submit, p0,
+                               scheduler="iter")
         if self.prefix is not None:
             # admission prefill through the prefix store: a joiner whose
             # prompt shares a cached prefix forwards only its suffix (and
@@ -596,7 +620,10 @@ class IterBatchingEngine:
             # right-aligned — content at [0, plen), no pad — so the merge
             # roll below uses sp = plen. Byte-exact: store replay equals
             # a cold prefill (pinned by tests/test_prefix_cache.py).
-            logits, solo, sp = self.prefix.prefill_state(req.prompt)
+            # prefill_state records this row's prefill span (with prefix
+            # hit/miss annotations) into the ambient trace.
+            with tracing.use_trace(req.trace):
+                logits, solo, sp = self.prefix.prefill_state(req.prompt)
         else:
             sp = min(_round_up(plen, self.prompt_bucket), state.depth)
             if sp < plen:   # bucket would overshoot current depth: exact
@@ -606,6 +633,10 @@ class IterBatchingEngine:
             logits, solo = eng._prefill(eng._run_params(),
                                         jnp.asarray(ids),
                                         jnp.asarray([sp - plen], jnp.int32))
+            if req.trace is not None:
+                req.trace.add_span("prefill", p0, time.perf_counter(),
+                                   kind="admit", depth=state.depth,
+                                   prompt_len=plen)
         sampling = state.sampling
         if sampling.mode == "greedy":
             first = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
@@ -644,6 +675,19 @@ class IterBatchingEngine:
 
     # -- the segment step ----------------------------------------------------
 
+    def _set_gauges(self, state: _BatchState) -> None:
+        """Live-state gauges, refreshed at every scheduling decision
+        point (seed, segment boundary): what the batch looks like NOW."""
+        live = sum(1 for s in state.slots if s is not None)
+        width = len(state.slots)
+        REGISTRY.gauge("iter_live_rows", live)
+        REGISTRY.gauge("batch_occupancy", round(live / max(width, 1), 4),
+                       scheduler="iter")
+        REGISTRY.gauge("kv_cache_slots_in_use", state.depth * live,
+                       component="iter")
+        REGISTRY.gauge("queue_depth", self._queue.qsize(),
+                       scheduler="iter")
+
     def _advance(self, state: _BatchState):
         if state.spec_mode:
             return self._advance_spec(state)
@@ -653,12 +697,15 @@ class IterBatchingEngine:
         assert n >= 1, "active rows past max_seq (admission bug)"
         window = eng._decode_window(d + n)   # shared bucket policy
         step_keys = self._segment_keys(state, n)
+        t0 = time.perf_counter()
         out, state.cache = eng._decode_seg(
             eng._run_params(), state.token, state.cache, state.pad_j,
             step_keys, sampling=state.sampling, window=window)
         state.token = out[:, -1]
         state.depth = d + n
         seg = _SegOut(out)
+        t1 = time.perf_counter()
+        eng._note_compiles()
         with self._stats_lock:
             self.segments_run += 1
         REGISTRY.inc("iter_segments_total")
@@ -666,7 +713,14 @@ class IterBatchingEngine:
             if s is not None:
                 s.segs.append((seg, n))
                 s.emitted += n
+                if s.req.trace is not None:
+                    # dispatch wall time (segments queue asynchronously
+                    # on the device — the serving-thread view)
+                    s.req.trace.add_span("decode", t0, t1, seg=True,
+                                         steps=n, width=len(state.slots),
+                                         depth=state.depth)
         self._retire_finished(state)
+        self._set_gauges(state)
 
     def _advance_spec(self, state: _BatchState):
         """One draft-verify SEGMENT (spec batches): up to
@@ -691,6 +745,7 @@ class IterBatchingEngine:
             if s is not None:
                 budgets[i] = max(s.req.max_new_tokens - s.emitted, 0)
         max_verify = max(1, self.seg_steps // (K + 1))
+        t0 = time.perf_counter()
         # the spec flag is routing metadata: normalize it out of the
         # static sampling arg so the segment program is shared with (and
         # byte-identical to) the solo spec engine's acceptance math
@@ -711,22 +766,28 @@ class IterBatchingEngine:
         with self._stats_lock:
             self.segments_run += 1
             self.spec_segments_run += 1
-        # acceptance stats flow to the spec engine too, so /healthz's
-        # spec_decode_stats stays live under the iteration scheduler
-        with self.spec._stats_lock:
-            self.spec._verifies += steps_i
-            self.spec._emitted += int(emitted_np.sum())
+        # acceptance stats flow through the spec engine's one accounting
+        # path (counters + /healthz stats + the acceptance-rate gauge),
+        # so solo-spec and spec x iterbatch modes cannot diverge;
+        # requests are counted at retirement (_deliver), hence 0 here
+        self.spec._update_stats(0, int(emitted_np.sum()), steps_i)
         REGISTRY.inc("iter_segments_total")
         REGISTRY.inc("iter_spec_segments_total")
-        REGISTRY.inc("spec_verify_steps_total", value=steps_i)
-        REGISTRY.inc("spec_emitted_tokens_total",
-                     value=int(emitted_np.sum()))
+        self.spec._note_compiles()
+        t1 = time.perf_counter()
         for s in state.slots:
             if s is not None:
                 s.emitted += int(emitted_np[s.row])
                 s.spec_buf = seg
                 s.spec_pad = int(pad_np[s.row])
+                if s.req.trace is not None:
+                    s.req.trace.add_span(
+                        "decode", t0, t1, seg=True, spec=True,
+                        verify_steps=steps_i,
+                        emitted=int(emitted_np[s.row]),
+                        width=len(state.slots), depth=state.depth)
         self._retire_finished(state)
+        self._set_gauges(state)
 
     def _segment_keys(self, state: _BatchState, n: int):
         """[n, B, 2] per-step keys. Sample rows consume THEIR OWN step

@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import tracing
 from ..utils.metrics import REGISTRY
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
                      prepare_generate, select_token)
@@ -175,11 +176,16 @@ class PrefixCachingEngine:
             REGISTRY.inc("prefix_cache_hits_total")
             REGISTRY.inc("prefix_cache_reused_tokens_total",
                          value=m_hit * self.chunk)
+            # mark the enclosing prefill span (request trace) so a
+            # flight-recorder timeline shows hit depth, not just speed
+            tracing.annotate_span(prefix_hit=True,
+                                  reused_tokens=m_hit * self.chunk)
             cache = entry
         else:
             with self._store_lock:
                 self.misses += 1
             REGISTRY.inc("prefix_cache_misses_total")
+            tracing.annotate_span(prefix_hit=False)
             cache = self._eng._fresh_cache(1)
 
         # extend chunk by chunk (one shared program), snapshotting the
@@ -213,7 +219,9 @@ class PrefixCachingEngine:
         cache (safe to donate)."""
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         with self._lock:
-            logits, cache = self._prefill_walk(prompt, len(prompt))
+            with tracing.span("prefill", prefix=True,
+                              prompt_len=len(prompt)):
+                logits, cache = self._prefill_walk(prompt, len(prompt))
         return logits[:, -1], cache, len(prompt)
 
     def generate(self, prompt_ids, max_new_tokens: int,
@@ -231,11 +239,13 @@ class PrefixCachingEngine:
 
         with self._lock:
             t0 = time.perf_counter()
-            logits, cache = self._prefill_walk(prompt, prompt_len)
+            with tracing.span("prefill", prefix=True,
+                              prompt_len=prompt_len):
+                logits, cache = self._prefill_walk(prompt, prompt_len)
 
-            prefill_key, decode_key = jax.random.split(key)
-            first = select_token(logits[:, -1], sampling, prefill_key)
-            first.block_until_ready()
+                prefill_key, decode_key = jax.random.split(key)
+                first = select_token(logits[:, -1], sampling, prefill_key)
+                first.block_until_ready()
             prefill_seconds = time.perf_counter() - t0
 
             spec = self._spec

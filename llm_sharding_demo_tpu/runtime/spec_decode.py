@@ -76,6 +76,8 @@ import numpy as np
 
 from ..models.gpt2 import GPT2Config, Params
 from ..ops.attention import KVCache
+from ..utils import tracing
+from ..utils.metrics import REGISTRY, CompileWatch
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
                      prepare_generate, sampler_pmf, select_token)
 
@@ -142,6 +144,32 @@ class SpecDecodeEngine:
         self._seg_b = jax.jit(self._seg_b_impl,
                               static_argnames=("max_verify", "sampling"),
                               donate_argnums=(1, 2))
+        # compile-event accounting (one increment per NEW (width, policy)
+        # program — see utils.metrics.CompileWatch); the iteration
+        # scheduler checks the segment watch after its dispatches
+        self._compile_watches = (CompileWatch("spec_loop", self._loop),
+                                 CompileWatch("spec_loop", self._loop_b),
+                                 CompileWatch("spec_seg", self._seg_b))
+
+    def _note_compiles(self) -> None:
+        self._eng._note_compiles()   # the shared prefill programs
+        for w in self._compile_watches:
+            w.check()
+        REGISTRY.gauge("jit_program_cache_size",
+                       sum(w._seen for w in self._compile_watches),
+                       component="spec")
+
+    def _update_stats(self, n_req: int, n_tok: int, steps: int) -> None:
+        """Shared acceptance accounting: cumulative /healthz stats,
+        counters, and the live acceptance-rate gauge."""
+        with self._stats_lock:
+            self._requests += n_req
+            self._verifies += steps
+            self._emitted += n_tok
+            rate = self._emitted / max(self._verifies, 1)
+        REGISTRY.inc("spec_verify_steps_total", value=steps)
+        REGISTRY.inc("spec_emitted_tokens_total", value=n_tok)
+        REGISTRY.gauge("spec_acceptance_rate", round(rate, 4))
 
     @property
     def plain(self) -> DecodeEngine:
@@ -544,6 +572,8 @@ class SpecDecodeEngine:
         first = select_token(last_logits, sampling, prefill_key)
         first.block_until_ready()
         t1 = time.perf_counter()
+        tracing.record("prefill", t0, t1, batch=batch,
+                       prompt_len=prompt_len, chunked=bool(chunk))
 
         if batch == 1:
             return self.run_loop(run_params, ids_j[0], first, cache,
@@ -588,13 +618,11 @@ class SpecDecodeEngine:
         steps_i = int(steps)
         n_req, n_tok = (delivered if delivered is not None
                         else (batch, batch * max_new_tokens))
-        with self._stats_lock:
-            self._requests += n_req
-            self._verifies += steps_i
-            self._emitted += n_tok
-        from ..utils.metrics import REGISTRY
-        REGISTRY.inc("spec_verify_steps_total", value=steps_i)
-        REGISTRY.inc("spec_emitted_tokens_total", value=n_tok)
+        self._update_stats(n_req, n_tok, steps_i)
+        tracing.record("decode", t1, t2, spec=True, batch=batch,
+                       verify_steps=steps_i,
+                       emitted=batch * max_new_tokens)
+        self._note_compiles()
 
         tokens = buf[:, :total_i]
         return GenerateResult(tokens=tokens, prompt_len=prompt_len,
@@ -645,13 +673,10 @@ class SpecDecodeEngine:
         steps_i = int(steps)
         n_req, n_tok = (delivered if delivered is not None
                         else (1, max_new_tokens))
-        with self._stats_lock:
-            self._requests += n_req
-            self._verifies += steps_i
-            self._emitted += n_tok
-        from ..utils.metrics import REGISTRY
-        REGISTRY.inc("spec_verify_steps_total", value=steps_i)
-        REGISTRY.inc("spec_emitted_tokens_total", value=n_tok)
+        self._update_stats(n_req, n_tok, steps_i)
+        tracing.record("decode", t1, t2, spec=True, batch=1,
+                       verify_steps=steps_i, emitted=max_new_tokens)
+        self._note_compiles()
 
         tokens = buf[None, :prompt_len + max_new_tokens]
         return GenerateResult(tokens=tokens, prompt_len=prompt_len,

@@ -41,6 +41,7 @@ from ..models import gpt2
 from ..parallel import partition as P_
 from ..parallel.pipeline import PipelineRunner
 from ..runtime.engine import REF_TEMPERATURE, REF_TOP_K, SamplingConfig
+from ..utils import tracing
 from ..utils.config import ServingConfig, from_env
 from ..utils.metrics import REGISTRY
 from ..utils.tracing import timed
@@ -98,11 +99,17 @@ class GenerateReq(BaseModel):
 
 
 def create_app(cfg: Optional[ServingConfig] = None,
-               model=None, tokenizer=None) -> JSONApp:
+               model=None, tokenizer=None,
+               registry=None, recorder=None) -> JSONApp:
     """Build the app. ``model=(config, params)`` / ``tokenizer`` injectable
     for tests; by default resolved via ``serving.loader`` / HF-or-byte
-    tokenizer."""
+    tokenizer. ``registry`` (utils.metrics.MetricsRegistry) and
+    ``recorder`` (utils.tracing.FlightRecorder) are likewise injectable —
+    tests can assert the app-level series/traces without touching the
+    process-global defaults."""
     cfg = cfg or from_env()
+    reg = registry if registry is not None else REGISTRY
+    rec = recorder if recorder is not None else tracing.RECORDER
     # multi-host glue sits HERE, where every entry path converges (CLI,
     # `serving.app:app` lazy attribute, tests) — it must run before the
     # first backend use, i.e. before the model loads. No-op when the
@@ -401,9 +408,28 @@ def create_app(cfg: Optional[ServingConfig] = None,
     @app.get("/metrics")
     def metrics():
         # Prometheus text exposition (the reference has no metrics at all,
-        # SURVEY.md §5): request counters + latency histograms from
-        # utils.metrics.REGISTRY.
-        return REGISTRY.prometheus()
+        # SURVEY.md §5): request counters, gauges + latency histograms.
+        return reg.prometheus()
+
+    def _topology() -> dict:
+        """The decode topology/composition ACTUALLY serving /generate —
+        the single source for /healthz and the flight-recorder header
+        (/debug/requests), so the two can never disagree."""
+        return {
+            "role": cfg.shard_role,
+            "model": cfg.model_id,
+            "n_stages": decode_stages,
+            "dispatch": cfg.dispatch,
+            "max_batch": cfg.max_batch,
+            "batch_mode": cfg.batch_mode,
+            "inference_dtype": cfg.inference_dtype,
+            "spec_decode": cfg.spec_decode,
+            "prefill_chunk": cfg.prefill_chunk,
+            "prefix_cache": cfg.prefix_cache,
+            "pp_decode": cfg.pp_decode,
+            "ep_decode": cfg.ep_decode,
+            "tp_decode": cfg.tp_decode,
+        }
 
     @app.get("/healthz")
     def healthz():
@@ -429,20 +455,28 @@ def create_app(cfg: Optional[ServingConfig] = None,
         return {
             **live,
             "status": "ok",
-            "role": cfg.shard_role,
-            "model": cfg.model_id,
-            "n_stages": decode_stages,
-            "dispatch": cfg.dispatch,
-            "max_batch": cfg.max_batch,
-            "batch_mode": cfg.batch_mode,
-            "inference_dtype": cfg.inference_dtype,
-            "spec_decode": cfg.spec_decode,
-            "prefill_chunk": cfg.prefill_chunk,
-            "prefix_cache": cfg.prefix_cache,
-            "pp_decode": cfg.pp_decode,
-            "ep_decode": cfg.ep_decode,
-            "tp_decode": cfg.tp_decode,
+            **_topology(),
             "devices": [str(d) for d in jax.devices()],
+        }
+
+    @app.get("/debug/requests")
+    def debug_requests(query: dict):
+        """Flight recorder: JSON span timelines of the last N completed
+        /generate requests (bounded ring — see utils.tracing.
+        FlightRecorder). ``?n=K`` caps the rows returned, ``?slowest=1``
+        orders by duration instead of recency — the view that answers
+        "where did that slow request's time go" without a profiler."""
+        try:
+            n = int(query.get("n", "32"))
+        except ValueError:
+            return 422, {"detail": "n must be an integer"}
+        slowest = query.get("slowest", "").lower() in ("1", "true", "yes")
+        return {
+            "serving": _topology(),
+            "capacity": rec.capacity,
+            "recorded": len(rec),
+            "order": "slowest" if slowest else "newest",
+            "requests": rec.snapshot(n=n, slowest=slowest),
         }
 
     @app.post("/forward")
@@ -593,77 +627,151 @@ def create_app(cfg: Optional[ServingConfig] = None,
         return ids
 
     @app.post("/generate")
-    def generate(req: GenerateReq):
+    def generate(req: GenerateReq, headers: dict):
+        # Request identity: honor an X-Request-ID the caller sent, mint
+        # one otherwise; every response (errors included) echoes it as a
+        # response header — the BODY stays wire-parity with the
+        # reference ({"generated": ...}, server.py:210). Caller-supplied
+        # ids are restricted to a safe charset: the id is interpolated
+        # into the structured log line and echoed as a header, so a
+        # quote/newline-bearing value would corrupt both (the same
+        # injection class _escape_label_value fixes for /metrics).
+        import re as _re
+        raw_rid = (headers.get("x-request-id") or "").strip()
+        rid = (raw_rid if _re.fullmatch(r"[A-Za-z0-9._:-]{1,128}", raw_rid)
+               else tracing.new_request_id())
+        hdrs = {"X-Request-ID": rid}
+
+        def out(body, status=200):
+            return status, body, hdrs
+
         if cfg.shard_role != "coordinator":
-            return {"error": "This instance is not coordinator."}
+            return out({"error": "This instance is not coordinator."})
         if req.max_new_tokens < 1:
-            return {"error": "max_new_tokens must be >= 1"}
-        prompt_ids = tokenizer.encode(req.prompt)
+            return out({"error": "max_new_tokens must be >= 1"})
+        trace = tracing.RequestTrace(rid, mode=req.mode,
+                                     dispatch=cfg.dispatch)
+        with trace.span("tokenize"):
+            prompt_ids = tokenizer.encode(req.prompt)
         if not prompt_ids:
-            return {"error": "prompt tokenized to zero tokens"}
+            return out({"error": "prompt tokenized to zero tokens"})
         if len(prompt_ids) + req.max_new_tokens > cfg.max_seq:
-            return {"error": f"prompt ({len(prompt_ids)} tokens) + "
-                             f"max_new_tokens ({req.max_new_tokens}) exceeds "
-                             f"max_seq ({cfg.max_seq})"}
+            return out({"error": f"prompt ({len(prompt_ids)} tokens) + "
+                        f"max_new_tokens ({req.max_new_tokens}) exceeds "
+                        f"max_seq ({cfg.max_seq})"})
         if req.mode not in ("sample", "greedy"):
-            return {"error": f"unknown mode {req.mode!r}"}
+            return out({"error": f"unknown mode {req.mode!r}"})
         if req.mode == "sample":
             if req.temperature <= 0:
-                return {"error": "temperature must be > 0"}
+                return out({"error": "temperature must be > 0"})
             if not 1 <= req.top_k <= config.vocab_size:
-                return {"error": f"top_k must be in [1, {config.vocab_size}]"}
+                return out(
+                    {"error": f"top_k must be in [1, {config.vocab_size}]"})
             if not 0.0 < req.top_p <= 1.0:
-                return {"error": "top_p must be in (0, 1]"}
+                return out({"error": "top_p must be in (0, 1]"})
         eos_id = None
         if req.stop_at_eos or req.eos_token_id is not None:
             eos_id = (req.eos_token_id if req.eos_token_id is not None
                       else getattr(tokenizer, "eos_token_id", None))
             if eos_id is None:
-                return {"error": "stop_at_eos requested but the tokenizer "
-                                 "has no eos_token_id; pass eos_token_id "
-                                 "explicitly"}
+                return out({"error": "stop_at_eos requested but the "
+                            "tokenizer has no eos_token_id; pass "
+                            "eos_token_id explicitly"})
             if not 0 <= eos_id < config.vocab_size:
-                return {"error": f"eos_token_id {eos_id} out of vocab range"}
-        with timed("generate_request_seconds", mode=req.mode,
-                   dispatch=cfg.dispatch):
-            if cfg.dispatch == "remote":
-                try:
-                    ids = _generate_remote(req, prompt_ids, eos_id=eos_id)
-                except UpstreamError as e:
-                    # typed upstream failure (the reference propagates a
-                    # raw exception -> opaque 500, server.py:173-180)
-                    log.warning("upstream failure: %s", e)
-                    REGISTRY.inc("upstream_failures_total", shard=e.shard)
-                    return 502, {"error": "upstream_failure",
-                                 "shard": e.shard, "upstream": e.url,
-                                 "detail": e.detail}
-            else:
-                ids = _generate_local(req, prompt_ids, eos_id=eos_id)
-        finish_reason = "length"
-        if eos_id is not None:
-            # truncate at the first EOS among the NEW tokens (the decode
-            # scan is fixed-length on device; stopping is a host-side
-            # truncation, the standard serving semantics)
-            new = ids[len(prompt_ids):]
-            if eos_id in new:
-                ids = ids[:len(prompt_ids) + new.index(eos_id)]
-                finish_reason = "stop"
-        REGISTRY.inc("generate_requests_total", mode=req.mode)
-        REGISTRY.inc("generated_tokens_total",
-                     value=len(ids) - len(prompt_ids))
-        log.info('{"event": "generate", "mode": "%s", "prompt_tokens": %d, '
-                 '"new_tokens": %d, "finish_reason": "%s"}', req.mode,
-                 len(prompt_ids), len(ids) - len(prompt_ids), finish_reason)
+                return out(
+                    {"error": f"eos_token_id {eos_id} out of vocab range"})
+        # The ambient trace rides the generation: solo runners record
+        # prefill/decode spans directly; the batch schedulers capture it
+        # onto their queue entry and stamp queue wait + shared phases
+        # from the worker side (runtime.batcher / runtime.iterbatch).
         try:
-            text = tokenizer.decode(ids, skip_special_tokens=True)
-        except TypeError:  # ByteTokenizer takes no HF kwargs
-            text = tokenizer.decode(ids)
-        out = {"generated": text}
+            with timed("generate_request_seconds", registry=reg,
+                       mode=req.mode, dispatch=cfg.dispatch):
+                if cfg.dispatch == "remote":
+                    try:
+                        with tracing.use_trace(trace):
+                            ids = _generate_remote(req, prompt_ids,
+                                                   eos_id=eos_id)
+                    except UpstreamError as e:
+                        # typed upstream failure (the reference propagates
+                        # a raw exception -> opaque 500, server.py:173-180)
+                        log.warning("upstream failure: %s", e)
+                        reg.inc("upstream_failures_total", shard=e.shard)
+                        trace.labels.update(error="upstream_failure",
+                                            shard=e.shard)
+                        rec.record(trace)
+                        return out({"error": "upstream_failure",
+                                    "shard": e.shard, "upstream": e.url,
+                                    "detail": e.detail}, status=502)
+                else:
+                    with tracing.use_trace(trace):
+                        ids = _generate_local(req, prompt_ids,
+                                              eos_id=eos_id)
+            # the response-assembly tail (EOS truncation, detokenize,
+            # latency derivation) stays INSIDE the try: a decode error
+            # surfacing there must still flight-record and echo the id
+            finish_reason = "length"
+            # tokens actually DECODED — captured before the host-side
+            # EOS truncation below, so TPOT divides decode wall time by
+            # the steps the device really ran, not the kept prefix (an
+            # early EOS would otherwise inflate TPOT ~budget/kept-fold)
+            n_decoded = len(ids) - len(prompt_ids)
+            if eos_id is not None:
+                # truncate at the first EOS among the NEW tokens (the
+                # decode scan is fixed-length on device; stopping is a
+                # host-side truncation, the standard serving semantics)
+                new = ids[len(prompt_ids):]
+                if eos_id in new:
+                    ids = ids[:len(prompt_ids) + new.index(eos_id)]
+                    finish_reason = "stop"
+            n_new = len(ids) - len(prompt_ids)
+            reg.inc("generate_requests_total", mode=req.mode)
+            reg.inc("generated_tokens_total", value=n_new)
+            log.info('{"event": "generate", "mode": "%s", '
+                     '"request_id": "%s", "prompt_tokens": %d, '
+                     '"new_tokens": %d, "finish_reason": "%s"}', req.mode,
+                     rid, len(prompt_ids), n_new, finish_reason)
+            with trace.span("detokenize"):
+                try:
+                    text = tokenizer.decode(ids, skip_special_tokens=True)
+                except TypeError:  # ByteTokenizer takes no HF kwargs
+                    text = tokenizer.decode(ids)
+            trace.finish()
+            # Latency split derived from the span tree. TTFT counts from
+            # request arrival THROUGH the prefill (queue wait included —
+            # what the caller experiences); runners without span
+            # instrumentation (PipelineRunner, remote dispatch) fall
+            # back to the whole request. TPOT divides the decode spans'
+            # wall time over the inter-token steps actually decoded.
+            pre = trace.find("prefill")
+            ttft = (pre.t1 - trace.t0) if pre is not None \
+                else trace.duration
+            reg.observe("ttft_seconds", ttft, mode=req.mode)
+            if n_decoded > 1:
+                decode_spans = trace.find_all("decode")
+                decode_wall = sum(s.duration for s in decode_spans)
+                if not decode_spans:
+                    decode_wall = max(trace.duration - ttft, 0.0)
+                reg.observe("tpot_seconds", decode_wall / (n_decoded - 1),
+                            mode=req.mode)
+            trace.labels.update(prompt_tokens=len(prompt_ids),
+                                new_tokens=n_new,
+                                finish_reason=finish_reason,
+                                ttft_ms=round(ttft * 1e3, 3))
+            rec.record(trace)
+        except Exception as e:  # noqa: BLE001 — a failed (e.g. timed-out)
+            # generation is exactly the request the flight recorder must
+            # keep, and the caller still needs its X-Request-ID echo;
+            # body shape matches http.py's uncaught-500 {"detail": ...}
+            trace.labels.update(error=f"{type(e).__name__}: {e}")
+            rec.record(trace)
+            return out({"detail": f"{type(e).__name__}: {e}"}, status=500)
+        body = {"generated": text}
         if eos_id is not None:
             # extension field, absent in parity mode so the reference's
             # wire shape ({"generated": ...}, server.py:210) is untouched
-            out["finish_reason"] = finish_reason
-        return out
+            body["finish_reason"] = finish_reason
+        return out(body)
 
     return app
 

@@ -12,6 +12,18 @@ on: POST handlers take one validated body model, handlers return a dict
 serialized as JSON, unvalidatable bodies get HTTP 422, unknown routes
 404. Role guards returning 200 + ``{"error": ...}`` therefore behave
 byte-identically to the reference (server.py:135,147,157).
+
+Handlers may additionally declare parameters by NAME to receive request
+context (both optional, so existing handlers are untouched):
+
+- ``headers``: the request headers as a lower-cased dict (request-ID
+  propagation reads ``x-request-id`` here);
+- ``query``: the parsed query string as a flat dict (last value wins) —
+  ``/debug/requests?slowest=1`` style options.
+
+Handlers return a dict (200), ``(status, payload)``, or ``(status,
+payload, headers)`` — the third form sets response headers (the echoed
+``X-Request-ID``).
 """
 
 from __future__ import annotations
@@ -20,8 +32,12 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, Optional, Tuple, get_type_hints
+from urllib.parse import parse_qsl, urlsplit
 
 import pydantic
+
+# handler parameters passed by NAME (never body-validated)
+_CONTEXT_PARAMS = ("headers", "query")
 
 
 class JSONApp:
@@ -29,8 +45,8 @@ class JSONApp:
 
     POST handlers may annotate a single parameter with a pydantic
     BaseModel subclass; the body is validated into it (422 on failure).
-    GET handlers take no arguments. Handlers return a JSON-serializable
-    dict, or ``(status_code, dict)`` to override the 200 default.
+    GET handlers take no body. Handlers return a JSON-serializable
+    dict, ``(status_code, dict)``, or ``(status_code, dict, headers)``.
     """
 
     def __init__(self, title: str = "", version: str = ""):
@@ -50,43 +66,61 @@ class JSONApp:
             return fn
         return deco
 
-    def handle(self, method: str, path: str,
-               body: Optional[bytes]) -> Tuple[int, Dict[str, Any]]:
-        fn = self._routes.get((method, path))
+    def handle(self, method: str, path: str, body: Optional[bytes],
+               headers: Optional[Dict[str, str]] = None,
+               ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
+        parts = urlsplit(path)
+        route_path = parts.path
+        fn = self._routes.get((method, route_path))
         if fn is None:
-            if any(p == path for (_, p) in self._routes):
-                return 405, {"detail": "Method Not Allowed"}
-            return 404, {"detail": "Not Found"}
+            if any(p == route_path for (_, p) in self._routes):
+                return 405, {"detail": "Method Not Allowed"}, {}
+            return 404, {"detail": "Not Found"}, {}
+
+        kwargs: Dict[str, Any] = {}
+        code = getattr(fn, "__code__", None)
+        arg_names = (code.co_varnames[:code.co_argcount] if code else ())
+        if "headers" in arg_names:
+            kwargs["headers"] = {k.lower(): v
+                                 for k, v in (headers or {}).items()}
+        if "query" in arg_names:
+            kwargs["query"] = dict(parse_qsl(parts.query))
 
         args = []
-        hints = {k: v for k, v in get_type_hints(fn).items() if k != "return"}
+        hints = {k: v for k, v in get_type_hints(fn).items()
+                 if k != "return" and k not in _CONTEXT_PARAMS}
         if hints:
             model = next(iter(hints.values()))
             if isinstance(model, type) and issubclass(model, pydantic.BaseModel):
                 try:
                     payload = json.loads(body or b"null")
                 except json.JSONDecodeError:
-                    return 422, {"detail": "invalid JSON body"}
+                    return 422, {"detail": "invalid JSON body"}, {}
                 try:
                     args.append(model.model_validate(payload))
                 except pydantic.ValidationError as e:
-                    return 422, {"detail": json.loads(e.json())}
+                    return 422, {"detail": json.loads(e.json())}, {}
         try:
-            result = fn(*args)
+            result = fn(*args, **kwargs)
         except Exception as e:  # uncaught handler error -> 500, like uvicorn
-            return 500, {"detail": f"{type(e).__name__}: {e}"}
+            return 500, {"detail": f"{type(e).__name__}: {e}"}, {}
+        if isinstance(result, tuple) and len(result) == 3 \
+                and isinstance(result[0], int):
+            return result
         if (isinstance(result, tuple) and len(result) == 2
                 and isinstance(result[0], int)):
-            return result
-        return 200, result  # payload: dict (JSON) or str (text/plain)
+            return result[0], result[1], {}
+        return 200, result, {}  # payload: dict (JSON) or str (text/plain)
 
 
 class Response:
     """requests-compatible view of a handled call."""
 
-    def __init__(self, status_code: int, payload: Any):
+    def __init__(self, status_code: int, payload: Any,
+                 headers: Optional[Dict[str, str]] = None):
         self.status_code = status_code
         self._payload = payload
+        self.headers = dict(headers or {})
         self.text = payload if isinstance(payload, str) else json.dumps(payload)
 
     def json(self) -> Dict[str, Any]:
@@ -107,13 +141,15 @@ class TestClient:
     def __init__(self, app: JSONApp):
         self.app = app
 
-    def get(self, path: str) -> Response:
-        return Response(*self.app.handle("GET", path, None))
+    def get(self, path: str,
+            headers: Optional[Dict[str, str]] = None) -> Response:
+        return Response(*self.app.handle("GET", path, None, headers))
 
-    def post(self, path: str, json: Any = None) -> Response:  # noqa: A002
+    def post(self, path: str, json: Any = None,  # noqa: A002
+             headers: Optional[Dict[str, str]] = None) -> Response:
         import json as _json
         return Response(*self.app.handle(
-            "POST", path, _json.dumps(json).encode()))
+            "POST", path, _json.dumps(json).encode(), headers))
 
 
 def serve(app: JSONApp, host: str = "0.0.0.0", port: int = 5000,
@@ -128,7 +164,8 @@ def serve(app: JSONApp, host: str = "0.0.0.0", port: int = 5000,
         def _dispatch(self, method: str):
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else None
-            status, payload = app.handle(method, self.path, body)
+            status, payload, resp_headers = app.handle(
+                method, self.path, body, dict(self.headers.items()))
             if isinstance(payload, str):
                 data = payload.encode()
                 ctype = "text/plain; version=0.0.4"  # Prometheus exposition
@@ -138,6 +175,8 @@ def serve(app: JSONApp, host: str = "0.0.0.0", port: int = 5000,
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            for k, v in resp_headers.items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
 

@@ -1,4 +1,4 @@
-"""In-process metrics: counters + latency histograms, Prometheus-exposable.
+"""In-process metrics: counters, gauges + latency histograms, Prometheus-exposable.
 
 The reference's observability is one startup print and uvicorn access
 logs (reference server.py:27, Dockerfile:19; SURVEY.md §5 "Metrics":
@@ -10,6 +10,11 @@ Thread-safe (the stdlib HTTP server is one-thread-per-request). Export
 format is Prometheus text exposition, so a scrape config pointed at the
 pod Just Works; ``snapshot()`` returns the same data as a dict for tests
 and /healthz embedding.
+
+``METRIC_CATALOG`` is the single inventory of every metric name this
+codebase may emit, with its instrument kind. ``tools/check_metrics.py``
+(run in the test suite) greps the ``REGISTRY.inc/observe/gauge`` call
+sites against it, so a typo'd name cannot silently fork a time series.
 """
 
 from __future__ import annotations
@@ -23,10 +28,64 @@ DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
 
+# name -> instrument kind ("counter" | "histogram" | "gauge"). THE metric
+# inventory: every literal name passed to REGISTRY.inc/observe/gauge must
+# appear here with the matching kind (tools/check_metrics.py enforces it),
+# and docs/ARCHITECTURE.md's observability section points here instead of
+# duplicating the list.
+METRIC_CATALOG: Dict[str, str] = {
+    # serving surface (serving/app.py)
+    "generate_requests_total": "counter",
+    "generated_tokens_total": "counter",
+    "upstream_failures_total": "counter",
+    "generate_request_seconds": "histogram",
+    # request-phase latency split (derived from the request trace):
+    # time-to-first-token and per-token (inter-token) time, per mode
+    "ttft_seconds": "histogram",
+    "tpot_seconds": "histogram",
+    # admission batcher (runtime/batcher.py)
+    "decode_batches_total": "counter",
+    "batched_requests_total": "counter",
+    "batched_rows_padded_total": "counter",
+    # iteration-level scheduler (runtime/iterbatch.py)
+    "iter_batches_total": "counter",
+    "iter_joins_total": "counter",
+    "iter_segments_total": "counter",
+    "iter_spec_segments_total": "counter",
+    "iter_grows_total": "counter",
+    "iter_eos_retires_total": "counter",
+    "iter_rows_total": "counter",
+    # speculation (runtime/spec_decode.py)
+    "spec_verify_steps_total": "counter",
+    "spec_emitted_tokens_total": "counter",
+    # prefix cache (runtime/prefix_cache.py)
+    "prefix_cache_hits_total": "counter",
+    "prefix_cache_misses_total": "counter",
+    "prefix_cache_reused_tokens_total": "counter",
+    # compile events: one increment per NEW jitted program entering a
+    # tracked cache (engine prefill/decode, spec loops/segments) — a
+    # compile storm is visible as a burst here, distinguishable from
+    # steady-state latency
+    "compile_events_total": "counter",
+    # live-state gauges
+    "queue_depth": "gauge",                 # waiting requests per scheduler
+    "batch_occupancy": "gauge",             # live rows / compiled width
+    "iter_live_rows": "gauge",              # live iterbatch rows
+    # KV-cache slots holding live request state, labeled by the writer
+    # (component="engine": the in-flight solo generate's reservation,
+    # back to 0 when it finishes; component="iter": depth x live rows of
+    # the running batch) — distinct series, never mixed semantics
+    "kv_cache_slots_in_use": "gauge",
+    "jit_program_cache_size": "gauge",      # compiled programs per component
+    "spec_acceptance_rate": "gauge",        # emitted tokens per verify
+}
+
+
 class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+        self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._histograms: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
                                List] = {}
 
@@ -39,6 +98,12 @@ class MetricsRegistry:
         with self._lock:
             self._counters[key] = self._counters.get(key, 0.0) + value
 
+    def gauge(self, name: str, value: float, **labels) -> None:
+        """Set a gauge to its current value (last write wins)."""
+        key = self._key(name, labels)
+        with self._lock:
+            self._gauges[key] = float(value)
+
     def observe(self, name: str, seconds: float, **labels) -> None:
         key = self._key(name, labels)
         with self._lock:
@@ -50,10 +115,32 @@ class MetricsRegistry:
             self._histograms[key][1] += seconds
             self._histograms[key][2] += 1
 
+    # -- test isolation (tests/conftest.py) ----------------------------------
+
+    def dump_state(self) -> tuple:
+        """Deep snapshot of all series — the conftest isolation fixture
+        pairs this with ``restore_state`` so one test's metric writes
+        cannot leak into another's assertions on the process-global
+        ``REGISTRY``."""
+        with self._lock:
+            return (dict(self._counters), dict(self._gauges),
+                    {k: [list(v[0]), v[1], v[2]]
+                     for k, v in self._histograms.items()})
+
+    def restore_state(self, state: tuple) -> None:
+        counters, gauges, histograms = state
+        with self._lock:
+            self._counters = dict(counters)
+            self._gauges = dict(gauges)
+            self._histograms = {k: [list(v[0]), v[1], v[2]]
+                                for k, v in histograms.items()}
+
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
             out: Dict[str, object] = {}
             for (name, labels), v in self._counters.items():
+                out[_fmt_name(name, labels)] = v
+            for (name, labels), v in self._gauges.items():
                 out[_fmt_name(name, labels)] = v
             for (name, labels), (counts, total, n) in self._histograms.items():
                 base = _fmt_name(name, labels)
@@ -78,6 +165,11 @@ class MetricsRegistry:
                     seen_type.add(name)
                     lines.append(f"# TYPE {name} counter")
                 lines.append(f"{name}{_prom_labels(labels)} {v}")
+            for (name, labels), v in sorted(self._gauges.items()):
+                if name not in seen_type:
+                    seen_type.add(name)
+                    lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name}{_prom_labels(labels)} {v}")
             for (name, labels), (counts, total, n) in sorted(
                     self._histograms.items()):
                 if name not in seen_type:
@@ -96,10 +188,53 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
+class CompileWatch:
+    """Turns jitted-program cache growth into ``compile_events_total``.
+
+    Wraps one ``jax.jit`` result; ``check()`` (called after invocations,
+    off the hot device path) diffs ``_cache_size()`` against the last
+    observed value and increments the counter by exactly the number of
+    NEW compiled programs, labeled with ``phase`` — so a compile storm
+    (e.g. unbucketed shapes minting a program per request) is visible as
+    a counter burst, distinguishable from steady-state latency.
+    """
+
+    def __init__(self, phase: str, fn):
+        self.phase = phase
+        self._fn = fn
+        self._seen = 0
+        # solo-mode engines are called straight from server handler
+        # threads — an unsynchronized read-modify-write of _seen would
+        # let two concurrent checks double-count the same new program
+        self._lock = threading.Lock()
+
+    def check(self, registry: "MetricsRegistry" = None) -> int:
+        size_of = getattr(self._fn, "_cache_size", None)
+        if size_of is None:  # non-jit stub (tests)
+            return 0
+        size = size_of()
+        with self._lock:
+            new = size - self._seen
+            if new > 0:
+                self._seen = size
+        if new > 0:
+            (registry or REGISTRY).inc("compile_events_total", value=new,
+                                       phase=self.phase)
+        return max(new, 0)
+
+
 def _fmt_name(name: str, labels) -> str:
     if not labels:
         return name
     return name + "{" + ",".join(f"{k}={v}" for k, v in labels) + "}"
+
+
+def _escape_label_value(v) -> str:
+    """Escape a label value per the Prometheus text-format spec:
+    backslash, double-quote, and line-feed must be escaped, or the
+    exposition line is invalid and the scraper drops the WHOLE page."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 def _prom_labels(labels, le=None) -> str:
@@ -108,7 +243,8 @@ def _prom_labels(labels, le=None) -> str:
         items = items + [("le", le)]
     if not items:
         return ""
-    return "{" + ",".join(f'{k}="{v}"' for k, v in items) + "}"
+    return "{" + ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in items) + "}"
 
 
 # process-wide default registry (what serving.app uses)

@@ -1,30 +1,56 @@
-"""Profiling/tracing helpers around ``jax.profiler``.
+"""Tracing: request-scoped span trees + ``jax.profiler`` helpers.
 
 SURVEY.md §5 "Tracing / profiling": the reference imports ``time`` and
-never uses it (reference server.py:3). Here:
+never uses it (reference server.py:3). Two layers here:
+
+**Profiler helpers** (device-level, attach-a-tool workflows):
 
 - ``trace(dir)``: context manager capturing an XLA/TPU profile viewable
   in TensorBoard/Perfetto (device timelines, HLO cost, HBM traffic);
 - ``annotate(name)``: named span that shows up inside those traces
   (``jax.profiler.TraceAnnotation``);
 - ``timed(name)``: lightweight host-side wall-clock span recording into
-  ``utils.metrics.REGISTRY`` — the per-request numbers /metrics exposes.
+  ``utils.metrics.REGISTRY`` — per-request numbers /metrics exposes.
+
+**Request traces** (always-on, no profiler attached): every /generate
+request carries a ``RequestTrace`` — a tree of timed spans (tokenize →
+queue wait → prefill → decode segments → detokenize) annotated with
+labels (mode, batch width, prefix hit depth, spec acceptance). The
+serving layer derives TTFT/TPOT histograms from it and keeps the last N
+completed traces in the ``FlightRecorder`` served at ``GET
+/debug/requests``, so a slow request is diagnosable after the fact
+without a profiler in the loop.
+
+Propagation: the ambient trace rides a ``contextvars.ContextVar`` set by
+``use_trace`` — runtime modules record through the module-level ``span``
+/ ``record`` helpers, which no-op when no trace is active (zero cost off
+the serving path). Batch schedulers run device work for MANY requests on
+one worker thread; they wrap shared phases in ``use_trace(fanout(
+traces))`` so one measured span lands in every participating request's
+tree.
+
+Span timestamps are ``time.perf_counter`` values; serialized timelines
+are relative to the request's start. Scheduler-side decode spans measure
+dispatch wall time (segments queue asynchronously on the device), which
+is the honest serving-thread view — device-level truth is the profiler
+trace's job.
 """
 
 from __future__ import annotations
 
 import contextlib
+import contextvars
+import threading
 import time
-from typing import Iterator, Optional
-
-import jax
-
-from .metrics import REGISTRY
+import uuid
+from collections import deque
+from typing import Iterator, List, Optional
 
 
 @contextlib.contextmanager
 def trace(log_dir: str, create_perfetto_link: bool = False) -> Iterator[None]:
     """Capture a device-level profiler trace into ``log_dir``."""
+    import jax
     jax.profiler.start_trace(log_dir,
                              create_perfetto_link=create_perfetto_link)
     try:
@@ -35,15 +61,276 @@ def trace(log_dir: str, create_perfetto_link: bool = False) -> Iterator[None]:
 
 def annotate(name: str):
     """Named span visible in profiler traces (device + host timelines)."""
+    import jax
     return jax.profiler.TraceAnnotation(name)
 
 
 @contextlib.contextmanager
 def timed(name: str, registry=None, **labels) -> Iterator[None]:
     """Wall-clock span recorded as a histogram observation."""
+    from .metrics import REGISTRY
     reg = registry if registry is not None else REGISTRY
     t0 = time.perf_counter()
     try:
         yield
     finally:
         reg.observe(name, time.perf_counter() - t0, **labels)
+
+
+# -- request-scoped span trees -----------------------------------------------
+
+
+def new_request_id() -> str:
+    return uuid.uuid4().hex[:12]
+
+
+class Span:
+    """One timed node: name, [t0, t1) perf_counter window, labels,
+    children. Append-only while open; read-only once closed."""
+
+    __slots__ = ("name", "t0", "t1", "labels", "children")
+
+    def __init__(self, name: str, t0: float, t1: Optional[float] = None,
+                 labels: Optional[dict] = None):
+        self.name = name
+        self.t0 = t0
+        self.t1 = t1
+        self.labels = dict(labels) if labels else {}
+        self.children: List["Span"] = []
+
+    @property
+    def duration(self) -> float:
+        return (self.t1 if self.t1 is not None else self.t0) - self.t0
+
+    def to_dict(self, origin: float) -> dict:
+        d = {"name": self.name,
+             "start_ms": round((self.t0 - origin) * 1e3, 3),
+             "duration_ms": round(self.duration * 1e3, 3)}
+        if self.labels:
+            d["labels"] = dict(self.labels)
+        if self.children:
+            d["spans"] = [c.to_dict(origin) for c in self.children]
+        return d
+
+
+class _TraceSink:
+    """Span-tree recording shared by ``RequestTrace`` and ``fanout``.
+
+    Nesting is per-thread (a thread-local open-span stack guarded by a
+    lock for the cross-thread ``add_span`` form), so a scheduler thread
+    adding spans to a caller thread's trace lands them at the root — the
+    right shape, since the two threads' phases don't enclose each other.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self.spans: List[Span] = []
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _commit(self, span: Span) -> None:
+        stack = self._stack()
+        if stack:
+            stack[-1].children.append(span)
+        else:
+            with self._lock:
+                self.spans.append(span)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **labels) -> Iterator[Span]:
+        s = Span(name, time.perf_counter(), labels=labels)
+        stack = self._stack()
+        stack.append(s)
+        try:
+            yield s
+        finally:
+            s.t1 = time.perf_counter()
+            stack.pop()
+            self._commit(s)
+
+    def add_span(self, name: str, t0: float, t1: float, **labels) -> Span:
+        """Record an already-timed span (schedulers time phases once and
+        attach them to every participating request)."""
+        s = Span(name, t0, t1, labels=labels)
+        self._commit(s)
+        return s
+
+    def event(self, name: str, **labels) -> Span:
+        now = time.perf_counter()
+        return self.add_span(name, now, now, **labels)
+
+
+class RequestTrace(_TraceSink):
+    """The span tree of one request, plus identity and summary fields."""
+
+    def __init__(self, request_id: Optional[str] = None, **labels):
+        super().__init__()
+        self.request_id = request_id or new_request_id()
+        self.labels = dict(labels)
+        self.t0 = time.perf_counter()
+        self.started_unix = time.time()
+        self.t1: Optional[float] = None
+
+    def finish(self) -> "RequestTrace":
+        if self.t1 is None:
+            self.t1 = time.perf_counter()
+        return self
+
+    @property
+    def duration(self) -> float:
+        return (self.t1 if self.t1 is not None
+                else time.perf_counter()) - self.t0
+
+    def find(self, name: str) -> Optional[Span]:
+        """First span with ``name``, depth-first."""
+        def walk(spans):
+            for s in spans:
+                if s.name == name:
+                    return s
+                got = walk(s.children)
+                if got is not None:
+                    return got
+            return None
+        with self._lock:
+            return walk(self.spans)
+
+    def find_all(self, name: str) -> List[Span]:
+        out: List[Span] = []
+
+        def walk(spans):
+            for s in spans:
+                if s.name == name:
+                    out.append(s)
+                walk(s.children)
+        with self._lock:
+            walk(self.spans)
+        return out
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            spans = [s.to_dict(self.t0) for s in self.spans]
+        d = {"request_id": self.request_id,
+             "started_unix": round(self.started_unix, 3),
+             "duration_ms": round(self.duration * 1e3, 3),
+             "spans": spans}
+        if self.labels:
+            d["labels"] = dict(self.labels)
+        return d
+
+
+class _FanoutTrace(_TraceSink):
+    """Records spans once and commits each completed root to every target
+    trace — how a batch scheduler attributes one shared device phase
+    (prefill, a decode round) to all rows riding it. Nested spans inside
+    the fanout keep their tree shape; the shared Span objects are
+    read-only after commit, so sharing across traces is safe."""
+
+    def __init__(self, traces: List[RequestTrace]):
+        super().__init__()
+        self._targets = [t for t in traces if t is not None]
+
+    def _commit(self, span: Span) -> None:
+        stack = self._stack()
+        if stack:
+            stack[-1].children.append(span)
+            return
+        for t in self._targets:
+            with t._lock:
+                t.spans.append(span)
+
+
+def fanout(traces: List[Optional[RequestTrace]]) -> _FanoutTrace:
+    return _FanoutTrace(traces)
+
+
+_current: "contextvars.ContextVar[Optional[_TraceSink]]" = \
+    contextvars.ContextVar("request_trace", default=None)
+
+
+def current_trace() -> Optional[_TraceSink]:
+    return _current.get()
+
+
+@contextlib.contextmanager
+def use_trace(trace_obj: Optional[_TraceSink]) -> Iterator[None]:
+    token = _current.set(trace_obj)
+    try:
+        yield
+    finally:
+        _current.reset(token)
+
+
+@contextlib.contextmanager
+def span(name: str, **labels) -> Iterator[Optional[Span]]:
+    """Record a span on the ambient trace; no-op (still yields) when no
+    trace is active — runtime modules call this unconditionally."""
+    tr = _current.get()
+    if tr is None:
+        yield None
+        return
+    with tr.span(name, **labels) as s:
+        yield s
+
+
+def record(name: str, t0: float, t1: float, **labels) -> None:
+    """Attach an already-timed span to the ambient trace (no-op without
+    one) — for call sites that measured the window themselves."""
+    tr = _current.get()
+    if tr is not None:
+        tr.add_span(name, t0, t1, **labels)
+
+
+def annotate_span(**labels) -> None:
+    """Merge labels into the innermost OPEN span of the ambient trace
+    (no-op without one) — e.g. the prefix store marking hit depth on the
+    enclosing prefill span."""
+    tr = _current.get()
+    if tr is None:
+        return
+    stack = tr._stack()
+    if stack:
+        stack[-1].labels.update(labels)
+
+
+class FlightRecorder:
+    """Bounded ring of the last N completed request traces, served at
+    ``GET /debug/requests`` — the after-the-fact view of where a slow
+    request's time went, no profiler attached."""
+
+    def __init__(self, capacity: int = 64):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._traces: "deque[RequestTrace]" = deque(maxlen=capacity)
+
+    def record(self, trace_obj: RequestTrace) -> None:
+        trace_obj.finish()
+        with self._lock:
+            self._traces.append(trace_obj)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._traces)
+
+    def snapshot(self, n: Optional[int] = None,
+                 slowest: bool = False) -> List[dict]:
+        """Most recent (or slowest) ``n`` traces as JSON timelines,
+        newest/slowest first."""
+        with self._lock:
+            traces = list(self._traces)
+        traces.reverse()                      # newest first
+        if slowest:
+            traces.sort(key=lambda t: t.duration, reverse=True)
+        if n is not None:
+            traces = traces[:max(n, 0)]
+        return [t.to_dict() for t in traces]
+
+
+# process-wide default recorder (what serving.app uses; injectable there)
+RECORDER = FlightRecorder()

@@ -85,6 +85,25 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.quick)
 
 
+@pytest.fixture(autouse=True)
+def _metrics_isolation():
+    """Snapshot/restore the process-global metrics REGISTRY and flight
+    RECORDER around every test: modules bind ``REGISTRY`` at import, so
+    it cannot be swapped per-test — but its STATE can, which is what
+    metric assertions need (one test's generate calls must not inflate
+    another's counters). ``create_app`` additionally accepts an injected
+    registry/recorder for tests that want full isolation."""
+    from llm_sharding_demo_tpu.utils import metrics, tracing
+    state = metrics.REGISTRY.dump_state()
+    with tracing.RECORDER._lock:
+        saved = list(tracing.RECORDER._traces)
+    yield
+    metrics.REGISTRY.restore_state(state)
+    with tracing.RECORDER._lock:
+        tracing.RECORDER._traces.clear()
+        tracing.RECORDER._traces.extend(saved)
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """Bound in-process XLA state: the full suite compiles hundreds of

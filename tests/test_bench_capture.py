@@ -83,3 +83,42 @@ def test_journal_rows_append_to_progress(monkeypatch, tmp_path):
 def test_journal_noop_without_progress_env(monkeypatch):
     monkeypatch.delenv(bench._PROGRESS_ENV, raising=False)
     bench._journal_row({"name": "x"})  # must not raise
+
+
+def test_metrics_delta_counters_and_gauges():
+    """Per-config /metrics deltas: counters as after-before, gauges at
+    final value, unchanged series and _avg noise dropped."""
+    before = {"spec_verify_steps_total": 10.0,
+              "prefix_cache_hits_total": 2.0,
+              "ttft_seconds{mode=greedy}_count": 5,
+              "ttft_seconds{mode=greedy}_avg": 0.01,
+              "queue_depth{scheduler=iter}": 3.0}
+    after = {"spec_verify_steps_total": 25.0,          # counter: delta
+             "prefix_cache_hits_total": 2.0,           # unchanged: drop
+             "ttft_seconds{mode=greedy}_count": 9,
+             "ttft_seconds{mode=greedy}_avg": 0.02,    # _avg: drop
+             "queue_depth{scheduler=iter}": 1.0,       # gauge: final
+             "compile_events_total{phase=decode}": 4}  # new series
+    d = bench._metrics_delta(before, after)
+    assert d == {"spec_verify_steps_total": 15.0,
+                 "ttft_seconds{mode=greedy}_count": 4,
+                 "queue_depth{scheduler=iter}": 1.0,
+                 "compile_events_total{phase=decode}": 4}
+
+
+def test_metrics_delta_rides_the_journal(monkeypatch, tmp_path):
+    """The delta lands on journaled rows (partial-artifact fallback) but
+    stays off the compact driver line (_COMPACT_DROP)."""
+    assert "metrics_delta" in bench._COMPACT_DROP
+    progress = tmp_path / "progress.jsonl"
+    monkeypatch.setenv(bench._PROGRESS_ENV, str(progress))
+    from llm_sharding_demo_tpu.utils.metrics import REGISTRY
+    before = REGISTRY.snapshot()
+    REGISTRY.inc("generate_requests_total", mode="greedy")
+    row = {"name": "cfg_x", "tokens_per_sec": 1.0,
+           "metrics_delta": bench._metrics_delta(before,
+                                                 REGISTRY.snapshot())}
+    bench._journal_row(row)
+    got = json.loads(progress.read_text())
+    assert got["metrics_delta"] == {
+        "generate_requests_total{mode=greedy}": 1.0}

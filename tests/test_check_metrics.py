@@ -1,0 +1,67 @@
+"""Metric-name catalog lint (tools/check_metrics.py): every literal
+metric name at a REGISTRY.inc/observe/gauge call site must be in
+utils.metrics.METRIC_CATALOG with the right instrument kind — a typo'd
+name silently forks a time series no dashboard watches."""
+
+import os
+import sys
+
+_TOOLS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+sys.path.insert(0, _TOOLS)
+try:
+    import check_metrics
+finally:
+    # scoped insert: leaving tools/ on sys.path would make convert_hf/
+    # profile_decode importable as bare names for every later test
+    sys.path.remove(_TOOLS)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_repo_call_sites_match_catalog():
+    """The actual codebase passes its own lint — the satellite's point."""
+    paths = check_metrics._iter_sources(REPO)
+    assert paths, "source scan found nothing — lint is vacuous"
+    violations = check_metrics.find_violations(paths)
+    assert violations == [], "\n".join(
+        f"{p}:{ln}: {name}: {why}" for p, ln, name, why in violations)
+
+
+def test_lint_catches_unknown_name(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text('REGISTRY.inc("generate_requsts_total")\n')  # typo'd
+    got = check_metrics.find_violations([str(bad)])
+    assert len(got) == 1
+    assert got[0][2] == "generate_requsts_total"
+    assert "not in METRIC_CATALOG" in got[0][3]
+
+
+def test_lint_catches_kind_mismatch(tmp_path):
+    bad = tmp_path / "bad.py"
+    # queue_depth is a gauge; .inc() on it would fork counter semantics
+    bad.write_text('reg.inc("queue_depth")\n'
+                   'with timed("queue_depth"):\n    pass\n')
+    got = check_metrics.find_violations([str(bad)])
+    assert len(got) == 2
+    assert all("queue_depth" == g[2] for g in got)
+
+
+def test_lint_skips_non_literal_names(tmp_path):
+    ok = tmp_path / "ok.py"
+    ok.write_text("reg.observe(name, dt)\n")      # helper forwarding
+    assert check_metrics.find_violations([str(ok)]) == []
+
+
+def test_lint_catches_wrapped_call_site(tmp_path):
+    """Line-length wrapping must not hide a typo'd name from the lint."""
+    bad = tmp_path / "bad.py"
+    bad.write_text('REGISTRY.inc(\n    "generate_requsts_total")\n')
+    got = check_metrics.find_violations([str(bad)])
+    assert len(got) == 1 and got[0][2] == "generate_requsts_total"
+    assert got[0][1] == 1          # reported at the call line
+
+
+def test_cli_main_ok(capsys):
+    assert check_metrics.main([REPO]) == 0
+    assert "OK" in capsys.readouterr().out

@@ -1,15 +1,43 @@
-"""Metrics registry, /metrics endpoint, and timed spans."""
+"""Metrics registry (counters/gauges/histograms, label escaping),
+request-trace span trees, the flight recorder, and the serving surface:
+/metrics TTFT/TPOT + gauges, X-Request-ID propagation, /debug/requests
+timelines (plain-batch AND spec x iterbatch modes), and compile-event
+accounting."""
 
 import jax
+import numpy as np
 import pytest
 
 from llm_sharding_demo_tpu.models import gpt2
 from llm_sharding_demo_tpu.serving.app import create_app
 from llm_sharding_demo_tpu.serving.http import TestClient
 from llm_sharding_demo_tpu.serving.tokenizer import ByteTokenizer
+from llm_sharding_demo_tpu.utils import tracing
 from llm_sharding_demo_tpu.utils.config import ServingConfig
-from llm_sharding_demo_tpu.utils.metrics import MetricsRegistry
-from llm_sharding_demo_tpu.utils.tracing import timed
+from llm_sharding_demo_tpu.utils.metrics import (METRIC_CATALOG,
+                                                 MetricsRegistry)
+from llm_sharding_demo_tpu.utils.tracing import (FlightRecorder,
+                                                 RequestTrace, timed)
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = gpt2.GPT2Config(vocab_size=256, n_positions=64, n_embd=8,
+                             n_layer=2, n_head=2)
+    params = gpt2.init_params(config, jax.random.PRNGKey(0))
+    return config, params
+
+
+def make_client(model, **kw):
+    extra = {k: kw.pop(k) for k in ("registry", "recorder") if k in kw}
+    cfg = ServingConfig(model_id="test", shard_role="coordinator",
+                        boundaries=kw.pop("boundaries", (1,)),
+                        max_seq=kw.pop("max_seq", 64), **kw)
+    return TestClient(create_app(cfg, model=model,
+                                 tokenizer=ByteTokenizer(), **extra))
+
+
+# -- registry ----------------------------------------------------------------
 
 
 def test_registry_counters_and_histograms():
@@ -28,6 +56,33 @@ def test_registry_counters_and_histograms():
     assert 'latency_seconds_bucket{le="+Inf"} 2' in prom
 
 
+def test_registry_gauges():
+    reg = MetricsRegistry()
+    reg.gauge("queue_depth", 3, scheduler="iter")
+    reg.gauge("queue_depth", 1, scheduler="iter")   # last write wins
+    reg.gauge("iter_live_rows", 4)
+    snap = reg.snapshot()
+    assert snap["queue_depth{scheduler=iter}"] == 1
+    assert snap["iter_live_rows"] == 4
+    prom = reg.prometheus()
+    assert "# TYPE queue_depth gauge" in prom
+    assert 'queue_depth{scheduler="iter"} 1.0' in prom
+
+
+def test_prometheus_label_escaping():
+    """Label values with ", \\, or newlines must escape per the text-
+    format spec — one raw quote makes the scraper drop the WHOLE page."""
+    reg = MetricsRegistry()
+    reg.inc("requests_total", route='say "hi"\\now', detail="a\nb")
+    prom = reg.prometheus()
+    assert r'route="say \"hi\"\\now"' in prom
+    assert 'detail="a\\nb"' in prom
+    assert "\na\nb" not in prom          # no raw newline inside a label
+    # every line is a comment or `name{...} value` — i.e. parseable
+    for line in prom.strip().splitlines():
+        assert line.startswith("#") or " " in line
+
+
 def test_timed_records():
     reg = MetricsRegistry()
     with timed("span_seconds", registry=reg, phase="x"):
@@ -35,19 +90,305 @@ def test_timed_records():
     assert reg.snapshot()["span_seconds{phase=x}_count"] == 1
 
 
-def test_metrics_endpoint():
-    config = gpt2.GPT2Config(vocab_size=256, n_positions=32, n_embd=8,
-                             n_layer=2, n_head=2)
-    params = gpt2.init_params(config, jax.random.PRNGKey(0))
-    cfg = ServingConfig(model_id="test", shard_role="coordinator",
-                        boundaries=(1,), max_seq=32)
-    client = TestClient(create_app(cfg, model=(config, params),
-                                   tokenizer=ByteTokenizer()))
+def test_registry_dump_restore_roundtrip():
+    reg = MetricsRegistry()
+    reg.inc("requests_total")
+    state = reg.dump_state()
+    reg.inc("requests_total", value=5)
+    reg.gauge("queue_depth", 9)
+    reg.restore_state(state)
+    snap = reg.snapshot()
+    assert snap["requests_total"] == 1
+    assert "queue_depth" not in snap
+
+
+# -- request traces ----------------------------------------------------------
+
+
+def test_request_trace_span_tree():
+    tr = RequestTrace("req-1", mode="greedy")
+    with tr.span("outer", phase="a"):
+        with tr.span("inner"):
+            pass
+        tr.add_span("sibling", 1.0, 2.0, n=3)
+    tr.finish()
+    d = tr.to_dict()
+    assert d["request_id"] == "req-1"
+    assert d["labels"]["mode"] == "greedy"
+    (outer,) = d["spans"]
+    assert outer["name"] == "outer"
+    names = [s["name"] for s in outer["spans"]]
+    assert names == ["inner", "sibling"]
+    assert tr.find("inner") is not None
+    assert len(tr.find_all("sibling")) == 1
+
+
+def test_fanout_trace_lands_in_every_target():
+    a, b = RequestTrace("a"), RequestTrace("b")
+    fan = tracing.fanout([a, b, None])
+    with tracing.use_trace(fan):
+        with tracing.span("prefill", batch=2):
+            pass
+        tracing.record("decode", 0.0, 1.0, steps=8)
+    for tr in (a, b):
+        assert tr.find("prefill").labels["batch"] == 2
+        assert tr.find("decode").labels["steps"] == 8
+
+
+def test_ambient_span_noop_without_trace():
+    with tracing.span("anything") as s:     # must not raise, yields None
+        assert s is None
+    tracing.record("x", 0.0, 1.0)
+    tracing.annotate_span(k=1)
+
+
+def test_flight_recorder_bounded_and_slowest():
+    rec = FlightRecorder(capacity=3)
+    for i in range(5):
+        tr = RequestTrace(f"r{i}")
+        tr.t1 = tr.t0 + (0.1 if i != 3 else 9.0)  # r3 is the slow one
+        rec.record(tr)
+    assert len(rec) == 3                           # r2 r3 r4 survive
+    newest = rec.snapshot()
+    assert [t["request_id"] for t in newest] == ["r4", "r3", "r2"]
+    slowest = rec.snapshot(slowest=True)
+    assert slowest[0]["request_id"] == "r3"
+    assert [t["request_id"] for t in rec.snapshot(n=1)] == ["r4"]
+
+
+# -- serving surface ---------------------------------------------------------
+
+
+def test_metrics_endpoint(model):
+    client = make_client(model)
     client.post("/generate", json={"prompt": "yo", "max_new_tokens": 2,
                                    "mode": "greedy"})
     r = client.get("/metrics")
     assert r.status_code == 200
     assert "generate_requests_total" in r.text
     assert "generate_request_seconds_bucket" in r.text
+    assert 'ttft_seconds_bucket' in r.text
     with pytest.raises(ValueError):
         r.json()  # text, not JSON
+
+
+def test_request_id_header_echoed_and_minted(model):
+    client = make_client(model)
+    r = client.post("/generate",
+                    json={"prompt": "hi", "max_new_tokens": 2,
+                          "mode": "greedy"},
+                    headers={"X-Request-ID": "caller-id-7"})
+    assert r.status_code == 200
+    assert r.headers["X-Request-ID"] == "caller-id-7"
+    r2 = client.post("/generate", json={"prompt": "hi", "max_new_tokens": 2,
+                                        "mode": "greedy"})
+    minted = r2.headers["X-Request-ID"]
+    assert minted and minted != "caller-id-7"
+    # errors echo it too (body stays wire-parity)
+    r3 = client.post("/generate", json={"prompt": "x", "mode": "banana"},
+                     headers={"X-Request-ID": "err-1"})
+    assert r3.headers["X-Request-ID"] == "err-1"
+    assert "error" in r3.json()
+    # hostile ids (quotes/newlines would corrupt the structured log line
+    # and the echoed header) are replaced with a minted one
+    r4 = client.post("/generate", json={"prompt": "hi", "max_new_tokens": 2,
+                                        "mode": "greedy"},
+                     headers={"X-Request-ID": 'a"b\\c'})
+    assert r4.headers["X-Request-ID"] != 'a"b\\c'
+    assert r4.status_code == 200
+
+
+def test_injected_registry_and_recorder(model):
+    reg, rec = MetricsRegistry(), FlightRecorder(capacity=8)
+    client = make_client(model, registry=reg, recorder=rec)
+    client.post("/generate", json={"prompt": "hi", "max_new_tokens": 3,
+                                   "mode": "greedy"})
+    snap = reg.snapshot()
+    assert snap["generate_requests_total{mode=greedy}"] == 1
+    assert snap["ttft_seconds{mode=greedy}_count"] == 1
+    assert snap["tpot_seconds{mode=greedy}_count"] == 1
+    assert len(rec) == 1
+    assert client.get("/metrics").text == reg.prometheus()
+
+
+def _gauge_names(prom_text):
+    return {ln.split()[2] for ln in prom_text.splitlines()
+            if ln.startswith("# TYPE") and ln.endswith(" gauge")}
+
+
+def test_debug_requests_plain_batch_e2e(model):
+    """Plain-batch (admission batcher) serving: timelines with request
+    IDs and tokenize/queue_wait/prefill/decode spans; TTFT/TPOT per mode
+    and >= 4 gauges on /metrics."""
+    client = make_client(model, max_batch=4)
+    for i, mode in enumerate(("greedy", "greedy", "sample")):
+        body = {"prompt": "Hi, Hi, ", "max_new_tokens": 6, "mode": mode}
+        if mode == "sample":
+            body["seed"] = 3
+        r = client.post("/generate", json=body,
+                        headers={"X-Request-ID": f"plainb-{i}"})
+        assert r.status_code == 200
+    d = client.get("/debug/requests").json()
+    assert d["serving"]["max_batch"] == 4
+    assert d["serving"]["batch_mode"] == "admission"
+    by_id = {t["request_id"]: t for t in d["requests"]}
+    assert {"plainb-0", "plainb-1", "plainb-2"} <= set(by_id)
+    t = by_id["plainb-0"]
+    names = [s["name"] for s in t["spans"]]
+    for want in ("tokenize", "queue_wait", "prefill", "decode",
+                 "detokenize"):
+        assert want in names, (want, names)
+    assert t["labels"]["new_tokens"] == 6
+    assert t["labels"]["ttft_ms"] > 0
+    # newest-first ordering and the ?n= bound
+    assert d["requests"][0]["request_id"] == "plainb-2"
+    assert len(client.get("/debug/requests?n=1").json()["requests"]) == 1
+    slow = client.get("/debug/requests?slowest=1").json()
+    durs = [t["duration_ms"] for t in slow["requests"]]
+    assert durs == sorted(durs, reverse=True)
+    prom = client.get("/metrics").text
+    for mode in ("greedy", "sample"):
+        assert f'ttft_seconds_count{{mode="{mode}"}}' in prom
+        assert f'tpot_seconds_count{{mode="{mode}"}}' in prom
+    assert len(_gauge_names(prom)) >= 4, _gauge_names(prom)
+
+
+def test_debug_requests_spec_iterbatch_e2e(model):
+    """Speculation x iteration-level batching: the decode spans are
+    draft-verify segments (spec labels, verify counts) and the whole
+    trace pipeline still holds end-to-end."""
+    client = make_client(model, spec_decode=4, max_batch=4,
+                         batch_mode="iter")
+    body = {"prompt": "Hi, Hi, Hi, ", "max_new_tokens": 8,
+            "mode": "greedy"}
+    r = client.post("/generate", json=body,
+                    headers={"X-Request-ID": "specit-0"})
+    assert r.status_code == 200
+    d = client.get("/debug/requests").json()
+    assert d["serving"]["spec_decode"] == 4
+    assert d["serving"]["batch_mode"] == "iter"
+    by_id = {t["request_id"]: t for t in d["requests"]}
+    t = by_id["specit-0"]
+    names = [s["name"] for s in t["spans"]]
+    for want in ("tokenize", "queue_wait", "prefill", "decode"):
+        assert want in names, (want, names)
+    dec = [s for s in t["spans"] if s["name"] == "decode"]
+    assert any(s["labels"].get("spec") for s in dec)
+    # first token comes from the seed prefill; segments emit the rest
+    assert sum(s["labels"].get("emitted", 0) for s in dec) >= 7
+    assert any(s["labels"].get("verify_steps", 0) >= 1 for s in dec)
+    prom = client.get("/metrics").text
+    assert 'ttft_seconds_count{mode="greedy"}' in prom
+    assert "spec_acceptance_rate" in prom
+    assert "iter_live_rows" in prom
+    assert len(_gauge_names(prom)) >= 4
+
+
+def test_debug_requests_bad_query(model):
+    client = make_client(model)
+    assert client.get("/debug/requests?n=zap").status_code == 422
+
+
+def test_tpot_counts_decoded_steps_not_truncated(model):
+    """Host-side EOS truncation keeps 1 token of a 6-token decode: TPOT
+    must divide by the steps the device actually ran (a kept-prefix
+    denominator would skip — or wildly inflate — the observation)."""
+    reg = MetricsRegistry()
+    client = make_client(model, registry=reg, recorder=FlightRecorder())
+    full = client.post("/generate", json={
+        "prompt": "abc", "max_new_tokens": 6,
+        "mode": "greedy"}).json()["generated"]
+    eos = ord(full[4])  # the 2nd new char: truncates to <= 1 kept token
+    r = client.post("/generate", json={"prompt": "abc",
+                                       "max_new_tokens": 6,
+                                       "mode": "greedy",
+                                       "eos_token_id": eos})
+    assert r.json()["finish_reason"] == "stop"
+    # kept n_new <= 1, decoded 6: the observation still lands
+    assert reg.snapshot()["tpot_seconds{mode=greedy}_count"] == 2
+
+
+def test_failed_generate_recorded_and_id_echoed(model, monkeypatch):
+    """A generation that DIES (not a validation error) is exactly the
+    request the flight recorder must keep — and the caller still gets
+    its X-Request-ID echo on the 500."""
+    from llm_sharding_demo_tpu.parallel.pipeline import PipelineRunner
+
+    reg, rec = MetricsRegistry(), FlightRecorder(capacity=4)
+    client = make_client(model, registry=reg, recorder=rec)
+
+    def boom(self, *a, **k):
+        raise RuntimeError("synthetic device loss")
+
+    monkeypatch.setattr(PipelineRunner, "generate", boom)
+    r = client.post("/generate",
+                    json={"prompt": "hi", "max_new_tokens": 2,
+                          "mode": "greedy"},
+                    headers={"X-Request-ID": "fail-1"})
+    assert r.status_code == 500
+    assert r.headers["X-Request-ID"] == "fail-1"
+    assert "synthetic device loss" in r.json()["detail"]
+    assert len(rec) == 1
+    t = rec.snapshot()[0]
+    assert t["request_id"] == "fail-1"
+    assert "synthetic device loss" in t["labels"]["error"]
+
+
+# -- compile events ----------------------------------------------------------
+
+
+def test_compile_events_once_per_program(model):
+    """compile_events_total counts each NEW (shape, policy) program
+    exactly once: a repeated generate adds zero, a new batch width adds
+    exactly the new cache entries."""
+    from llm_sharding_demo_tpu.runtime.engine import DecodeEngine
+    from llm_sharding_demo_tpu.utils.metrics import REGISTRY
+
+    config, params = model
+    eng = DecodeEngine(params, config, max_seq=64)
+
+    def counted(phase):
+        return REGISTRY.snapshot().get(
+            f"compile_events_total{{phase={phase}}}", 0)
+
+    base_p, base_d = counted("prefill"), counted("decode")
+    prompt = np.arange(1, 9, dtype=np.int32)
+    eng.generate(prompt, max_new_tokens=4)
+    p1, d1 = counted("prefill") - base_p, counted("decode") - base_d
+    assert p1 == eng._prefill._cache_size() >= 1
+    assert d1 == eng._decode_seg._cache_size() >= 1
+    # same shape + policy again: no new programs, no new events
+    eng.generate(prompt, max_new_tokens=4)
+    assert counted("prefill") - base_p == p1
+    assert counted("decode") - base_d == d1
+    # a new batch width mints new programs — counted exactly once
+    eng.generate(np.tile(prompt, (2, 1)), max_new_tokens=4)
+    p2, d2 = counted("prefill") - base_p, counted("decode") - base_d
+    assert p2 == eng._prefill._cache_size() > p1
+    assert d2 == eng._decode_seg._cache_size() > d1
+
+
+def test_spec_compile_events_and_acceptance_gauge(model):
+    from llm_sharding_demo_tpu.runtime.spec_decode import SpecDecodeEngine
+    from llm_sharding_demo_tpu.utils.metrics import REGISTRY
+
+    config, params = model
+    spec = SpecDecodeEngine(params, config, max_seq=64, draft_len=3)
+    prompt = np.asarray([7, 8, 7, 8, 7, 8], dtype=np.int32)
+    spec.generate(prompt, max_new_tokens=6)
+    snap = REGISTRY.snapshot()
+    assert snap.get("compile_events_total{phase=spec_loop}", 0) >= 1
+    assert snap["spec_acceptance_rate"] > 0
+    before = snap["compile_events_total{phase=spec_loop}"]
+    spec.generate(prompt, max_new_tokens=6)  # cached program: no event
+    assert REGISTRY.snapshot()[
+        "compile_events_total{phase=spec_loop}"] == before
+
+
+def test_metric_catalog_covers_runtime_names():
+    """Spot-check the catalog knows the series this PR's tests assert."""
+    for name in ("ttft_seconds", "tpot_seconds", "compile_events_total",
+                 "queue_depth", "iter_live_rows", "kv_cache_slots_in_use",
+                 "jit_program_cache_size", "spec_acceptance_rate",
+                 "batch_occupancy"):
+        assert name in METRIC_CATALOG, name

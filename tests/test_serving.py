@@ -42,6 +42,35 @@ def test_healthz(model):
     assert body["n_stages"] == 2
 
 
+def test_healthz_reports_active_topology(model):
+    """/healthz must report the decode topology ACTUALLY serving
+    /generate — not just the configured knobs. The flight-recorder
+    header (/debug/requests "serving") reads the same dict, so this
+    pins both surfaces."""
+    # staged pipeline: n_stages follows the boundaries
+    four = make_client(model, "coordinator", boundaries=(1, 2, 3))
+    h = four.get("/healthz").json()
+    assert h["n_stages"] == 4 and h["batch_mode"] == "admission"
+    assert h["max_batch"] == 1 and h["spec_decode"] == 0
+    # speculation decodes unstaged: n_stages must drop to 1 even though
+    # boundaries still configure a 2-stage partition
+    spec = make_client(model, "coordinator", spec_decode=3)
+    h = spec.get("/healthz").json()
+    assert h["spec_decode"] == 3 and h["n_stages"] == 1
+    # iteration-level batching: composition flags surface together
+    it = make_client(model, "coordinator", spec_decode=3, max_batch=4,
+                     batch_mode="iter")
+    h = it.get("/healthz").json()
+    assert (h["batch_mode"], h["max_batch"], h["spec_decode"]) \
+        == ("iter", 4, 3)
+    assert h["n_stages"] == 1
+    # the flight-recorder header is the SAME topology dict
+    dbg = it.get("/debug/requests").json()["serving"]
+    for k in ("n_stages", "spec_decode", "batch_mode", "max_batch",
+              "inference_dtype", "dispatch"):
+        assert dbg[k] == h[k], k
+
+
 def test_role_guards_match_reference(model):
     """Guards answer 200 + {"error": ...} (reference server.py:135,147,157)."""
     coord = make_client(model, "coordinator")
