@@ -1149,6 +1149,42 @@ def main() -> None:
         configs.append(row)
         _journal_row(row)
 
+    def cfg_graftcheck():
+        """Static-analysis journal row (ISSUE 3): the graftcheck --json
+        payload rides the perf matrix, so contract drift (new lint
+        findings, changed recompile bounds, stale baseline entries)
+        lands in the same trajectory as the timings. Cheap (a few
+        seconds of AST walking + abstract eval, no tunnel dependency)
+        and journaled FIRST — before any chip-bound row — so a
+        timeout-cut run still records it."""
+        import sys as _sys
+        here = os.path.dirname(os.path.abspath(__file__))
+        added = here not in _sys.path
+        if added:
+            _sys.path.insert(0, here)
+        try:
+            from tools.graftcheck import cli as _gc
+            payload = _gc.run(root=here)
+        finally:
+            if added:
+                try:
+                    _sys.path.remove(here)
+                except ValueError:
+                    pass
+        return {
+            "ok": payload["ok"],
+            "active_findings": len(payload["findings"]),
+            # full finding rows only when something is wrong — the OK
+            # case stays one compact journal line
+            **({"findings": payload["findings"]}
+               if payload["findings"] else {}),
+            "suppressed": payload["suppressed"],
+            "stale_baseline": payload["stale_baseline"],
+            "semantic_checks": payload["semantic_checks"],
+            "recompile_bounds": payload["recompile_bounds"],
+        }
+
+    safe("graftcheck_static_analysis", cfg_graftcheck)
     safe("cfg1_tiny_gpt2_2shard_20tok", cfg1)
 
     if args.quick:

@@ -1,0 +1,56 @@
+"""Pallas TPU API-skew shim: one resolver for ``CompilerParams``.
+
+The Pallas TPU compiler-params dataclass was renamed across jax releases:
+older releases expose ``pltpu.TPUCompilerParams``, newer ones
+``pltpu.CompilerParams`` (the old name first aliased, then removed). The
+kernels in this package (ops.decode_attention, ops.decode_layer,
+ops.flash_attention) were written against the new name, which the
+installed jax may not have — an ``AttributeError`` at kernel-build time
+that has nothing to do with the kernel itself.
+
+``tpu_compiler_params(**kwargs)`` is THE single construction point: it
+resolves whichever class the installed jax exposes, preferring the new
+name. Kernel call sites pass ``compiler_params=tpu_compiler_params(...)``
+and never touch ``pltpu.*CompilerParams`` directly — the lint-friendly
+invariant that keeps the skew fixed in exactly one file.
+
+``HBM`` follows the same pattern for the memory-space rename: newer jax
+spells "leave this ref in HBM, the kernel DMAs it manually" as
+``pltpu.HBM``; older releases spell it ``pltpu.ANY`` (the compiler then
+keeps un-blocked refs in HBM — the semantics the manual-DMA kernels
+rely on either way).
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _resolve():
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = getattr(pltpu, "TPUCompilerParams", None)
+    if cls is None:  # pragma: no cover — every supported jax has one
+        raise AttributeError(
+            "jax.experimental.pallas.tpu exposes neither CompilerParams "
+            "nor TPUCompilerParams; unsupported jax version")
+    return cls
+
+
+def tpu_compiler_params(**kwargs):
+    """Build the installed jax's TPU compiler-params object.
+
+    Keyword names (``dimension_semantics``, ``vmem_limit_bytes``,
+    ``has_side_effects``, ...) are identical across the rename, so the
+    call sites stay version-agnostic.
+    """
+    return _resolve()(**kwargs)
+
+
+# The HBM memory space for BlockSpec(memory_space=...): pltpu.HBM where
+# the installed jax has it, else pltpu.ANY (see module docstring). Two
+# steps, not getattr-with-default: the default would evaluate pltpu.ANY
+# eagerly, breaking import on a jax that has HBM but dropped ANY.
+HBM = getattr(pltpu, "HBM", None)
+if HBM is None:
+    HBM = pltpu.ANY

@@ -54,6 +54,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._pallas_compat import tpu_compiler_params
+from . import _pallas_compat
+
 BLOCK_S = 256          # cache positions per DMA block
 _WRITE_ROWS = 8        # RMW window for the column write (HBM tile rows)
 NEG_INF = -1e30        # f32 additive mask for scores
@@ -208,11 +211,11 @@ def _call(q4, k_new, v_new, vf_bh, KV, meta, *, interpret: bool):
             pl.BlockSpec(memory_space=pltpu.VMEM),  # k_new [BH, 1, hd]
             pl.BlockSpec(memory_space=pltpu.VMEM),  # v_new
             pl.BlockSpec(memory_space=pltpu.VMEM),  # vf [BH, 1, 1] int32
-            pl.BlockSpec(memory_space=pltpu.HBM),   # fused KV (aliased out)
+            pl.BlockSpec(memory_space=_pallas_compat.HBM),   # fused KV (aliased out)
         ],
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),  # out [B, Hkv, g, hd]
-            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=_pallas_compat.HBM),
         ],
         scratch_shapes=[
             pltpu.VMEM((B * Hkv, g, 2 * hd), jnp.float32),  # acc (fused)
@@ -238,7 +241,7 @@ def _call(q4, k_new, v_new, vf_bh, KV, meta, *, interpret: bool):
         # the double buffer alone is ~2*B*Hkv*BLOCK_S*2hd*2 bytes (12.6 MB
         # at GPT-2-124M bs=8) — past the default 16 MB scoped-vmem limit
         # once accumulators join; v5e has 128 MB of VMEM to give
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(meta, q4.reshape(B * Hkv, g, hd),

@@ -51,6 +51,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._pallas_compat import tpu_compiler_params
+from . import _pallas_compat
 from .decode_attention import BLOCK_S, NEG_INF, _WRITE_ROWS
 
 _LANE = 128
@@ -399,10 +401,10 @@ def _build_call(kernel, parts, vmem_operands, KV, meta, *, n_head,
         in_specs=([layer_block(x) for x in parts]
                   + [pl.BlockSpec(memory_space=pltpu.VMEM)
                      for _ in vmem_operands]
-                  + [pl.BlockSpec(memory_space=pltpu.HBM)]),  # KV (aliased)
+                  + [pl.BlockSpec(memory_space=_pallas_compat.HBM)]),  # KV (aliased)
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),            # h out
-            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=_pallas_compat.HBM),
         ],
         scratch_shapes=[
             pltpu.VMEM(h0.shape, h0.dtype),                   # h carry
@@ -424,7 +426,7 @@ def _build_call(kernel, parts, vmem_operands, KV, meta, *, n_head,
             jax.ShapeDtypeStruct(KV.shape, KV.dtype),
         ],
         input_output_aliases={n_in - 1: 1},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=110 * 1024 * 1024),
         interpret=interpret,

@@ -40,6 +40,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._pallas_compat import tpu_compiler_params
+
 NEG_INF = -1e9
 
 
@@ -200,7 +202,7 @@ def _forward_kernel(q, k, v, block_q, block_k, interpret):
             pltpu.VMEM((block_q, 128), jnp.float32),   # running max m
             pltpu.VMEM((block_q, 128), jnp.float32),   # normalizer l
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf)
@@ -316,7 +318,7 @@ def _backward_kernels(q, k, v, out, lse, g, block_q, block_k, interpret):
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, s, hd), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf, dof, lse, dd)
@@ -337,7 +339,7 @@ def _backward_kernels(q, k, v, out, lse, g, block_q, block_k, interpret):
                    jax.ShapeDtypeStruct((b * h, s, hd), q.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, hd), jnp.float32),
                         pltpu.VMEM((block_k, hd), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf, dof, lse, dd)

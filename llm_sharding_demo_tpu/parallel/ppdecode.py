@@ -51,6 +51,23 @@ from ..runtime.engine import (GenerateResult, SamplingConfig, _split_keys,
 from . import partition as Pt
 
 
+def stage_ring_permutation(n_stages: int) -> list:
+    """THE ppermute pairs for one hop along the stage ring:
+    ``[(0, 1), (1, 2), ..., (n_stages - 2, n_stages - 1)]``.
+
+    A *partial bijection* over the stage axis by construction — every
+    source and every destination appears at most once, all in range.
+    The last stage deliberately sends nowhere and stage 0 receives
+    nothing (its lane is refilled by the scan carry); ``ppermute``
+    zero-fills un-addressed destinations, which the tick schedule never
+    reads. Declared as a named function (rather than inlined at the
+    ``ppermute`` call) so the static verifier (tools/graftcheck) can
+    check the bijection property per axis size without tracing the full
+    pipelined program.
+    """
+    return [(j, j + 1) for j in range(n_stages - 1)]
+
+
 class PipelinedDecoder:
     """N-stage pipelined generate as two compiled SPMD programs.
 
@@ -184,7 +201,7 @@ class PipelinedDecoder:
                 # real; everything else is masked out after the scan
                 final = jnp.where(t == n_stages - 1, y, final)
                 incoming = jax.lax.ppermute(
-                    y, pp, [(j, j + 1) for j in range(n_stages - 1)])
+                    y, pp, stage_ring_permutation(n_stages))
                 return (incoming, ck, cv, final), None
 
             (_, ck, cv, final), _ = jax.lax.scan(

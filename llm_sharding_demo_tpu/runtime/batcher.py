@@ -55,6 +55,13 @@ from ..utils.metrics import REGISTRY
 from .engine import DecodeEngine, GenerateResult, SamplingConfig
 
 
+# Static-analysis contract (tools/graftcheck): every ``jax.jit`` site in
+# this module, by holding attribute — an undeclared site is a lint
+# finding (a compiled-program population the recompile budget would
+# silently miss).
+JIT_ENTRY_POINTS = ("_merge",)
+
+
 def _round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 

@@ -52,6 +52,21 @@ REF_TOP_K = 40
 EOS_SEGMENT = 32
 
 
+# Static-analysis contract (tools/graftcheck): every ``jax.jit`` call
+# site in this module must appear here, named by the attribute/function
+# holding the jitted callable — the recompile-budget certifier
+# enumerates these, and an undeclared jit site is a lint finding (a
+# compiled-program population the budget would silently miss).
+JIT_ENTRY_POINTS = ("_prefill", "_prefill_chunked", "_decode_seg")
+
+# Decode hot-loop scopes (tools/graftcheck host-sync rule): functions
+# whose loop bodies sit between compiled decode dispatches, where an
+# accidental ``.item()``/``np.asarray``/``float()`` on a device value
+# stalls the dispatch pipeline. Intentional syncs are baselined in
+# tools/graftcheck/baseline.txt with a justification.
+GRAFTCHECK_HOT_LOOPS = ("DecodeEngine._decode_and_pack",)
+
+
 # EOS check-cap doubling ceiling: checks land at 32, 64, 128, 256, 256...
 # steps, so a long armed decode pays O(log) + steps/256 syncs instead of
 # steps/32. On the tunneled bench chip a sync is ~100 ms ≈ ~300 decode

@@ -98,6 +98,18 @@ from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
                      select_token)
 
 
+# Static-analysis contract (tools/graftcheck): every ``jax.jit`` site in
+# this module, by holding name — enumerated by the recompile-budget
+# certifier; an undeclared site is a lint finding.
+JIT_ENTRY_POINTS = ("_admit_cache",)
+
+# Decode hot-loop scopes (tools/graftcheck host-sync rule): the segment
+# dispatch loop is the zero-sync fast path; the spec variant's syncs are
+# the documented per-segment price and are baselined.
+GRAFTCHECK_HOT_LOOPS = ("IterBatchingEngine._advance",
+                        "IterBatchingEngine._advance_spec")
+
+
 def _next_pow2(n: int) -> int:
     p = 1
     while p < n:

@@ -56,6 +56,12 @@ from ..utils.metrics import REGISTRY
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
                      prepare_generate, select_token)
 
+# Static-analysis contract (tools/graftcheck): every ``jax.jit`` site in
+# this module, by holding attribute — an undeclared site is a lint
+# finding (a compiled-program population the recompile budget would
+# silently miss).
+JIT_ENTRY_POINTS = ("_extend", "_extend_keep")
+
 
 class PrefixCachingEngine:
     """Wraps a ``DecodeEngine`` with a chunk-aligned KV prefix cache.

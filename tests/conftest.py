@@ -68,6 +68,7 @@ _QUICK_MODULES = {
     "test_chunked_prefill", # chunked ≡ monolithic prefill
     "test_subproc",         # watchdog attribution (bench/CI harness)
     "test_tokenizer",       # offline BPE round-trips
+    "test_graftcheck",      # static contract verifier + lint (whole-repo)
 }
 
 

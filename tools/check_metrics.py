@@ -1,117 +1,46 @@
-"""Metric-name catalog lint: every literal metric name at a
-``REGISTRY.inc/observe/gauge`` call site must appear in
-``utils.metrics.METRIC_CATALOG`` with the matching instrument kind.
+"""Thin compatibility shim over ``tools/graftcheck/metric_catalog.py``.
 
-A typo'd metric name doesn't fail — it silently forks a brand-new time
-series that no dashboard is watching (the counter you meant to increment
-stays flat). This lint runs inside the test suite
-(tests/test_check_metrics.py) and is a standalone CLI:
-
-    python tools/check_metrics.py           # scan the package + bench.py
-
-Only literal string names are checked; call sites passing a variable
-(e.g. ``timed(name)``'s forwarding ``reg.observe(name, ...)``) are the
-helper's responsibility and are skipped by construction — the helper's
-CALLERS pass literals, which the regex does catch.
+The metric-name catalog lint (PR 2) is now a graftcheck rule so there is
+ONE lint entry point (``python -m tools.graftcheck``). This module keeps
+the old CLI and the old import surface (``find_violations`` /
+``_iter_sources`` / ``main``, used by tests/test_check_metrics.py and any
+existing automation) working unchanged.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import List, Tuple
 
-_KIND_OF_CALL = {"inc": "counter", "observe": "histogram", "gauge": "gauge"}
+try:                                    # imported as tools.check_metrics
+    from .graftcheck import metric_catalog as _impl
+except ImportError:                     # imported as top-level check_metrics
+    _here = os.path.dirname(os.path.abspath(__file__))
+    _added = _here not in sys.path
+    if _added:
+        sys.path.insert(0, _here)
+    try:
+        from graftcheck import metric_catalog as _impl
+    finally:
+        if _added:                      # scoped insert, same leak-class
+            try:                        # hygiene as the original tool
+                sys.path.remove(_here)
+            except ValueError:
+                pass
 
-# REGISTRY.inc("name"...) / reg.gauge('name'...) / timed("name"...) — the
-# receiver must LOOK like a metrics registry handle (REGISTRY/reg/
-# registry) or the timed() span helper, so pytest fixtures etc. don't
-# false-positive.
-_CALL_RE = re.compile(
-    r"\b(?:REGISTRY|reg|registry)\s*\)?\s*\.\s*(inc|observe|gauge)\s*\(\s*"
-    r"[\"']([A-Za-z_:][A-Za-z0-9_:]*)[\"']")
-_TIMED_RE = re.compile(r"\btimed\s*\(\s*[\"']([A-Za-z_:][A-Za-z0-9_:]*)[\"']")
-
-
-def _iter_sources(root: str) -> List[str]:
-    """Production call sites: the package tree + bench.py (tests mint
-    local throwaway names on purpose — they are not scraped)."""
-    out = []
-    pkg = os.path.join(root, "llm_sharding_demo_tpu")
-    for dirpath, _, files in os.walk(pkg):
-        out.extend(os.path.join(dirpath, f)
-                   for f in files if f.endswith(".py"))
-    bench = os.path.join(root, "bench.py")
-    if os.path.exists(bench):
-        out.append(bench)
-    return sorted(out)
-
-
-def find_violations(paths: List[str],
-                    catalog=None) -> List[Tuple[str, int, str, str]]:
-    """(path, line_no, name, problem) for every call-site metric name
-    missing from the catalog or used with the wrong instrument kind."""
-    if catalog is None:
-        from llm_sharding_demo_tpu.utils.metrics import METRIC_CATALOG
-        catalog = METRIC_CATALOG
-    bad = []
-    for path in paths:
-        with open(path, encoding="utf-8") as f:
-            text = f.read()
-
-        def lineno(pos: int) -> int:
-            return text.count("\n", 0, pos) + 1
-
-        # whole-file scan (the `\s*` spans newlines), so a name literal
-        # pushed to a continuation line by line-length wrapping is still
-        # checked — a per-line scan would silently skip exactly the
-        # typo class this tool exists to catch
-        for m in _CALL_RE.finditer(text):
-            call, name = m.group(1), m.group(2)
-            want = catalog.get(name)
-            if want is None:
-                bad.append((path, lineno(m.start()), name,
-                            "not in METRIC_CATALOG"))
-            elif want != _KIND_OF_CALL[call]:
-                bad.append((path, lineno(m.start()), name,
-                            f"catalog says {want}, call site "
-                            f"uses .{call}()"))
-        for m in _TIMED_RE.finditer(text):
-            name = m.group(1)
-            want = catalog.get(name)
-            if want is None:
-                bad.append((path, lineno(m.start()), name,
-                            "not in METRIC_CATALOG"))
-            elif want != "histogram":
-                bad.append((path, lineno(m.start()), name,
-                            f"catalog says {want}, timed() "
-                            "records a histogram"))
-    return sorted(bad)
+_CALL_RE = _impl._CALL_RE
+_TIMED_RE = _impl._TIMED_RE
+_KIND_OF_CALL = _impl._KIND_OF_CALL
+_iter_sources = _impl._iter_sources
+find_violations = _impl.find_violations
 
 
 def main(argv=None) -> int:
-    root = (argv or sys.argv[1:] or
-            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))])[0]
-    # scoped path insert: the test suite calls main() in-process, and a
-    # permanent sys.path[0] prepend would leak into every later test
-    # (the same leak class the _mega_mosaic_smoke satellite fixed)
-    sys.path.insert(0, root)
-    try:
-        bad = find_violations(_iter_sources(root))
-    finally:
-        try:
-            sys.path.remove(root)
-        except ValueError:
-            pass
-    for path, line, name, problem in bad:
-        print(f"{path}:{line}: metric {name!r}: {problem}")
-    if bad:
-        print(f"{len(bad)} metric-catalog violation(s); add the name to "
-              "utils/metrics.py METRIC_CATALOG or fix the call site")
-        return 1
-    print("metric catalog OK")
-    return 0
+    # default root resolves relative to THIS file (tools/ -> repo root),
+    # exactly as the pre-shim CLI did
+    root = (argv or sys.argv[1:]
+            or [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))])
+    return _impl.main([root[0]])
 
 
 if __name__ == "__main__":

@@ -1,0 +1,28 @@
+"""graftcheck: compile-free contract verifier + TPU-footgun lint suite.
+
+Two passes, one entry point (``python -m tools.graftcheck``; in-suite
+driver ``tests/test_graftcheck.py``):
+
+- **Pass 1 — semantic** (``semantic``, ``recompile``): abstract
+  evaluation (``jax.eval_shape`` / ``jax.make_jaxpr`` on CPU-mesh
+  stand-ins — no compute, no TPU, no XLA compile of model programs) of
+  the contracts the runtime otherwise only checks by executing them:
+  inter-stage shape/dtype contracts for every registered family x
+  partition plan, PartitionSpec validity against the mesh, ``ppermute``
+  bijection over the stage axis, and a recompile-budget certifier that
+  statically bounds the jitted-program space per serving config.
+- **Pass 2 — lint** (``lint``): AST rules for TPU serving footguns —
+  host syncs in decode hot loops, ``jax.jit`` in per-request scope,
+  implicitly captured closure state in jitted functions, wall-clock
+  reads under jit, metrics/tracing calls under jit (silent no-ops), and
+  the metric-name catalog (the former ``tools/check_metrics.py``, now a
+  rule here).
+
+Findings are suppressed per (rule, file, scope) by
+``tools/graftcheck/baseline.txt`` — one line per intentional keep, with
+a justification. Anything not baselined fails the run.
+"""
+
+from .core import Finding, load_baseline, split_findings  # noqa: F401
+
+__all__ = ["Finding", "load_baseline", "split_findings"]

@@ -1,0 +1,117 @@
+"""graftcheck CLI: ``python -m tools.graftcheck [--json] [--lint-only]``.
+
+Exit code 0 iff every finding from both passes is baselined. ``--json``
+emits one machine-readable object (journaled by bench.py alongside the
+perf matrix, so contract drift shows up in the perf trajectory too).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import load_baseline, split_findings
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def run(root: str = None, lint_only: bool = False,
+        baseline_path: str = None) -> dict:
+    """Both passes -> one JSON-able payload. Import-light until called;
+    the semantic pass imports jax (CPU stand-ins only)."""
+    root = root or _repo_root()
+    # scoped insert (the same leak-class hygiene as the check_metrics
+    # shim): in-suite callers run() in-process, and a permanent prepend
+    # would leak into every later test
+    added = root not in sys.path
+    if added:
+        sys.path.insert(0, root)
+    try:
+        from . import lint
+        findings = list(lint.run_lint(root))
+        semantic_checks = 0
+        bounds = {}
+        if not lint_only:
+            from . import recompile, registry, semantic
+            sem, semantic_checks = semantic.run_semantic()
+            findings.extend(sem)
+            for label, desc, calls in registry.serving_workloads():
+                for call in calls:
+                    for problem in recompile.planner_invariants(desc, call):
+                        from .core import Finding
+                        findings.append(Finding(
+                            "recompile-budget",
+                            "llm_sharding_demo_tpu/runtime/engine.py", 1,
+                            label, problem))
+                        semantic_checks += 1
+                bounds[label] = recompile.certify(desc, calls)
+                semantic_checks += len(calls)
+    finally:
+        if added:
+            try:
+                sys.path.remove(root)
+            except ValueError:
+                pass
+
+    baseline = load_baseline(baseline_path)
+    active, suppressed, stale = split_findings(findings, baseline)
+    return {
+        "ok": not active,
+        "findings": [f.to_dict() for f in active],
+        "suppressed": len(suppressed),
+        "stale_baseline": sorted("::".join(k[1:]) + f" [{k[0]}]"
+                                 for k in stale),
+        "semantic_checks": semantic_checks,
+        "recompile_bounds": bounds,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftcheck",
+        description="compile-free contract verifier + TPU-footgun lints")
+    ap.add_argument("--root", default=None, help="repo root (default: "
+                    "the checkout containing this tool)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON object instead of text")
+    ap.add_argument("--lint-only", action="store_true",
+                    help="skip the semantic (jax-tracing) pass")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: tools/graftcheck/"
+                    "baseline.txt)")
+    args = ap.parse_args(argv)
+
+    # standalone runs stay off any real accelerator: the semantic pass
+    # needs only abstract avals/meshes. In-suite callers import run()
+    # directly and keep their own backend config.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    payload = run(root=args.root, lint_only=args.lint_only,
+                  baseline_path=args.baseline)
+    if args.json:
+        print(json.dumps(payload, indent=2, default=str))
+    else:
+        for f in payload["findings"]:
+            print(f"{f['path']}:{f['line']}: [{f['rule']}] {f['message']}"
+                  f"  (scope: {f['scope']})")
+        for s in payload["stale_baseline"]:
+            print(f"stale baseline entry (fixed? delete the line): {s}")
+        n = len(payload["findings"])
+        print(f"graftcheck: {n} active finding(s), "
+              f"{payload['suppressed']} baselined, "
+              f"{payload['semantic_checks']} semantic checks"
+              + ("" if args.lint_only else
+                 f", recompile bounds for {len(payload['recompile_bounds'])}"
+                 " workload(s)"))
+        if payload["ok"]:
+            print("graftcheck OK")
+    return 0 if payload["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

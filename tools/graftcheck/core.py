@@ -1,0 +1,91 @@
+"""Finding model + baseline suppressions shared by both graftcheck passes.
+
+A finding is keyed by ``(rule, path, scope)`` — NOT by line number, so a
+baselined intentional keep survives unrelated edits to the file above it.
+``scope`` is the enclosing function's qualname (``Class.method`` /
+``func.<locals>.inner``) or ``<module>`` for module-level findings; the
+semantic pass uses contract coordinates (``family/plan/stage``) instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Iterable, List, Set, Tuple
+
+Key = Tuple[str, str, str]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str           # repo-relative, forward slashes
+    line: int
+    scope: str
+    message: str
+
+    @property
+    def key(self) -> Key:
+        return (self.rule, self.path, self.scope)
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "scope": self.scope, "message": self.message}
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.txt")
+
+
+def load_baseline(path: str = None) -> Dict[Key, str]:
+    """Parse baseline lines: ``rule<ws>path::scope<ws>justification``.
+
+    ``#`` starts a comment; blank lines are skipped. The justification is
+    mandatory — a suppression nobody can explain is a bug with a permit.
+    """
+    path = path or default_baseline_path()
+    out: Dict[Key, str] = {}
+    if not os.path.exists(path):
+        return out
+    with open(path, encoding="utf-8") as f:
+        for n, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split(None, 2)
+            if len(parts) < 3 or "::" not in parts[1]:
+                raise ValueError(
+                    f"{path}:{n}: malformed baseline line (want "
+                    f"'rule path::scope justification'): {line!r}")
+            rule, loc, why = parts
+            fpath, _, scope = loc.rpartition("::")
+            out[(rule, fpath, scope)] = why
+    return out
+
+
+def split_findings(findings: Iterable[Finding],
+                   baseline: Dict[Key, str],
+                   ) -> Tuple[List[Finding], List[Finding], Set[Key]]:
+    """-> (active, suppressed, stale_baseline_keys).
+
+    A baseline entry suppresses EVERY finding in its (rule, path, scope)
+    — intentional keeps usually come in small clusters (e.g. the several
+    fetches of one documented sync point) and one justification covers
+    the scope. Stale keys (baselined but nothing found) are reported so
+    fixed findings get their suppression removed.
+    """
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    hit: Set[Key] = set()
+    for f in findings:
+        if f.key in baseline:
+            suppressed.append(f)
+            hit.add(f.key)
+        else:
+            active.append(f)
+    stale = set(baseline) - hit
+    return active, suppressed, stale

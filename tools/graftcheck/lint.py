@@ -1,0 +1,564 @@
+"""Pass 2: AST lint rules for TPU serving footguns.
+
+Shared driver: every scanned module is parsed ONCE into a ``ModuleInfo``
+(AST + resolved jit sites/targets + in-file declarations), and each rule
+is a function ``rule(mod) -> [Finding]``. Being AST-based, every rule is
+wrap-tolerant by construction — a call split across continuation lines
+is one ``ast.Call`` either way.
+
+In-file declarations the rules key on (the "registration annotations"
+the analyzer needs — grep for them in ``runtime/``):
+
+- ``JIT_ENTRY_POINTS``: tuple of attribute/function names holding the
+  module's jitted callables. The ``undeclared-jit`` rule enforces that
+  every ``jax.jit`` call site in a runtime module is declared (and no
+  declaration is stale) — the recompile-budget certifier
+  (``recompile.py``) enumerates exactly these, so an undeclared site
+  would be a compiled-program population the budget silently misses.
+- ``GRAFTCHECK_HOT_LOOPS``: qualnames of decode hot-loop scopes — the
+  functions whose bodies sit between compiled decode dispatches. The
+  ``host-sync`` rule flags device->host synchronization inside them.
+
+Rules (ids in brackets):
+
+- [undeclared-jit]   jax.jit site in runtime/ not in JIT_ENTRY_POINTS
+                     (or a stale declaration).
+- [host-sync]        ``.item()`` / ``float()``/``int()`` on non-literals
+                     / ``np.asarray``/``np.array`` /
+                     ``block_until_ready`` inside a declared hot loop.
+- [jit-in-handler]   ``jax.jit`` invoked in per-request scope (inside
+                     any function) in ``serving/`` — jit belongs in
+                     construction scope; a per-request jit retraces and
+                     recompiles on every call.
+- [jit-closure]      implicitly captured closure state in a jitted
+                     lambda/nested function: a free variable that is not
+                     a parameter, module-level name, enclosing ``def``,
+                     or ``self`` gets baked in silently at trace time
+                     (explicit default-arg binding ``_x=x`` is the
+                     sanctioned pattern and does not flag).
+- [time-in-jit]      ``time.time()``/``perf_counter()``/``monotonic()``
+                     inside a jit target — traced once, constant
+                     forever after.
+- [metrics-in-jit]   ``REGISTRY.inc/observe/gauge`` / ``tracing.record/
+                     span`` / ``timed(...)`` inside a jit target —
+                     silent no-ops per the PR 2 contextvar design (they
+                     run at trace time, not per step).
+- [metric-catalog]   the former tools/check_metrics.py (see
+                     ``metric_catalog.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import dataclasses
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding
+
+_HOST_SYNC_NP = {"asarray", "array"}
+_TIME_CALLS = {"time", "perf_counter", "monotonic", "time_ns",
+               "perf_counter_ns"}
+_METRIC_RECEIVERS = {"REGISTRY", "reg", "registry"}
+_METRIC_METHODS = {"inc", "observe", "gauge"}
+_TRACING_CALLS = {"record", "span", "timed", "annotate_span"}
+
+
+# -- module model ------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class JitSite:
+    line: int
+    name: Optional[str]          # holding attr/def name, if resolvable
+    target: Optional[ast.AST]    # the jitted FunctionDef/Lambda node, if
+                                 # resolvable within this module
+    enclosing: str               # qualname of the enclosing function or
+                                 # "<module>"
+    depth: int                   # 0 = module level
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    path: str
+    relpath: str
+    source: str
+    tree: ast.Module
+    qualname_of: Dict[ast.AST, str]
+    functions: Dict[str, ast.AST]          # qualname -> def node
+    module_names: Set[str]                 # names bound at module level
+    jit_sites: List[JitSite]
+    declared_entry_points: Set[str]
+    declared_hot_loops: Set[str]
+    entry_decl_line: int
+    jit_target_quals: Set[str]             # qualnames of jitted defs
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    """``jax.jit`` as an expression (Attribute) — the repo's only form."""
+    return (isinstance(node, ast.Attribute) and node.attr == "jit"
+            and isinstance(node.value, ast.Name) and node.value.id == "jax")
+
+
+def _jit_call(node: ast.AST) -> Optional[ast.Call]:
+    """The Call whose programs a jit cache will hold, if ``node`` is one:
+    ``jax.jit(...)`` or ``functools.partial(jax.jit, ...)``."""
+    if not isinstance(node, ast.Call):
+        return None
+    if _is_jax_jit(node.func):
+        return node
+    if (isinstance(node.func, ast.Attribute) and node.func.attr == "partial"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "functools"
+            and node.args and _is_jax_jit(node.args[0])):
+        return node
+    return None
+
+
+def _string_tuple(node: ast.AST) -> Optional[Set[str]]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = set()
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, str)):
+                return None
+            vals.add(elt.value)
+        return vals
+    return None
+
+
+class _Indexer(ast.NodeVisitor):
+    """One walk building qualnames, declarations, and jit sites."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.stack: List[str] = []        # enclosing def/class names
+        self.kind_stack: List[str] = []   # "class" | "def"
+
+    # -- scopes --
+
+    def _qual(self, name: str) -> str:
+        parts = []
+        for n, k in zip(self.stack, self.kind_stack):
+            parts.append(n)
+            if k == "def":
+                parts.append("<locals>")
+        if parts and parts[-1] == "<locals>":
+            pass
+        return ".".join(parts + [name]).replace(".<locals>.", ".<locals>.")
+
+    def _enclosing_fn(self) -> str:
+        for n, k in reversed(list(zip(self.stack, self.kind_stack))):
+            if k == "def":
+                # rebuild the def's qualname
+                idx = len(self.stack) - 1 - self.stack[::-1].index(n)
+                return self._join(self.stack[:idx], self.kind_stack[:idx], n)
+        return "<module>"
+
+    @staticmethod
+    def _join(stack, kinds, name) -> str:
+        parts = []
+        for n, k in zip(stack, kinds):
+            parts.append(n)
+            if k == "def":
+                parts.append("<locals>")
+        return ".".join(parts + [name])
+
+    def _fn_depth(self) -> int:
+        return sum(1 for k in self.kind_stack if k == "def")
+
+    # -- visitors --
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        if not self.stack:
+            # a module-level class is as safe a lambda reference as the
+            # module-level functions/imports already whitelisted
+            self.mod.module_names.add(node.name)
+        self._visit_scope(node, "class")
+
+    def visit_FunctionDef(self, node):
+        self._handle_def(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _handle_def(self, node):
+        qual = self._join(self.stack, self.kind_stack, node.name)
+        self.mod.qualname_of[node] = qual
+        self.mod.functions[qual] = node
+        if not self.stack:
+            self.mod.module_names.add(node.name)
+        # decorator form: @jax.jit / @functools.partial(jax.jit, ...)
+        for dec in node.decorator_list:
+            if _is_jax_jit(dec) or _jit_call(dec) is not None:
+                dec._gc_seen = True
+                self.mod.jit_sites.append(JitSite(
+                    line=node.lineno, name=node.name, target=node,
+                    enclosing=self._enclosing_fn(),
+                    depth=self._fn_depth()))
+                self.mod.jit_target_quals.add(qual)
+        self._visit_scope(node, "def")
+
+    def _visit_scope(self, node, kind: str):
+        self.stack.append(node.name)
+        self.kind_stack.append(kind)
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self.stack.pop()
+        self.kind_stack.pop()
+
+    def visit_Assign(self, node: ast.Assign):
+        # declarations
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                if not self.stack or self.kind_stack == ["class"] * len(
+                        self.kind_stack):
+                    if tgt.id == "JIT_ENTRY_POINTS":
+                        vals = _string_tuple(node.value)
+                        if vals is not None:
+                            self.mod.declared_entry_points |= vals
+                            self.mod.entry_decl_line = node.lineno
+                    elif tgt.id == "GRAFTCHECK_HOT_LOOPS":
+                        vals = _string_tuple(node.value)
+                        if vals is not None:
+                            self.mod.declared_hot_loops |= vals
+                if not self.stack:
+                    self.mod.module_names.add(tgt.id)
+        # jit assignment forms: ``self.X = jax.jit(f, ...)`` and
+        # ``X = jax.jit(f, ...)``
+        call = _jit_call(node.value)
+        if call is not None:
+            call._gc_seen = True
+            name = None
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Attribute):
+                name = tgt.attr
+            elif isinstance(tgt, ast.Name):
+                name = tgt.id
+            self.mod.jit_sites.append(JitSite(
+                line=node.lineno, name=name,
+                target=self._resolve_target(call),
+                enclosing=self._enclosing_fn(), depth=self._fn_depth()))
+        self.generic_visit(node)
+
+    def visit_Import(self, node):
+        if not self.stack:
+            for a in node.names:
+                self.mod.module_names.add(
+                    (a.asname or a.name).split(".")[0])
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        if not self.stack:
+            for a in node.names:
+                self.mod.module_names.add(a.asname or a.name)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        # bare jit calls not captured by Assign/decorator (e.g.
+        # ``return jax.jit(...)`` or a jit inside an expression)
+        call = _jit_call(node)
+        if call is not None and not getattr(node, "_gc_seen", False):
+            self.mod.jit_sites.append(JitSite(
+                line=node.lineno, name=None,
+                target=self._resolve_target(call),
+                enclosing=self._enclosing_fn(), depth=self._fn_depth()))
+        self.generic_visit(node)
+
+    def _resolve_target(self, call: ast.Call) -> Optional[ast.AST]:
+        """The function node being jitted, when it is visible here:
+        a direct Lambda, or a Name/`self.X` resolved later by qualname."""
+        args = call.args
+        if _is_jax_jit(call.func):
+            fn = args[0] if args else None
+        else:  # functools.partial(jax.jit, f, ...)
+            fn = args[1] if len(args) > 1 else None
+        return fn
+
+
+def _dedupe_sites(sites: List[JitSite]) -> List[JitSite]:
+    """Assign/decorator visitors and the Call visitor can both see one
+    site; collapse by (line): prefer the named record."""
+    by_line: Dict[int, JitSite] = {}
+    for s in sites:
+        prev = by_line.get(s.line)
+        if prev is None or (prev.name is None and s.name is not None):
+            by_line[s.line] = s
+    return [by_line[k] for k in sorted(by_line)]
+
+
+def index_module(path: str, root: str) -> Optional[ModuleInfo]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return None
+    mod = ModuleInfo(path=path,
+                     relpath=os.path.relpath(path, root).replace(os.sep, "/"),
+                     source=source, tree=tree, qualname_of={}, functions={},
+                     module_names=set(), jit_sites=[],
+                     declared_entry_points=set(), declared_hot_loops=set(),
+                     entry_decl_line=0, jit_target_quals=set())
+    _Indexer(mod).visit(tree)
+    mod.jit_sites = _dedupe_sites(mod.jit_sites)
+    return mod
+
+
+# -- jitted-body resolution ---------------------------------------------------
+
+
+def _jitted_function_nodes(mod: ModuleInfo) -> List[Tuple[str, ast.AST]]:
+    """(qualname-or-label, def/lambda node) for every function this
+    module jits and whose body is visible in the module: decorated defs,
+    ``jax.jit(self.X_impl)`` methods, ``jax.jit(local_fn)`` defs, and
+    direct lambdas."""
+    out: List[Tuple[str, ast.AST]] = []
+    seen: Set[int] = set()
+
+    def add(label, node):
+        if node is not None and id(node) not in seen:
+            seen.add(id(node))
+            out.append((label, node))
+
+    for qual in mod.jit_target_quals:
+        add(qual, mod.functions.get(qual))
+    for site in mod.jit_sites:
+        t = site.target
+        if isinstance(t, ast.Lambda):
+            add(f"{site.enclosing}:<lambda@{t.lineno}>", t)
+        elif isinstance(t, ast.Attribute) and t.attr in _suffix_index(mod):
+            add(*_suffix_index(mod)[t.attr])
+        elif isinstance(t, ast.Name):
+            # a local or module-level def with this trailing name
+            hit = _suffix_index(mod).get(t.id)
+            if hit is not None:
+                add(*hit)
+    return out
+
+
+def _suffix_index(mod: ModuleInfo) -> Dict[str, Tuple[str, ast.AST]]:
+    idx = getattr(mod, "_gc_suffix_idx", None)
+    if idx is None:
+        idx = {}
+        for qual, node in mod.functions.items():
+            leaf = qual.rpartition(".")[2]
+            idx.setdefault(leaf, (qual, node))
+        mod._gc_suffix_idx = idx
+    return idx
+
+
+# -- rules -------------------------------------------------------------------
+
+
+def rule_undeclared_jit(mod: ModuleInfo) -> List[Finding]:
+    """runtime/ modules must declare every jit site in JIT_ENTRY_POINTS."""
+    if "/runtime/" not in "/" + mod.relpath:
+        return []
+    out = []
+    site_names = {s.name for s in mod.jit_sites if s.name is not None}
+    for s in mod.jit_sites:
+        if s.name is None:
+            out.append(Finding(
+                "undeclared-jit", mod.relpath, s.line, s.enclosing,
+                "jax.jit call site not held by a nameable attribute — "
+                "the recompile-budget certifier cannot enumerate it; "
+                "bind it to an attribute and declare it in "
+                "JIT_ENTRY_POINTS"))
+        elif s.name not in mod.declared_entry_points:
+            out.append(Finding(
+                "undeclared-jit", mod.relpath, s.line, s.enclosing,
+                f"jit site {s.name!r} missing from this module's "
+                "JIT_ENTRY_POINTS declaration (the recompile-budget "
+                "certifier enumerates declared entry points only)"))
+    for name in sorted(mod.declared_entry_points - site_names):
+        out.append(Finding(
+            "undeclared-jit", mod.relpath, mod.entry_decl_line or 1,
+            "<module>",
+            f"JIT_ENTRY_POINTS declares {name!r} but no jax.jit site "
+            "binds it (stale declaration)"))
+    return out
+
+
+def _call_repr(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        base = f.value.id if isinstance(f.value, ast.Name) else "..."
+        return f"{base}.{f.attr}()"
+    if isinstance(f, ast.Name):
+        return f"{f.id}()"
+    return "call"
+
+
+def _host_sync_calls(fn_node: ast.AST) -> List[Tuple[int, str]]:
+    hits = []
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "item" and not node.args:
+                hits.append((node.lineno, ".item() host-syncs the value"))
+            elif (f.attr in _HOST_SYNC_NP
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in ("np", "numpy")):
+                hits.append((node.lineno,
+                             f"{_call_repr(node)} copies device->host"))
+            elif f.attr == "block_until_ready":
+                hits.append((node.lineno,
+                             "block_until_ready() stalls the dispatch "
+                             "pipeline"))
+        elif (isinstance(f, ast.Name) and f.id in ("float", "int")
+                and node.args
+                and not isinstance(node.args[0], ast.Constant)):
+            hits.append((node.lineno,
+                         f"{f.id}() on a non-literal host-syncs a "
+                         "device value"))
+    return hits
+
+
+def rule_host_sync(mod: ModuleInfo) -> List[Finding]:
+    out = []
+    for qual in sorted(mod.declared_hot_loops):
+        fn = mod.functions.get(qual)
+        if fn is None:
+            out.append(Finding(
+                "host-sync", mod.relpath, 1, "<module>",
+                f"GRAFTCHECK_HOT_LOOPS names {qual!r} but no such "
+                "function exists in this module (stale declaration)"))
+            continue
+        for line, msg in _host_sync_calls(fn):
+            out.append(Finding("host-sync", mod.relpath, line, qual,
+                               msg + " inside a decode hot loop"))
+    return out
+
+
+def rule_jit_in_handler(mod: ModuleInfo) -> List[Finding]:
+    if "/serving/" not in "/" + mod.relpath:
+        return []
+    return [Finding(
+        "jit-in-handler", mod.relpath, s.line, s.enclosing,
+        "jax.jit invoked in per-request scope — every call retraces and "
+        "recompiles; build jitted callables once at construction")
+        for s in mod.jit_sites if s.depth >= 1]
+
+
+def _lambda_free_names(lam: ast.Lambda, mod: ModuleInfo,
+                       enclosing_defs: Set[str]) -> List[Tuple[int, str]]:
+    params = {a.arg for a in (lam.args.args + lam.args.kwonlyargs
+                              + lam.args.posonlyargs)}
+    if lam.args.vararg:
+        params.add(lam.args.vararg.arg)
+    if lam.args.kwarg:
+        params.add(lam.args.kwarg.arg)
+    known = (params | mod.module_names | set(dir(builtins))
+             | enclosing_defs | {"self", "cls"})
+    hits, seen = [], set()
+    for node in ast.walk(lam.body):
+        if (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)
+                and node.id not in known and node.id not in seen):
+            seen.add(node.id)
+            hits.append((node.lineno, node.id))
+    return hits
+
+
+def rule_jit_closure(mod: ModuleInfo) -> List[Finding]:
+    """Implicit closure capture in jitted lambdas: a free variable is
+    baked in at trace time; if it later changes (or is unhashable
+    non-array state) the program silently disagrees with the source.
+    Explicit default-arg binding (``_x=x``) is the sanctioned pattern."""
+    out = []
+    enclosing_defs = {q.rpartition(".")[2] for q in mod.functions}
+    for site in mod.jit_sites:
+        if not isinstance(site.target, ast.Lambda):
+            continue
+        for line, name in _lambda_free_names(site.target, mod,
+                                             enclosing_defs):
+            out.append(Finding(
+                "jit-closure", mod.relpath, line, site.enclosing,
+                f"jitted lambda implicitly captures {name!r} from the "
+                "enclosing scope (baked in at trace time); bind it "
+                f"explicitly with a default arg (_x={name})"))
+    return out
+
+
+def _jit_body_calls(mod: ModuleInfo, match) -> List[Tuple[str, int, str]]:
+    hits = []
+    for label, fn in _jitted_function_nodes(mod):
+        body = fn.body if isinstance(fn, ast.Lambda) else fn
+        for node in ast.walk(body):
+            if isinstance(node, ast.Call):
+                msg = match(node)
+                if msg:
+                    hits.append((label, node.lineno, msg))
+    return hits
+
+
+def rule_time_in_jit(mod: ModuleInfo) -> List[Finding]:
+    def match(node: ast.Call):
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in _TIME_CALLS
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("time", "_time")):
+            return (f"time.{f.attr}() inside a jitted function runs at "
+                    "trace time only — the compiled program reuses one "
+                    "frozen value")
+        return None
+
+    return [Finding("time-in-jit", mod.relpath, line, label, msg)
+            for label, line, msg in _jit_body_calls(mod, match)]
+
+
+def rule_metrics_in_jit(mod: ModuleInfo) -> List[Finding]:
+    def match(node: ast.Call):
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in _METRIC_METHODS
+                and isinstance(f.value, ast.Name)
+                and f.value.id in _METRIC_RECEIVERS):
+            return (f"{f.value.id}.{f.attr}(...) under jit records at "
+                    "trace time only (silent no-op per step); move it "
+                    "off the compiled path")
+        if (isinstance(f, ast.Attribute) and f.attr in _TRACING_CALLS
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "tracing"):
+            return (f"tracing.{f.attr}(...) under jit records at trace "
+                    "time only; spans belong outside compiled programs")
+        if isinstance(f, ast.Name) and f.id == "timed":
+            return ("timed(...) under jit measures tracing, not steps; "
+                    "move it off the compiled path")
+        return None
+
+    return [Finding("metrics-in-jit", mod.relpath, line, label, msg)
+            for label, line, msg in _jit_body_calls(mod, match)]
+
+
+RULES = (rule_undeclared_jit, rule_host_sync, rule_jit_in_handler,
+         rule_jit_closure, rule_time_in_jit, rule_metrics_in_jit)
+
+RULE_IDS = ("undeclared-jit", "host-sync", "jit-in-handler", "jit-closure",
+            "time-in-jit", "metrics-in-jit", "metric-catalog")
+
+
+def iter_sources(root: str) -> List[str]:
+    """Same production surface as the metric-catalog rule: the package
+    tree + bench.py."""
+    from .metric_catalog import _iter_sources
+    return _iter_sources(root)
+
+
+def run_lint(root: str, paths: Optional[List[str]] = None,
+             with_metric_catalog: bool = True) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in (paths if paths is not None else iter_sources(root)):
+        mod = index_module(path, root)
+        if mod is None:
+            findings.append(Finding(
+                "syntax", os.path.relpath(path, root).replace(os.sep, "/"),
+                1, "<module>", "file does not parse"))
+            continue
+        for rule in RULES:
+            findings.extend(rule(mod))
+    if with_metric_catalog:
+        from . import metric_catalog
+        findings.extend(metric_catalog.as_findings(root))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

@@ -1,0 +1,77 @@
+"""The semantic pass's contract registry: families x plans x schedules.
+
+Tiny CPU stand-in configs — the semantic pass runs everything through
+``jax.eval_shape``/``jax.make_jaxpr``, so only shapes matter and tracing
+a 4-layer / 8-wide model covers the same contract code paths as the
+real checkpoints. Mesh axes are validated against
+``jax.sharding.AbstractMesh`` stand-ins: no devices, no placement, no
+compile.
+
+Adding a family or plan here puts it under every check in
+``semantic.run_semantic`` (stage contracts, pspec validity, padded
+stacking round-trip, ring-permutation bijection).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+
+def families() -> Dict[str, tuple]:
+    """name -> (family module, tiny config). Stand-ins keep every
+    divisibility property of the real configs (head_dim, kv grouping)
+    at trace-instant sizes."""
+    from llm_sharding_demo_tpu.models import gpt2, llama, moe
+    return {
+        "gpt2-tiny": (gpt2, gpt2.GPT2Config(
+            vocab_size=96, n_positions=64, n_embd=8, n_layer=4, n_head=2)),
+        "llama-tiny": (llama, llama.LlamaConfig(
+            vocab_size=96, n_positions=64, n_embd=8, n_layer=4, n_head=2,
+            n_kv_head=1, intermediate_size=16)),
+        "moe-tiny": (moe, moe.MoEConfig(
+            vocab_size=96, n_positions=64, n_embd=8, n_layer=2, n_head=2,
+            n_experts=4, expert_top_k=2)),
+    }
+
+
+# partition plans per n_layer=4 stageable family: interior boundaries.
+# Balanced 1/2/4-stage plans plus the uneven plans (padded stacking).
+STAGE_PLANS: Tuple[Tuple[str, tuple], ...] = (
+    ("1-stage", ()),
+    ("2-stage", (2,)),
+    ("4-stage", (1, 2, 3)),
+    ("uneven-1+3", (1,)),
+    ("uneven-3+1", (3,)),
+    ("uneven-1+2+1", (1, 3)),
+)
+
+# mesh stand-ins for the PartitionSpec checks (axis name -> size)
+MESHES: Dict[str, Dict[str, int]] = {
+    "tp2": {"tp": 2},
+    "dp2-tp2": {"dp": 2, "tp": 2},
+    "ep2-tp2": {"ep": 2, "tp": 2},
+    "pp4": {"pp": 4},
+}
+
+# stage-axis sizes the ppermute ring is verified over
+RING_SIZES: Tuple[int, ...] = (1, 2, 3, 4, 8)
+
+
+def serving_workloads() -> List[tuple]:
+    """(label, EngineDesc kwargs, workload) rows the CLI certifies —
+    canonical shapes of the serving configs the runtime tests pin (the
+    full equality-vs-observed-cache-size check drives REAL engines and
+    lives in tests/test_graftcheck.py)."""
+    from . import recompile as R
+    greedy = R.greedy_sampling()
+    return [
+        ("solo-greedy", R.EngineDesc(max_seq=64),
+         [R.GenerateCall(prompt_lens=(8,), max_new=4, sampling=greedy)]),
+        ("batch2-greedy", R.EngineDesc(max_seq=64),
+         [R.GenerateCall(prompt_lens=(8, 8), max_new=4, sampling=greedy)]),
+        ("chunked-prefill", R.EngineDesc(max_seq=128, prefill_chunk=16),
+         [R.GenerateCall(prompt_lens=(40,), max_new=8, sampling=greedy)]),
+        ("long-decode-windows", R.EngineDesc(max_seq=1024),
+         [R.GenerateCall(prompt_lens=(16,), max_new=700,
+                         sampling=greedy)]),
+    ]

@@ -1,0 +1,458 @@
+"""Pass 1: semantic contract verification by abstract evaluation.
+
+Everything here runs under ``jax.eval_shape`` / ``jax.make_jaxpr`` on
+CPU-mesh stand-ins (``jax.sharding.AbstractMesh``): shapes and dtypes
+propagate through the REAL model/partition code, but no model program is
+compiled and no device computes — the whole pass traces in well under a
+second, so it runs on every test invocation.
+
+Checks (each a function usable standalone on fixtures; ``run_semantic``
+drives them over the registry):
+
+- **Inter-stage contracts** (``check_stage_contracts``): for a family x
+  partition plan, every stage's output aval must equal the next stage's
+  input aval — ``[B, S]`` int32 into stage 0, the family hidden aval
+  ``[B, S, D]`` (engine dtype) between stages (uneven/padded plans
+  included), ``[B, S, vocab]`` out of the last — and each stage's cache
+  must come back shape/dtype-identical (the decode scan carries it).
+- **Partition plan validity** (``check_partition_plan``): overlapping /
+  non-exhaustive / empty-stage plans are rejected with the partitioner's
+  own diagnostic, surfaced as a finding.
+- **Padded stacking round-trip** (``check_padded_stacking``): for uneven
+  plans, ``unstack(stack(params))`` must reproduce the block avals
+  exactly and the validity mask must count exactly ``n_layer`` true
+  rows.
+- **PartitionSpec validity** (``check_pspec_tree``): every spec leaf
+  names only axes the mesh has, has rank <= array rank, uses no mesh
+  axis twice, and shards only dims divisible by the axis size.
+- **ppermute bijection** (``check_permutation`` /
+  ``collect_ppermutes``): the stage-ring permutation must be a partial
+  bijection over the axis (each source/destination at most once, all in
+  range). ``collect_ppermutes`` extracts the pairs from a traced
+  function's jaxpr (recursing into scan/while/cond/pjit/shard_map
+  bodies), so the property is checked on what the program WILL run, not
+  on what a docstring says.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .core import Finding
+
+_PARTITION_PATH = "llm_sharding_demo_tpu/parallel/partition.py"
+_PPDECODE_PATH = "llm_sharding_demo_tpu/parallel/ppdecode.py"
+_SPMD_PATH = "llm_sharding_demo_tpu/parallel/spmd.py"
+
+
+# -- partition plans ---------------------------------------------------------
+
+
+def check_partition_plan(n_layer: int, boundaries: Sequence[int],
+                         where: str = "plan") -> List[Finding]:
+    """A plan must partition [0, n_layer) disjointly and exhaustively;
+    the partitioner's ValueError is the precise diagnostic."""
+    from llm_sharding_demo_tpu.parallel import partition as Pt
+    try:
+        Pt.make_stage_specs(n_layer, boundaries)
+    except ValueError as e:
+        return [Finding("stage-contract", _PARTITION_PATH, 1, where,
+                        f"rejected partition plan: {e}")]
+    return []
+
+
+def check_spec_list(specs, n_layer: int, where: str = "specs",
+                    ) -> List[Finding]:
+    """``validate_specs`` as a finding source — overlapping stages,
+    gaps, and index/n_stages inconsistencies in an externally built
+    stage list."""
+    from llm_sharding_demo_tpu.parallel import partition as Pt
+    try:
+        Pt.validate_specs(specs, n_layer)
+    except ValueError as e:
+        return [Finding("stage-contract", _PARTITION_PATH, 1, where,
+                        f"rejected stage list: {e}")]
+    return []
+
+
+# -- inter-stage shape/dtype contracts ---------------------------------------
+
+
+def _param_avals(module, config):
+    import jax
+    key = jax.random.PRNGKey(0)
+    return jax.eval_shape(lambda k: module.init_params(config, k), key)
+
+
+def check_stage_chain(stage_fns, first_in_aval, mid_aval, last_out_aval,
+                      where: str) -> List[Finding]:
+    """Generic chain checker: ``stage_fns[i]`` maps (x_aval) ->
+    (out_aval, cache_delta_ok: bool). Used by the fixture tests with
+    deliberately broken stages; ``check_stage_contracts`` builds the
+    real stage closures and delegates here."""
+    import jax
+    findings: List[Finding] = []
+    x = first_in_aval
+    n = len(stage_fns)
+    for i, fn in enumerate(stage_fns):
+        try:
+            out, cache_ok = fn(x)
+        except Exception as e:  # noqa: BLE001 — a trace abort IS the finding
+            findings.append(Finding(
+                "stage-contract", _PARTITION_PATH, 1, where,
+                f"stage {i} rejects its input aval "
+                f"{tuple(x.shape)}/{x.dtype}: {type(e).__name__}: {e}"))
+            return findings
+        expect = last_out_aval if i == n - 1 else mid_aval
+        if (tuple(out.shape) != tuple(expect.shape)
+                or out.dtype != expect.dtype):
+            findings.append(Finding(
+                "stage-contract", _PARTITION_PATH, 1, where,
+                f"stage {i} emits {tuple(out.shape)}/{out.dtype}, the "
+                f"{'head contract' if i == n - 1 else 'next stage'} "
+                f"expects {tuple(expect.shape)}/{expect.dtype}"))
+        if not cache_ok:
+            findings.append(Finding(
+                "stage-contract", _PARTITION_PATH, 1, where,
+                f"stage {i} returns a cache whose avals differ from its "
+                "input cache (the decode scan carries it fixed-shape)"))
+        x = out
+    return findings
+
+
+def check_stage_contracts(module, config, boundaries: Sequence[int],
+                          batch: int = 2, seq: int = 6, max_seq: int = 32,
+                          where: str = "", dtype=None) -> List[Finding]:
+    """The registry-driven form: build the plan's stage closures over
+    ``partition.stage_apply`` + per-stage caches and run the chain
+    checker — all under eval_shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from llm_sharding_demo_tpu.parallel import partition as Pt
+    dtype = dtype or jnp.float32
+    bad = check_partition_plan(config.n_layer, boundaries, where)
+    if bad:
+        return bad
+    specs = Pt.make_stage_specs(config.n_layer, boundaries)
+    params_aval = _param_avals(module, config)
+    stage_avals = jax.eval_shape(
+        lambda p: Pt.partition_params(p, specs), params_aval)
+
+    def tree_avals_equal(a, b) -> bool:
+        la = jax.tree_util.tree_leaves(a)
+        lb = jax.tree_util.tree_leaves(b)
+        return (len(la) == len(lb)
+                and all(tuple(x.shape) == tuple(y.shape)
+                        and x.dtype == y.dtype for x, y in zip(la, lb)))
+
+    def make_fn(sp_aval, spec):
+        cache_aval = jax.eval_shape(
+            functools.partial(Pt.make_stage_cache, spec, config, batch,
+                              max_seq, dtype))
+
+        def fn(x_aval):
+            out, cache_out = jax.eval_shape(
+                lambda sp, x, c: Pt.stage_apply(sp, spec, config, x, c),
+                sp_aval, x_aval, cache_aval)
+            return out, tree_avals_equal(cache_aval, cache_out)
+
+        return fn
+
+    first_in = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    mid = jax.ShapeDtypeStruct((batch, seq, config.n_embd), dtype)
+    last_out = jax.ShapeDtypeStruct((batch, seq, config.vocab_size),
+                                    jnp.float32)
+    fns = [make_fn(sp, spec) for sp, spec in zip(stage_avals, specs)]
+    return check_stage_chain(fns, first_in, mid, last_out, where)
+
+
+def check_padded_stacking(module, config, boundaries: Sequence[int],
+                          where: str = "") -> List[Finding]:
+    """Uneven-plan stacking: round-trip aval identity + mask row counts."""
+    import jax
+    import numpy as np
+
+    from llm_sharding_demo_tpu.parallel import partition as Pt
+    specs = Pt.make_stage_specs(config.n_layer, boundaries)
+    params_aval = _param_avals(module, config)
+    findings: List[Finding] = []
+
+    rt = jax.eval_shape(
+        lambda p: Pt.unstack_stage_params_padded(
+            Pt.stack_stage_params_padded(p, specs)[0], specs), params_aval)
+    orig = params_aval["blocks"]
+    ra = jax.tree_util.tree_leaves(rt)
+    oa = jax.tree_util.tree_leaves(orig)
+    if (len(ra) != len(oa)
+            or any(tuple(x.shape) != tuple(y.shape) or x.dtype != y.dtype
+                   for x, y in zip(ra, oa))):
+        findings.append(Finding(
+            "stage-contract", _PARTITION_PATH, 1, where,
+            "padded stack/unstack round-trip does not reproduce the "
+            "block avals"))
+    mask = np.asarray(Pt.stage_valid_mask(specs))
+    per_max = max(s.n_blocks for s in specs)
+    if mask.shape != (len(specs), per_max):
+        findings.append(Finding(
+            "stage-contract", _PARTITION_PATH, 1, where,
+            f"validity mask shape {mask.shape}, want "
+            f"{(len(specs), per_max)}"))
+    elif int(mask.sum()) != config.n_layer:
+        findings.append(Finding(
+            "stage-contract", _PARTITION_PATH, 1, where,
+            f"validity mask marks {int(mask.sum())} real layers, model "
+            f"has {config.n_layer} — padded stages would execute the "
+            "wrong layer set"))
+    return findings
+
+
+# -- PartitionSpec validity --------------------------------------------------
+
+
+def check_pspec(spec, shape: Tuple[int, ...], mesh_axes: Dict[str, int],
+                where: str) -> List[Finding]:
+    """One spec against one array shape and a mesh's {axis: size}."""
+    problems: List[str] = []
+    entries = list(spec)
+    if len(entries) > len(shape):
+        problems.append(
+            f"spec rank {len(entries)} exceeds array rank {len(shape)} "
+            f"for shape {shape}")
+        entries = entries[:len(shape)]
+    used: Dict[str, int] = {}
+    for dim, entry in enumerate(entries):
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        factor = 1      # a dim sharded over SEVERAL axes splits by their
+        for axis in axes:  # PRODUCT — per-axis checks alone would pass
+            if axis is None:  # specs the real mesh rejects
+                continue
+            if axis not in mesh_axes:
+                problems.append(
+                    f"dim {dim} names mesh axis {axis!r}, mesh has "
+                    f"{sorted(mesh_axes)}")
+                continue
+            if axis in used:
+                problems.append(
+                    f"mesh axis {axis!r} used on dims {used[axis]} and "
+                    f"{dim} — an axis shards at most one dim")
+            used[axis] = dim
+            factor *= mesh_axes[axis]
+        if factor > 1 and shape[dim] % factor:
+            axes_str = "*".join(repr(a) for a in axes if a is not None)
+            problems.append(
+                f"dim {dim} of size {shape[dim]} not divisible by "
+                f"mesh axis {axes_str}={factor}")
+    return [Finding("pspec", _SPMD_PATH, 1, where, p) for p in problems]
+
+
+def check_pspec_tree(specs_tree, aval_tree, mesh_axes: Dict[str, int],
+                     where: str) -> List[Finding]:
+    """Walk a pspec pytree against a matching aval pytree (dict-shaped,
+    PartitionSpec leaves — the ``spmd.*_pspecs`` layout)."""
+    import jax
+    from jax.sharding import PartitionSpec
+    findings: List[Finding] = []
+
+    def walk(spec_node, aval_node, path: str):
+        if isinstance(spec_node, PartitionSpec):
+            leaves = jax.tree_util.tree_leaves(aval_node)
+            if len(leaves) != 1:
+                findings.append(Finding(
+                    "pspec", _SPMD_PATH, 1, where,
+                    f"{path}: one spec for {len(leaves)} arrays"))
+                return
+            findings.extend(check_pspec(
+                spec_node, tuple(leaves[0].shape), mesh_axes,
+                f"{where}/{path}"))
+        elif isinstance(spec_node, dict):
+            if not isinstance(aval_node, dict) or (
+                    set(spec_node) != set(aval_node)):
+                findings.append(Finding(
+                    "pspec", _SPMD_PATH, 1, where,
+                    f"{path}: spec tree keys {sorted(spec_node)} != "
+                    f"param keys "
+                    f"{sorted(aval_node) if isinstance(aval_node, dict) else type(aval_node).__name__}"))
+                return
+            for k in spec_node:
+                walk(spec_node[k], aval_node[k], f"{path}.{k}" if path
+                     else str(k))
+        else:
+            findings.append(Finding(
+                "pspec", _SPMD_PATH, 1, where,
+                f"{path}: unexpected spec node {type(spec_node).__name__}"))
+
+    walk(specs_tree, aval_tree, "")
+    return findings
+
+
+# -- ppermute bijection ------------------------------------------------------
+
+
+def check_permutation(pairs: Sequence[Tuple[int, int]], axis_size: int,
+                      where: str) -> List[Finding]:
+    """Partial-bijection check over a ``ppermute`` pair list: every
+    source and every destination at most once, all indices in range.
+    (A duplicate destination silently SUMS contributions on some
+    backends and is undefined on others; a duplicate source double-sends
+    — both are wiring bugs no runtime test at the wrong axis size would
+    see.)"""
+    problems: List[str] = []
+    srcs: Dict[int, int] = {}
+    dsts: Dict[int, int] = {}
+    for i, (s, d) in enumerate(pairs):
+        if not (0 <= s < axis_size) or not (0 <= d < axis_size):
+            problems.append(
+                f"pair {i} = ({s}, {d}) out of range for axis size "
+                f"{axis_size}")
+        if s in srcs:
+            problems.append(
+                f"source {s} appears in pairs {srcs[s]} and {i} — not a "
+                "bijection (double-send)")
+        srcs.setdefault(s, i)
+        if d in dsts:
+            problems.append(
+                f"destination {d} appears in pairs {dsts[d]} and {i} — "
+                "not a bijection (colliding receives)")
+        dsts.setdefault(d, i)
+    return [Finding("ppermute", _PPDECODE_PATH, 1, where, p)
+            for p in problems]
+
+
+def collect_ppermutes(fn, *avals) -> List[Tuple[tuple, tuple]]:
+    """Trace ``fn`` (no compile, no execute) and return every
+    ``ppermute`` equation's ``(axis_name, perm)`` — recursing into
+    scan/while/cond/pjit/shard_map sub-jaxprs, so permutations inside
+    compiled-loop bodies are found too."""
+    import jax
+    jaxpr = jax.make_jaxpr(fn)(*avals)
+    found: List[Tuple[tuple, tuple]] = []
+
+    def walk(jxp):
+        for eqn in jxp.eqns:
+            if eqn.primitive.name == "ppermute":
+                found.append((tuple(eqn.params.get("axis_name", ())),
+                              tuple(eqn.params.get("perm", ()))))
+            for v in eqn.params.values():
+                sub = getattr(v, "jaxpr", None)
+                if sub is not None and hasattr(sub, "eqns"):
+                    walk(sub)
+                elif hasattr(v, "eqns"):
+                    walk(v)
+                elif isinstance(v, (tuple, list)):
+                    for item in v:
+                        sub = getattr(item, "jaxpr", None)
+                        if sub is not None and hasattr(sub, "eqns"):
+                            walk(sub)
+                        elif hasattr(item, "eqns"):
+                            walk(item)
+
+    walk(jaxpr.jaxpr)
+    return found
+
+
+def check_ring_program(n_stages: int, where: str) -> List[Finding]:
+    """Trace a shard_map stand-in that ppermutes with the REAL
+    ``stage_ring_permutation`` over an AbstractMesh of ``n_stages``
+    devices, extract the permutation from the jaxpr, and verify the
+    bijection property — end-to-end through the same machinery a full
+    program check would use, with zero devices."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import AbstractMesh, PartitionSpec as P
+
+    from llm_sharding_demo_tpu.parallel.ppdecode import \
+        stage_ring_permutation
+    if n_stages < 2:
+        # the declared helper must still behave (empty pair list)
+        return check_permutation(stage_ring_permutation(n_stages),
+                                 max(n_stages, 1), where)
+    try:
+        from jax import shard_map  # newer spelling
+        smap = functools.partial(shard_map, axis_names={"pp"})
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as smap
+    mesh = AbstractMesh((("pp", n_stages),))
+
+    def per_device(x):
+        return jax.lax.ppermute(x, "pp", stage_ring_permutation(n_stages))
+
+    fn = smap(per_device, mesh=mesh, in_specs=(P("pp"),), out_specs=P("pp"))
+    aval = jax.ShapeDtypeStruct((n_stages, 4), jnp.float32)
+    perms = collect_ppermutes(fn, aval)
+    if not perms:
+        return [Finding("ppermute", _PPDECODE_PATH, 1, where,
+                        "traced ring program contains no ppermute — "
+                        "extraction or wiring broke")]
+    findings: List[Finding] = []
+    for axis_name, perm in perms:
+        findings.extend(check_permutation(perm, n_stages, where))
+    return findings
+
+
+# -- registry-driven pass ----------------------------------------------------
+
+
+def run_semantic() -> Tuple[List[Finding], int]:
+    """All registry contracts; -> (findings, checks_run)."""
+    import jax.numpy as jnp
+    from jax.sharding import AbstractMesh
+
+    from llm_sharding_demo_tpu.models import is_stage_partitionable
+    from llm_sharding_demo_tpu.parallel import spmd
+    from . import registry
+    findings: List[Finding] = []
+    checks = 0
+
+    fams = registry.families()
+    for fam_name, (module, config) in fams.items():
+        if not is_stage_partitionable(config):
+            continue
+        for plan_name, bounds in registry.STAGE_PLANS:
+            where = f"{fam_name}/{plan_name}"
+            for dtype in (jnp.float32,):
+                findings.extend(check_stage_contracts(
+                    module, config, bounds, where=where, dtype=dtype))
+                checks += 1
+            from llm_sharding_demo_tpu.parallel import partition as Pt
+            specs = Pt.make_stage_specs(config.n_layer, bounds)
+            if len({s.n_blocks for s in specs}) > 1:
+                findings.extend(check_padded_stacking(
+                    module, config, bounds, where=where))
+                checks += 1
+
+    # PartitionSpec trees vs the mesh stand-ins they are meant for
+    mesh_tp = AbstractMesh(tuple(registry.MESHES["tp2"].items()))
+    mesh_ep = AbstractMesh(tuple(registry.MESHES["ep2-tp2"].items()))
+    gpt2_mod, gpt2_cfg = fams["gpt2-tiny"]
+    llama_mod, llama_cfg = fams["llama-tiny"]
+    moe_mod, moe_cfg = fams["moe-tiny"]
+    findings.extend(check_pspec_tree(
+        spmd.param_pspecs(mesh_tp), _param_avals(gpt2_mod, gpt2_cfg),
+        registry.MESHES["tp2"], "gpt2-tiny/tp2"))
+    findings.extend(check_pspec_tree(
+        spmd.llama_param_pspecs(mesh_tp), _param_avals(llama_mod, llama_cfg),
+        registry.MESHES["tp2"], "llama-tiny/tp2"))
+    findings.extend(check_pspec_tree(
+        spmd.moe_param_pspecs(mesh_ep), _param_avals(moe_mod, moe_cfg),
+        registry.MESHES["ep2-tp2"], "moe-tiny/ep2-tp2"))
+    checks += 3
+
+    # engine tp divisibility contracts for the registered stand-ins
+    tp = registry.MESHES["tp2"]["tp"]
+    for name, cfg in (("gpt2-tiny", gpt2_cfg), ("llama-tiny", llama_cfg)):
+        kv = getattr(cfg, "n_kv_head", cfg.n_head)
+        if cfg.n_head % tp or (kv % tp and kv >= tp):
+            findings.append(Finding(
+                "pspec", _SPMD_PATH, 1, f"{name}/tp2",
+                f"n_head={cfg.n_head}/n_kv_head={kv} not shardable over "
+                f"tp={tp} whole heads"))
+        checks += 1
+
+    # ppermute ring bijection per registered stage-axis size
+    for n in registry.RING_SIZES:
+        findings.extend(check_ring_program(n, f"ring/pp={n}"))
+        checks += 1
+
+    return findings, checks
