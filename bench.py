@@ -689,6 +689,95 @@ def measure_iterbatch(config, dtype="bfloat16", n_requests: int = 12,
     }
 
 
+def measure_paged_kv(config, dtype="bfloat16", steps: int = 192,
+                     prompt_len: int = 60, block_size: int = 16,
+                     max_batch: int = 8) -> dict:
+    """Paged vs contiguous decode (ISSUE 5): (a) solo decode rate
+    through the PagedKVRunner (the engine's own programs + one
+    gather/scatter round trip per segment) vs the plain engine — the
+    paging tax; (b) max concurrent iterbatch rows before the first
+    preemption on a deliberately small pool — the capacity the block
+    granularity buys over per-row max_seq arenas.
+
+    Needs the bench chip: CPU rates for the gather/scatter overhead
+    would mislead (the tax is HBM traffic, not host arithmetic).
+    """
+    import threading as _th
+
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        return {"skipped": "paged-vs-contiguous rates need the bench "
+                           "chip (the paging tax is HBM traffic; CPU "
+                           "numbers would mislead)"}
+
+    from llm_sharding_demo_tpu.models import gpt2
+    from llm_sharding_demo_tpu.runtime.engine import DecodeEngine
+    from llm_sharding_demo_tpu.runtime.iterbatch import IterBatchingEngine
+    from llm_sharding_demo_tpu.runtime.kv_pool import (KVBlockPool,
+                                                       PagedKVRunner)
+
+    params = gpt2.init_params(config, jax.random.PRNGKey(0),
+                              dtype=jnp.float32)
+    bucketed = (prompt_len + 15) // 16 * 16
+    max_seq = min(config.n_positions,
+                  -(-(bucketed + 2 * steps) // block_size) * block_size)
+    engine = DecodeEngine(params, config, max_seq=max_seq, dtype=dtype)
+    nbm = max_seq // block_size
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, config.vocab_size, size=(prompt_len,))
+
+    # (a) solo paged vs contiguous decode rate
+    pool = KVBlockPool.for_engine(engine, num_blocks=2 * nbm,
+                                  block_size=block_size)
+    runner = PagedKVRunner(engine, pool)
+    engine.generate(prompt[None, :], steps)          # warmup/compile
+    runner.generate(prompt[None, :], steps)
+    t0 = time.perf_counter()
+    contiguous = engine.generate(prompt[None, :], steps)
+    t1 = time.perf_counter()
+    runner.generate(prompt[None, :], steps)
+    t2 = time.perf_counter()
+    contig_rate = steps / (t1 - t0)
+    paged_rate = steps / (t2 - t1)
+
+    # (b) concurrency before first preemption: a pool of 2 full rows'
+    # worth of blocks, rows that each need ~1/2 row — block granularity
+    # admits ~4 before pressure; the contiguous allocator would cap at
+    # pool_bytes / max_seq_row = 2
+    small = KVBlockPool.for_engine(engine, num_blocks=2 * nbm,
+                                   block_size=block_size, watermark=1.0)
+    ib = IterBatchingEngine(engine, max_batch=max_batch, seg_steps=64,
+                            max_wait_ms=200.0, pool=small)
+    admitted = 0
+    threads = []
+
+    def run_one():
+        ib.generate(prompt, steps, timeout=600)
+
+    for i in range(max_batch):
+        if ib.stats()["preemptions"] > 0:
+            break
+        threads.append(_th.Thread(target=run_one))
+        threads[-1].start()
+        admitted += 1
+        time.sleep(0.2)
+    for t in threads:
+        t.join()
+    st = ib.stats()
+    return {
+        "contiguous_tokens_per_sec": round(contig_rate, 1),
+        "paged_tokens_per_sec": round(paged_rate, 1),
+        "paging_tax": round(1 - paged_rate / contig_rate, 3),
+        "block_size": block_size, "max_seq": max_seq,
+        "pool_blocks": 2 * nbm,
+        "rows_admitted_before_first_preemption": admitted,
+        "contiguous_rows_that_pool_could_hold": 2,
+        "preemptions": st["preemptions"], "resumes": st["resumes"],
+    }
+
+
 def measure_spec_iterbatch(config, dtype="bfloat16", n_requests: int = 8,
                            max_batch: int = 4, steps: int = 160,
                            prompt_len: int = 64, stagger_s: float = 0.04,
@@ -1436,9 +1525,23 @@ def main() -> None:
                     "is the solo analog)",
         }
 
+    def cfg14():
+        return {
+            **measure_paged_kv(g124),
+            "note": "paged KV pool (runtime.kv_pool): solo decode "
+                    "through PagedKVRunner (engine programs + one "
+                    "gather/scatter per segment) vs the contiguous "
+                    "engine = the paging tax; rows-before-preemption "
+                    "on a 2-full-rows pool shows the concurrency "
+                    "block granularity buys (contiguous arenas cap at "
+                    "2 rows for the same bytes); skip-with-reason off "
+                    "the bench chip",
+        }
+
     safe("cfg2_gpt2_124m_2shard_single_prompt", cfg2)
     safe("cfg3_gpt2_124m_bs8", cfg3)
     safe("cfg11_iterbatch_staggered_arrivals", cfg11)
+    safe("cfg14_paged_kv_vs_contiguous", cfg14)
     safe("cfg4_gpt2_medium_4shard", cfg4)
     safe("cfg5_kv_cache_vs_on2", cfg5)
     safe("cfg6_moe_8e_top2_124m_geometry", cfg6)

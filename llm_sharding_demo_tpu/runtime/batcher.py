@@ -51,7 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils import tracing
-from ..utils.metrics import REGISTRY
+from ..utils.metrics import REGISTRY, kv_block_gauges
 from .engine import DecodeEngine, GenerateResult, SamplingConfig
 
 
@@ -387,6 +387,11 @@ class BatchingEngine:
         s_max, steps = self._shapes(batch)  # planned feasible: not None
         b = _bucket_batch(len(batch), self.max_batch)
         ids, pad = self._bucket_rows(batch, b, s_max)
+        # the round's KV arena in the shared block denomination
+        # (utils.metrics.kv_block_gauges): live while the round runs,
+        # back to 0 at delivery — an idle batcher holds no KV
+        kv_block_gauges("batcher", b * (s_max + steps),
+                        b * self.engine._cache_seq)
 
         greedy = batch[0].sampling.mode == "greedy"
         if self.prefix is not None and greedy:
@@ -462,6 +467,11 @@ class BatchingEngine:
         REGISTRY.gauge("batch_occupancy",
                        round(len(batch) / (len(batch) + padded_rows), 4),
                        scheduler="admission")
+        # round done: its arena is released (an idle batcher must not
+        # keep reporting the last round's blocks — same invariant as
+        # the engine component's end-of-generate reset)
+        width = len(batch) + padded_rows
+        kv_block_gauges("batcher", 0, width * self.engine._cache_seq)
         REGISTRY.gauge("queue_depth", self._queue.qsize(),
                        scheduler="admission")
         for i, req in enumerate(batch):

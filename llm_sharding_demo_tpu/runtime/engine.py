@@ -41,7 +41,7 @@ import numpy as np
 from ..models.gpt2 import GPT2Config, Params
 from ..ops.attention import KVCache
 from ..utils import tracing
-from ..utils.metrics import REGISTRY, CompileWatch
+from ..utils.metrics import REGISTRY, CompileWatch, kv_block_gauges
 
 # Reference sampler constants (server.py:188, 191).
 REF_TEMPERATURE = 0.6
@@ -951,9 +951,11 @@ class DecodeEngine:
         t1 = time.perf_counter()
         tracing.record("prefill", t0, t1, batch=batch,
                        prompt_len=prompt_len, chunked=bool(chunk))
-        REGISTRY.gauge("kv_cache_slots_in_use",
-                       batch * (prompt_len + max_new_tokens),
-                       component="engine")
+        # KV reservation in the pool's block denomination (see
+        # utils.metrics.kv_block_gauges): the contiguous arena this
+        # generate holds, vs its allocated capacity
+        kv_block_gauges("engine", batch * (prompt_len + max_new_tokens),
+                        batch * self._cache_seq)
         return self._decode_and_pack(run_params, ids, pad, pad_j, first,
                                      cache, decode_key, max_new_tokens,
                                      sampling, prompt_len, t1 - t0,
@@ -1014,8 +1016,8 @@ class DecodeEngine:
                        steps=new.shape[1], segments=len(segs))
         self._note_compiles()
         # generation done: its cache reservation is released (an idle
-        # server must not keep reporting the last request's slots)
-        REGISTRY.gauge("kv_cache_slots_in_use", 0, component="engine")
+        # server must not keep reporting the last request's blocks)
+        kv_block_gauges("engine", 0, new.shape[0] * self._cache_seq)
 
         tokens = np.concatenate([ids, new], axis=1)
         return GenerateResult(tokens=tokens, prompt_len=prompt_len,

@@ -75,6 +75,29 @@ prompt shares a cached prefix forwards only its suffix before merging
 into the live batch at the current depth. Exact (store replay is
 byte-identical to a cold prefill) and compile-bounded by the store's
 chunk programs.
+
+Paged KV composition (``pool=``, runtime.kv_pool): rows' KV state lives
+in ref-counted pool BLOCKS between segments instead of a permanently
+allocated ``[B, max_seq]`` arena. Each segment boundary gathers the
+tabled rows into a contiguous working cache, runs the UNCHANGED segment
+program (same program keys, byte-identical tokens), and scatters the
+updated rows back; fully-padded table positions point at the shared
+trash block, so a short row costs ``ceil(content/block_size)`` blocks,
+not ``max_seq`` slots. The pool is also the ADMISSION authority:
+
+- admission of a policy-compatible request defers (without closing the
+  batch) while the allocator's watermark says its blocks don't fit —
+  and ``serving.app`` turns sustained refusal into 429 + Retry-After;
+- when live rows GROW past a block boundary and allocation fails even
+  after LRU-evicting prefix entries, the scheduler PREEMPTS the
+  lowest-priority row (latest admission order): fetch its emitted
+  tokens, free its blocks, park it. Parked rows resume — oldest first,
+  before any queued request — by RECOMPUTE: re-prefill prompt +
+  already-emitted tokens (one bucketed solo prefill, exactly the
+  admission move) and continue the row's own per-step PRNG chain.
+  Byte-identical to the un-preempted stream (prefix-stable key splits;
+  prefill-recomputed KV equals incrementally-decoded KV — pinned by
+  tests for greedy and seeded sample, plain and spec batches).
 """
 
 from __future__ import annotations
@@ -84,7 +107,7 @@ import functools
 import queue
 import threading
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -92,7 +115,7 @@ import numpy as np
 
 from ..ops.attention import KVCache
 from ..utils import tracing
-from ..utils.metrics import REGISTRY
+from ..utils.metrics import REGISTRY, kv_block_gauges
 from .batcher import _round_up
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
                      select_token)
@@ -167,7 +190,13 @@ class _SegOut:
     def np(self) -> np.ndarray:
         with self._lock:
             if self._np is None:
-                self._np = np.asarray(self.arr)
+                # OWNING copy, not np.asarray: on the CPU backend
+                # np.asarray returns a ZERO-COPY view of the device
+                # buffer, and once the next segment DONATES the array
+                # XLA may rewrite that memory in place under the view —
+                # the snapshot would silently shift (observed as
+                # rolled-buffer corruption in parked spec rows)
+                self._np = np.array(self.arr, copy=True)
             return self._np
 
 
@@ -176,11 +205,23 @@ class _Slot:
     req: _Req
     plen: int
     row: int                      # this slot's batch row index (fixed)
-    first_ref: "_SegOut"          # holds the first generated token ...
-    first_idx: int                # ... at this index
+    first_ref: Optional["_SegOut"]  # holds the first generated token ...
+    first_idx: int                # ... at this index (None for resumed
+                                  # rows: resumed_prefix replaces it)
     dk: Optional[jax.Array]       # per-row decode key (sample mode)
     emitted: int = 1              # tokens generated so far (incl. first)
     segs: List = dataclasses.field(default_factory=list)  # (_SegOut, n)
+    # admission order: THE preemption priority (higher = admitted later
+    # = preempted first). Monotonic across the scheduler's lifetime.
+    order: int = 0
+    # pool mode: this row's block ids at table columns
+    # [blk_lo, blk_lo + len(blk_ids)) — everything outside points at
+    # the trash block
+    blk_lo: int = 0
+    blk_ids: List[int] = dataclasses.field(default_factory=list)
+    # tokens emitted before a preemption (host copy); delivery prepends
+    # them in place of first_ref
+    resumed_prefix: Optional[np.ndarray] = None
     # Spec-mode delivery state: the latest segment's [B, buflen] token
     # buffer (prompt + everything emitted, per row, left-aligned at the
     # row's pad) and this row's pad at that moment — _row_tokens reads
@@ -215,15 +256,33 @@ def _admit_cache(cache, solo, slot, roll):
     return one(cache, solo)
 
 
+@dataclasses.dataclass
+class _Parked:
+    """A preempted row between its park and its resume: everything the
+    recompute path needs to reproduce the stream byte-identically."""
+
+    req: _Req
+    plen: int
+    emitted: int                  # tokens generated before the park
+    tokens: np.ndarray            # those tokens, fetched to host
+    order: int                    # original admission order (priority)
+    t0: float                     # original admission wall-clock
+    preempt_t: float = 0.0
+    spec_key: Optional[np.ndarray] = None  # verify key chain (spec rows)
+
+
 class _BatchState:
     """The live batch between segments (worker-thread-only state)."""
 
     def __init__(self, sampling, token, cache, pad_j, depth):
         self.sampling = sampling
         self.token = token            # [B] device
-        self.cache = cache
+        self.cache = cache            # contiguous mode only; None when a
+                                      # pool owns the state between
+                                      # segments (tables instead)
         self.pad_j = pad_j            # [B] device int32
         self.depth = depth            # uniform cache depth (host int)
+        self.tables: Optional[np.ndarray] = None   # [B, NBm] (pool mode)
         self.slots: List[Optional[_Slot]] = []
         self.closed = False           # True: no more admissions (FIFO)
         # speculative batches only: device token buffer [B, buflen]
@@ -249,14 +308,23 @@ class IterBatchingEngine:
 
     def __init__(self, engine: DecodeEngine, max_batch: int = 8,
                  seg_steps: int = 32, max_wait_ms: float = 2.0,
-                 prompt_bucket: int = 16, spec=None, prefix=None):
+                 prompt_bucket: int = 16, spec=None, prefix=None,
+                 pool=None, queue_limit: Optional[int] = None):
         """``spec`` (optional ``SpecDecodeEngine`` wrapping THIS engine)
         enables speculative segments: batches whose policy carries
         ``SamplingConfig.spec`` advance by draft-verify forwards instead
         of single-token steps (see module docstring). ``prefix``
         (optional ``PrefixCachingEngine`` wrapping THIS engine) routes
         admission prefills through the prefix store, so a joiner with a
-        warm prefix forwards only its suffix."""
+        warm prefix forwards only its suffix.
+
+        ``pool`` (optional ``runtime.kv_pool.KVBlockPool`` matching THIS
+        engine's cache geometry) turns on paged KV storage, watermark
+        admission, and preemption/resume (module docstring).
+        ``queue_limit`` feeds ``admission_load`` (the serving 429
+        decision): with the pool unable to host a request AND at least
+        this many requests already waiting/parked, serving sheds load
+        instead of queueing unboundedly. Defaults to ``max_batch``."""
         from ..models import is_window_independent
         if not is_window_independent(engine.config):
             raise NotImplementedError(
@@ -278,15 +346,24 @@ class IterBatchingEngine:
                              "weights/programs), got a different instance")
         if prefix is not None and prefix.plain is not engine:
             raise ValueError("prefix must wrap the same engine instance")
+        if pool is not None and pool.max_seq != engine._cache_seq:
+            raise ValueError(
+                f"pool rows span {pool.max_seq} slots, engine cache is "
+                f"{engine._cache_seq}; gathered segments must match the "
+                "compiled programs' cache width")
         self.engine = engine
         self.spec = spec
         self.prefix = prefix
+        self.pool = pool
+        self.queue_limit = max_batch if queue_limit is None else queue_limit
         self.max_batch = max_batch
         self.seg_steps = seg_steps
         self.max_wait_s = max_wait_ms / 1e3
         self.prompt_bucket = prompt_bucket
         self._queue: "queue.Queue[_Req]" = queue.Queue()
         self._pending: Optional[_Req] = None
+        self._parked: List[_Parked] = []   # preempted rows, oldest first
+        self._order = 0                    # admission-order counter
         self._stats_lock = threading.Lock()
         self.batches_run = 0
         self.rows_served = 0
@@ -295,6 +372,8 @@ class IterBatchingEngine:
         self.spec_segments_run = 0    # draft-verify segments (spec mode)
         self.eos_retires = 0
         self.grows = 0                # width upgrades of a live batch
+        self.preemptions = 0          # rows parked under pool pressure
+        self.resumes = 0              # parked rows recomputed back in
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
 
@@ -364,35 +443,71 @@ class IterBatchingEngine:
 
     def stats(self) -> dict:
         with self._stats_lock:
-            return {"batches": self.batches_run, "rows": self.rows_served,
-                    "joins": self.joins, "segments": self.segments_run,
-                    "spec_segments": self.spec_segments_run,
-                    "eos_retires": self.eos_retires, "grows": self.grows}
+            out = {"batches": self.batches_run, "rows": self.rows_served,
+                   "joins": self.joins, "segments": self.segments_run,
+                   "spec_segments": self.spec_segments_run,
+                   "eos_retires": self.eos_retires, "grows": self.grows,
+                   "preemptions": self.preemptions,
+                   "resumes": self.resumes,
+                   "parked": len(self._parked)}
+        return out
+
+    def admission_load(self, prompt_len: int,
+                       max_new_tokens: int) -> Tuple[bool, float]:
+        """The serving 429 decision: can this request reasonably be
+        queued, or is the pool saturated AND the queue already at its
+        limit (sustained overload — shed with Retry-After)? Always
+        admits without a pool (the pre-pool unbounded-queue behavior)."""
+        if self.pool is None:
+            return True, 0.0
+        # admission footprint (the prefill's blocks) — growth past it is
+        # the preemption machinery's business, not the 429 gate's
+        need = self.pool.allocator.blocks_for(prompt_len)
+        waiting = (self._queue.qsize() + len(self._parked)
+                   + (1 if self._pending is not None else 0))
+        if self.pool.allocator.can_admit(need) or waiting < self.queue_limit:
+            return True, 0.0
+        # crude but honest: each max_batch-wide wave of waiters needs
+        # roughly one batch lifetime to drain
+        return False, float(1 + waiting // max(self.max_batch, 1))
 
     # -- worker side ---------------------------------------------------------
 
     def _loop(self):
         while True:
-            head = self._pending or self._queue.get()
-            self._pending = None
-            if head.cancelled.is_set():
-                continue
+            # parked rows outrank every queued request (they were
+            # admitted first — FIFO priority): with any parked, the next
+            # batch seeds from the parked head instead of the queue
+            if self._parked:
+                head = self._parked.pop(0)
+                if head.req.cancelled.is_set():
+                    continue
+            else:
+                head = self._pending or self._queue.get()
+                self._pending = None
+                if head.cancelled.is_set():
+                    continue
             try:
                 self._run_batch(head)
             except Exception as e:  # noqa: BLE001 — delivered per-request
-                head.fail(e)
+                (head.req if isinstance(head, _Parked) else head).fail(e)
 
-    def _compatible(self, state: _BatchState, req: _Req) -> bool:
-        """Can ``req`` join the live batch right now? Policy must match
-        (the ``spec`` flag included — a spec arrival never joins a plain
-        batch or vice versa), its prompt must fit the current depth
-        (content at ``[d - plen, d)``), and its generation must fit the
-        cache — with ``draft_len`` extra slots of verify-write headroom
-        when the batch speculates."""
+    def _compatible(self, state: _BatchState, ent) -> bool:
+        """Can this entry (a fresh ``_Req`` or a ``_Parked`` resume)
+        join the live batch right now? ONE predicate for both — a
+        policy constraint added here gates resumes and fresh arrivals
+        identically. Policy must match (the ``spec`` flag included — a
+        spec arrival never joins a plain batch or vice versa), the
+        tokens its prefill forwards must fit the current depth (content
+        at ``[d - plen', d)``), and its remaining generation must fit
+        the cache — with ``draft_len`` extra slots of verify-write
+        headroom when the batch speculates. Pool room is checked
+        SEPARATELY (``_pool_room_for``): a policy mismatch closes
+        admission, missing pool room only defers it."""
         reserve = self.spec.draft_len if state.spec_mode else 0
-        return (req.sampling == state.sampling
-                and len(req.prompt) <= state.depth
-                and state.depth + req.max_new_tokens + reserve
+        return (self._ent_req(ent).sampling == state.sampling
+                and len(self._ent_ids(ent)) <= state.depth
+                and state.depth + self._ent_need(ent) + reserve
                 <= self.engine.max_seq)
 
     def _run_batch(self, head: _Req):
@@ -403,21 +518,58 @@ class IterBatchingEngine:
                     self._admit(state)
                 self._advance(state)
         except Exception as e:  # noqa: BLE001
-            for s in state.slots:
+            for i, s in enumerate(state.slots):
                 if s is not None:
                     s.req.fail(e)
+                    # an aborted batch must hand its pool blocks back —
+                    # the normal retire/cancel/preempt release paths
+                    # never run for these slots, and leaked refs would
+                    # shrink the pool permanently
+                    self._release_blocks(state, i)
             raise
 
     # -- seeding -------------------------------------------------------------
 
-    def _seed(self, head: _Req) -> _BatchState:
-        """Start a batch: gather up-to-``max_wait`` same-policy peers
-        that fit together, batched prefill, first tokens.  Any failure
-        past the gathering point (e.g. a prefill OOM) is delivered to
-        EVERY gathered request, not just the head — a gathered peer with
-        ``done`` never set would block its caller forever (ADVICE r4
-        medium)."""
+    @staticmethod
+    def _ent_req(e) -> _Req:
+        return e.req if isinstance(e, _Parked) else e
+
+    @staticmethod
+    def _ent_ids(e) -> np.ndarray:
+        """The tokens a seed/admission prefill forwards for this entry:
+        the prompt, or — resuming a parked row — prompt + all emitted
+        tokens but the last (the last is the live, not-yet-forwarded
+        token the segment loop carries)."""
+        if isinstance(e, _Parked):
+            return np.concatenate([e.req.prompt, e.tokens[:-1]])
+        return e.prompt
+
+    @staticmethod
+    def _ent_need(e) -> int:
+        """Cache slots the entry still needs past its prefill."""
+        if isinstance(e, _Parked):
+            return e.req.max_new_tokens - e.emitted + 1
+        return e.max_new_tokens
+
+    def _seed(self, head) -> _BatchState:
+        """Start a batch: gather same-policy parked rows first (they
+        outrank every queued request), then up-to-``max_wait`` queued
+        peers that fit. Any failure past the gathering point (e.g. a
+        prefill OOM) is delivered to EVERY gathered request, not just
+        the head — a gathered peer with ``done`` never set would block
+        its caller forever (ADVICE r4 medium)."""
         seed = [head]
+        sampling = self._ent_req(head).sampling
+        while len(seed) < self.max_batch and self._parked:
+            nxt = self._parked[0]
+            if nxt.req.cancelled.is_set():
+                self._parked.pop(0)
+                continue
+            if (nxt.req.sampling == sampling
+                    and self._fits(seed + [nxt])):
+                seed.append(self._parked.pop(0))
+            else:
+                break  # stays parked; reconsidered at admission/next seed
         deadline = time.monotonic() + self.max_wait_s
         while len(seed) < self.max_batch:
             remaining = deadline - time.monotonic()
@@ -429,7 +581,7 @@ class IterBatchingEngine:
                 break
             if nxt.cancelled.is_set():
                 continue
-            if nxt.sampling == seed[0].sampling and self._fits(seed + [nxt]):
+            if nxt.sampling == sampling and self._fits(seed + [nxt]):
                 seed.append(nxt)
             else:
                 # incompatible arrival: parked as the FIFO head — _admit
@@ -441,13 +593,15 @@ class IterBatchingEngine:
             return self._seed_batch(seed)
         except Exception as e:  # noqa: BLE001
             for r in seed:
-                r.fail(e)
+                self._ent_req(r).fail(e)
             raise
 
-    def _seed_batch(self, seed: List[_Req]) -> _BatchState:
+    def _seed_batch(self, seed: List) -> _BatchState:
         eng = self.engine
-        spec_mode = seed[0].sampling.spec
+        sampling = self._ent_req(seed[0]).sampling
+        spec_mode = sampling.spec
         s_max = self._seed_smax(seed)
+        rows = [self._ent_ids(e) for e in seed]
 
         # Right-size the compiled width (ADVICE r4: a lone request must
         # not pay max_batch x prefill/decode FLOPs for ghost rows): the
@@ -458,9 +612,9 @@ class IterBatchingEngine:
         ids = np.zeros((b, s_max), dtype=np.int32)
         pad = np.zeros((b,), dtype=np.int32)
         for i in range(b):
-            r = seed[min(i, len(seed) - 1)]   # free slots replicate last
-            ids[i, s_max - len(r.prompt):] = r.prompt
-            pad[i] = s_max - len(r.prompt)
+            row = rows[min(i, len(seed) - 1)]  # free slots replicate last
+            ids[i, s_max - len(row):] = row
+            pad[i] = s_max - len(row)
         ids_j = jnp.asarray(ids)
         pad_j = jnp.asarray(pad)
 
@@ -468,23 +622,39 @@ class IterBatchingEngine:
         sp0 = time.perf_counter()
         run_params = eng._run_params()
         last_logits, cache = eng._prefill(run_params, ids_j, pad_j)
-        sampling = seed[0].sampling
         first, pks, dks = self._first_tokens(
-            last_logits, sampling, [r.key for r in seed], b)
+            last_logits, sampling, [self._ent_req(e).key for e in seed], b)
+        # Resumed rows: the "first" token is the parked row's last
+        # emitted token — KNOWN, never re-selected (greedy would
+        # reproduce it from the recomputed logits; a sampled row's draw
+        # came from an earlier step key, so the override is what makes
+        # the resumed stream byte-identical).
+        for i, e in enumerate(seed):
+            if isinstance(e, _Parked):
+                first = first.at[i].set(int(e.tokens[-1]))
         sp1 = time.perf_counter()
-        for r in seed:
+        for e in seed:
+            r = self._ent_req(e)
             if r.trace is not None:
-                r.trace.add_span("queue_wait", r.t_submit, sp0,
-                                 scheduler="iter")
-                r.trace.add_span("prefill", sp0, sp1, kind="seed",
-                                 width=b, prompt_len=len(r.prompt))
+                if isinstance(e, _Parked):
+                    r.trace.add_span("preempted", e.preempt_t, sp0,
+                                     scheduler="iter")
+                    r.trace.add_span("prefill", sp0, sp1, kind="resume",
+                                     width=b, emitted=e.emitted)
+                else:
+                    r.trace.add_span("queue_wait", r.t_submit, sp0,
+                                     scheduler="iter")
+                    r.trace.add_span("prefill", sp0, sp1, kind="seed",
+                                     width=b, prompt_len=len(r.prompt))
 
         state = _BatchState(sampling, first, cache, pad_j, s_max)
         if spec_mode:
             # verify-loop entry state (spec_decode._seg_b invariant): the
             # token buffer holds prompt + the unforwarded first token per
             # row, content at [pad_b, depth + 1); the per-row key chains
-            # are the dks the solo loop would carry (split(key)[1]).
+            # are the dks the solo loop would carry (split(key)[1]) —
+            # except resumed rows, whose chains advanced with every
+            # verify step and resume from the parked snapshot.
             buf = jnp.zeros((b, eng.max_seq + self.spec.draft_len + 1),
                             jnp.int32)
             buf = jax.lax.dynamic_update_slice(buf, ids_j, (0, 0))
@@ -492,41 +662,74 @@ class IterBatchingEngine:
                                                (0, s_max))
             state.spec_mode = True
             state.buf = buf
-            state.keys = (dks if dks is not None
-                          else jnp.zeros((b, 2), jnp.uint32))
+            keys = (dks if dks is not None
+                    else jnp.zeros((b, 2), jnp.uint32))
+            for i, e in enumerate(seed):
+                if isinstance(e, _Parked) and e.spec_key is not None:
+                    keys = keys.at[i].set(jnp.asarray(e.spec_key))
+            state.keys = keys
         first_ref = _SegOut(first)          # one shared [B] fetch
         state.slots = [None] * b
-        for i, r in enumerate(seed):
-            state.slots[i] = _Slot(req=r, plen=len(r.prompt), row=i,
-                                   first_ref=first_ref, first_idx=i,
-                                   dk=None if dks is None else dks[i],
-                                   t0=t0)
+        n_res = 0
+        for i, e in enumerate(seed):
+            r = self._ent_req(e)
+            if isinstance(e, _Parked):
+                n_res += 1
+                state.slots[i] = _Slot(
+                    req=r, plen=e.plen, row=i, first_ref=None,
+                    first_idx=0, dk=None if dks is None else dks[i],
+                    emitted=e.emitted, resumed_prefix=e.tokens,
+                    order=e.order, t0=e.t0)
+            else:
+                self._order += 1
+                state.slots[i] = _Slot(req=r, plen=len(r.prompt), row=i,
+                                       first_ref=first_ref, first_idx=i,
+                                       dk=None if dks is None else dks[i],
+                                       order=self._order, t0=t0)
+        if self.pool is not None:
+            self._init_tables(state)
         with self._stats_lock:
             self.batches_run += 1
+            self.resumes += n_res
         REGISTRY.inc("iter_batches_total")
+        if n_res:
+            REGISTRY.inc("kv_pool_resumes_total", value=n_res)
         self.engine._note_compiles()
         self._retire_finished(state)      # max_new_tokens == 1 rows
         self._set_gauges(state)
         return state
 
-    def _fits(self, reqs: List[_Req]) -> bool:
-        s_max = self._seed_smax(reqs)
-        reserve = self._reserve(reqs[0])
-        return all(s_max + r.max_new_tokens + reserve <= self.engine.max_seq
-                   and len(r.prompt) <= s_max for r in reqs)
+    def _fits(self, ents: List) -> bool:
+        s_max = self._seed_smax(ents)
+        ok = all(s_max + self._ent_need(e) + self._reserve(ents[0])
+                 <= self.engine.max_seq
+                 and len(self._ent_ids(e)) <= s_max for e in ents)
+        if ok and self.pool is not None:
+            # CURRENT footprint only (blocks covering the seed depth):
+            # admission deliberately OVERSUBSCRIBES future growth — that
+            # is what preemption is for; a worst-case check here would
+            # forbid exactly the concurrency the pool exists to raise
+            alloc = self.pool.allocator
+            need = sum(
+                alloc.blocks_for(s_max)
+                - (s_max - len(self._ent_ids(e))) // self.pool.block_size
+                for e in ents)
+            ok = need <= alloc.available()
+        return ok
 
-    def _reserve(self, req: _Req) -> int:
+    def _reserve(self, ent) -> int:
         """Cache slots held back beyond the generation: speculative
         batches need ``draft_len`` of verify-write headroom past the
         deepest content slot (the spec engine's own guard, applied to
         the batch's shared shape)."""
-        return self.spec.draft_len if req.sampling.spec else 0
+        return (self.spec.draft_len
+                if self._ent_req(ent).sampling.spec else 0)
 
-    def _seed_smax(self, reqs: List[_Req]) -> int:
-        raw = max(len(r.prompt) for r in reqs)
-        need = max(r.max_new_tokens for r in reqs)
+    def _seed_smax(self, ents: List) -> int:
+        raw = max(len(self._ent_ids(e)) for e in ents)
+        need = max(self._ent_need(e) for e in ents)
         return min(_round_up(raw, self.prompt_bucket),
-                   self.engine.max_seq - need - self._reserve(reqs[0]))
+                   self.engine.max_seq - need - self._reserve(ents[0]))
 
     def _first_tokens(self, last_logits, sampling, keys, b):
         """First-token selection + per-row (prefill, decode) key split.
@@ -544,16 +747,56 @@ class IterBatchingEngine:
 
     # -- admission -----------------------------------------------------------
 
+    def _pool_room_for(self, state: _BatchState, ent) -> bool:
+        """Pool watermark check for one would-be row's CURRENT
+        footprint — blocks covering its content at the live depth
+        (pad-prefix blocks are free, they point at trash). Growth past
+        this is deliberately oversubscribed: preemption handles it."""
+        if self.pool is None:
+            return True
+        alloc = self.pool.allocator
+        plen_eff = len(self._ent_ids(ent))
+        p_lo = (state.depth - plen_eff) // self.pool.block_size
+        return alloc.can_admit(alloc.blocks_for(state.depth) - p_lo)
+
     def _admit(self, state: _BatchState):
-        """Drain compatible queued requests into free slots (strict FIFO:
+        """Drain parked rows (oldest first — they outrank the queue),
+        then compatible queued requests, into free slots. Strict FIFO:
         an incompatible head closes admission for this batch and seeds
-        the next one). A request parked in ``_pending`` (by ``_seed`` or
-        a previous round) is ALWAYS the head — it is reconsidered first
-        and never overwritten, so no request can be dropped.  When the
-        right-sized batch has no free slot but is narrower than
-        ``max_batch``, the live batch GROWS to the next power of two
-        (ghost rows replicate row 0; per-row exactness makes them
-        inert) instead of turning the arrival away."""
+        the next one — EXCEPT a head that is policy-compatible but
+        lacks pool room, which stays waiting without closing (blocks
+        free up as rows retire; closing would thrash batches under
+        memory pressure). A request parked in ``_pending`` (by
+        ``_seed`` or a previous round) is ALWAYS the queue's head — it
+        is reconsidered first and never overwritten, so no request can
+        be dropped. When the right-sized batch has no free slot but is
+        narrower than ``max_batch``, the live batch GROWS to the next
+        power of two (ghost rows replicate row 0; per-row exactness
+        makes them inert) instead of turning the arrival away."""
+        while self._parked:
+            ent = self._parked[0]
+            if ent.req.cancelled.is_set():
+                self._parked.pop(0)
+                continue
+            if not self._compatible(state, ent):
+                # the parked head must not be overtaken by younger
+                # queued requests: a policy mismatch closes admission
+                # (it seeds the next batch); a depth/headroom mismatch
+                # just waits for the next batch to seed from it
+                if ent.req.sampling != state.sampling:
+                    state.closed = True
+                return
+            if not self._pool_room_for(state, ent):
+                return  # blocks free up as rows retire; stays parked
+            slot = self._free_slot(state)
+            if slot is None:
+                return
+            ent = self._parked.pop(0)
+            try:
+                self._admit_one(state, ent.req, slot, resume=ent)
+            except Exception as e:  # noqa: BLE001
+                ent.req.fail(e)
+                raise
         while True:
             if self._pending is None:
                 try:
@@ -567,19 +810,27 @@ class IterBatchingEngine:
             if not self._compatible(state, req):
                 state.closed = True  # req stays parked as the FIFO head
                 return
-            free = [i for i, s in enumerate(state.slots) if s is None]
-            if not free:
-                if len(state.slots) >= self.max_batch:
-                    return  # full batch: req stays parked, retried at
-                    # the next segment boundary (a slot may retire)
-                self._grow(state)
-                free = [i for i, s in enumerate(state.slots) if s is None]
+            if not self._pool_room_for(state, req):
+                return  # req stays the head; retried as rows retire
+            slot = self._free_slot(state)
+            if slot is None:
+                return
             self._pending = None
             try:
-                self._admit_one(state, req, free[0])
+                self._admit_one(state, req, slot)
             except Exception as e:  # noqa: BLE001 — the popped request is
                 req.fail(e)        # not in state.slots yet; without this
                 raise              # its caller would block forever
+
+    def _free_slot(self, state: _BatchState) -> Optional[int]:
+        free = [i for i, s in enumerate(state.slots) if s is None]
+        if not free:
+            if len(state.slots) >= self.max_batch:
+                return None  # full: retried at the next boundary
+            self._grow(state)
+            free = [i for i, s in enumerate(state.slots) if s is None]
+        return free[0]
+
 
     def _grow(self, state: _BatchState):
         """Widen the live batch to the next power of two: pad token /
@@ -606,7 +857,14 @@ class IterBatchingEngine:
 
         state.token = rep(state.token, 0)
         state.pad_j = rep(state.pad_j, 0)
-        state.cache = grow_cache(state.cache)
+        if state.cache is not None:
+            state.cache = grow_cache(state.cache)
+        if state.tables is not None:
+            # ghost lanes read (and scatter) the trash block only
+            state.tables = np.concatenate(
+                [state.tables,
+                 np.full((pad_rows, self.pool.nbm), self.pool.trash,
+                         dtype=np.int32)], axis=0)
         if state.spec_mode:
             # ghost rows clone row 0's buffer/key lane; their zero
             # budgets keep them inert through every verify (n_emit = 0)
@@ -617,15 +875,22 @@ class IterBatchingEngine:
             self.grows += 1
         REGISTRY.inc("iter_grows_total")
 
-    def _admit_one(self, state: _BatchState, req: _Req, slot: int):
+    def _admit_one(self, state: _BatchState, req: _Req, slot: int,
+                   resume: Optional[_Parked] = None):
         eng = self.engine
-        plen = len(req.prompt)
-        t0 = time.monotonic()
+        stream = self._ent_ids(resume) if resume is not None else req.prompt
+        plen_eff = len(stream)            # tokens the prefill forwards
+        plen = resume.plen if resume is not None else plen_eff
+        t0 = resume.t0 if resume is not None else time.monotonic()
         p0 = time.perf_counter()
         if req.trace is not None:
-            req.trace.add_span("queue_wait", req.t_submit, p0,
-                               scheduler="iter")
-        if self.prefix is not None:
+            if resume is not None:
+                req.trace.add_span("preempted", resume.preempt_t, p0,
+                                   scheduler="iter")
+            else:
+                req.trace.add_span("queue_wait", req.t_submit, p0,
+                                   scheduler="iter")
+        if self.prefix is not None and resume is None:
             # admission prefill through the prefix store: a joiner whose
             # prompt shares a cached prefix forwards only its suffix (and
             # warms the store for the next one). The store's cache is
@@ -635,20 +900,21 @@ class IterBatchingEngine:
             # prefill_state records this row's prefill span (with prefix
             # hit/miss annotations) into the ambient trace.
             with tracing.use_trace(req.trace):
-                logits, solo, sp = self.prefix.prefill_state(req.prompt)
+                logits, solo, sp = self.prefix.prefill_state(stream)
         else:
-            sp = min(_round_up(plen, self.prompt_bucket), state.depth)
-            if sp < plen:   # bucket would overshoot current depth: exact
-                sp = plen   # length (rare; costs one extra prefill program)
+            sp = min(_round_up(plen_eff, self.prompt_bucket), state.depth)
+            if sp < plen_eff:  # bucket would overshoot current depth:
+                sp = plen_eff  # exact length (rare; one extra program)
             ids = np.zeros((1, sp), dtype=np.int32)
-            ids[0, sp - plen:] = req.prompt
-            logits, solo = eng._prefill(eng._run_params(),
-                                        jnp.asarray(ids),
-                                        jnp.asarray([sp - plen], jnp.int32))
+            ids[0, sp - plen_eff:] = stream
+            logits, solo = eng._prefill(
+                eng._run_params(), jnp.asarray(ids),
+                jnp.asarray([sp - plen_eff], jnp.int32))
             if req.trace is not None:
-                req.trace.add_span("prefill", p0, time.perf_counter(),
-                                   kind="admit", depth=state.depth,
-                                   prompt_len=plen)
+                req.trace.add_span(
+                    "prefill", p0, time.perf_counter(),
+                    kind="resume" if resume is not None else "admit",
+                    depth=state.depth, prompt_len=plen_eff)
         sampling = state.sampling
         if sampling.mode == "greedy":
             first = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
@@ -656,34 +922,210 @@ class IterBatchingEngine:
         else:
             pk, dk = jax.random.split(jnp.asarray(req.key))
             first = select_token(logits, sampling, pk[None, :])[0]
-        roll = jnp.asarray(state.depth - sp, jnp.int32)
-        state.cache = _admit_cache(state.cache, solo,
-                                   jnp.asarray(slot, jnp.int32), roll)
-        state.pad_j = state.pad_j.at[slot].set(state.depth - plen)
+        if resume is not None:
+            # the live token is the parked row's last emitted one —
+            # known, never re-selected (see _seed_batch)
+            first = jnp.asarray(int(resume.tokens[-1]), jnp.int32)
+        if self.pool is not None:
+            blk_lo, blk_ids = self._place_admitted(
+                state, slot, plen_eff, solo, state.depth - sp)
+        else:
+            state.cache = _admit_cache(
+                state.cache, solo, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(state.depth - sp, jnp.int32))
+        state.pad_j = state.pad_j.at[slot].set(state.depth - plen_eff)
         state.token = state.token.at[slot].set(first)
         if state.spec_mode:
-            # splice the joiner's stream into its buffer lane: prompt at
-            # [depth - plen, depth), first token at depth — the verify
-            # invariant every live row already satisfies. Host-built row
-            # + traced-offset writes: no program minted per depth.
+            # splice the joiner's stream into its buffer lane: forwarded
+            # tokens at [depth - plen_eff, depth), live token at depth —
+            # the verify invariant every live row already satisfies.
+            # Host-built row + traced-offset writes: no program minted
+            # per depth.
             rowbuf = np.zeros((state.buf.shape[1],), np.int32)
-            rowbuf[state.depth - plen:state.depth] = req.prompt
+            rowbuf[state.depth - plen_eff:state.depth] = stream
             row_j = jax.lax.dynamic_update_slice(
                 jnp.asarray(rowbuf), first[None],
                 (jnp.asarray(state.depth, jnp.int32),))
             state.buf = state.buf.at[slot].set(row_j)
             if sampling.mode != "greedy":
-                # the row's verify key chain starts at its own split(key)[1]
-                # — exactly where its solo spec run's loop would start
-                state.keys = state.keys.at[slot].set(dk)
-        state.slots[slot] = _Slot(req=req, plen=plen, row=slot,
-                                  first_ref=_SegOut(first[None]),
-                                  first_idx=0, dk=dk, t0=t0)
+                # the row's verify key chain starts at its own
+                # split(key)[1] (a fresh joiner) or resumes the parked
+                # snapshot (the chain advanced with every verify step)
+                chain = (jnp.asarray(resume.spec_key)
+                         if resume is not None and resume.spec_key
+                         is not None else dk)
+                state.keys = state.keys.at[slot].set(chain)
+        self._order += 1
+        state.slots[slot] = _Slot(
+            req=req, plen=plen, row=slot,
+            first_ref=None if resume is not None else _SegOut(first[None]),
+            first_idx=0, dk=dk, t0=t0,
+            emitted=resume.emitted if resume is not None else 1,
+            resumed_prefix=resume.tokens if resume is not None else None,
+            order=resume.order if resume is not None else self._order)
+        if self.pool is not None:
+            state.slots[slot].blk_lo = blk_lo
+            state.slots[slot].blk_ids = blk_ids
         with self._stats_lock:
-            self.joins += 1
-        REGISTRY.inc("iter_joins_total")
-        if req.max_new_tokens == 1:
+            if resume is not None:
+                self.resumes += 1
+            else:
+                self.joins += 1
+        if resume is not None:
+            REGISTRY.inc("kv_pool_resumes_total")
+        else:
+            REGISTRY.inc("iter_joins_total")
+        if req.max_new_tokens <= (resume.emitted if resume is not None
+                                  else 1):
             self._retire_finished(state)
+
+    # -- paged storage (pool mode) -------------------------------------------
+
+    def _init_tables(self, state: _BatchState) -> None:
+        """Seed-time placement: allocate each live row's content blocks
+        (pad-prefix positions stay on trash), scatter the seed prefill
+        into them, and drop the contiguous cache — between segments the
+        POOL is the only storage."""
+        bs = self.pool.block_size
+        state.tables = np.full((len(state.slots), self.pool.nbm),
+                               self.pool.trash, dtype=np.int32)
+        p_hi = -(-state.depth // bs)
+        pad_np = np.asarray(state.pad_j)
+        try:
+            for i, s in enumerate(state.slots):
+                if s is None:
+                    continue
+                p_lo = int(pad_np[i]) // bs
+                s.blk_lo = p_lo
+                s.blk_ids = self.pool.allocator.alloc(p_hi - p_lo)
+                state.tables[i, p_lo:p_hi] = s.blk_ids
+            self.pool.scatter(state.cache, state.tables)
+        except BaseException:
+            # all-or-nothing: rows placed before the failure must not
+            # leak their refs (the seed delivers the error to every
+            # request; nothing will ever retire these slots)
+            for i in range(len(state.slots)):
+                self._release_blocks(state, i)
+            raise
+        state.cache = None
+
+    def _place_admitted(self, state: _BatchState, slot: int,
+                        plen_eff: int, solo, roll: int):
+        """Admission-time placement of one solo-prefilled row: allocate
+        its content blocks and scatter the rolled row into them
+        (the paged form of ``_admit_cache``'s roll merge)."""
+        bs = self.pool.block_size
+        p_lo = (state.depth - plen_eff) // bs
+        p_hi = -(-state.depth // bs)
+        ids = self.pool.allocator.alloc(p_hi - p_lo)
+        try:
+            state.tables[slot, :] = self.pool.trash
+            state.tables[slot, p_lo:p_hi] = ids
+            self.pool.scatter_row(solo, state.tables[slot], roll)
+        except BaseException:
+            self.pool.allocator.free(ids)
+            state.tables[slot, :] = self.pool.trash
+            raise
+        return p_lo, ids
+
+    def _release_blocks(self, state: _BatchState, i: int) -> None:
+        s = state.slots[i]
+        if self.pool is None or s is None or not s.blk_ids:
+            return
+        self.pool.allocator.free(s.blk_ids)
+        s.blk_ids = []
+        if state.tables is not None:
+            state.tables[i, :] = self.pool.trash
+
+    def _ensure_blocks(self, state: _BatchState, new_depth: int) -> None:
+        """Pre-segment growth: every live row must own blocks covering
+        depth ``new_depth - 1``'s writes. Walked oldest-first so that
+        when allocation fails — even after the allocator LRU-evicted
+        every zero-ref prefix entry — the rows preempted to make room
+        are the youngest (lowest priority)."""
+        from .kv_pool import PoolExhausted
+        p_hi = -(-new_depth // self.pool.block_size)
+        for s in sorted((s for s in state.slots if s is not None),
+                        key=lambda s: s.order):
+            if state.slots[s.row] is not s:
+                continue  # preempted by an earlier iteration
+            while True:
+                missing = p_hi - (s.blk_lo + len(s.blk_ids))
+                if missing <= 0:
+                    break
+                try:
+                    ids = self.pool.allocator.alloc(missing)
+                except PoolExhausted:
+                    if not self._preempt_lowest(state):
+                        raise  # nothing left to preempt: cannot happen
+                        # while the pool holds >= blocks_per_row blocks
+                    if state.slots[s.row] is not s:
+                        break  # this row WAS the youngest: it parked
+                    continue
+                col = s.blk_lo + len(s.blk_ids)
+                state.tables[s.row, col:p_hi] = ids
+                s.blk_ids.extend(ids)
+
+    def _extend_blocks_down(self, state: _BatchState,
+                            pad_np: np.ndarray) -> None:
+        """Spec-mode low growth: a re-sync roll that shrank a row's pad
+        moved real content into columns below ``blk_lo`` — own them
+        before the full-row scatter (preempting younger rows if the
+        allocator cannot stretch)."""
+        from .kv_pool import PoolExhausted
+        bs = self.pool.block_size
+        for s in sorted((s for s in state.slots if s is not None),
+                        key=lambda s: s.order):
+            if state.slots[s.row] is not s:
+                continue
+            new_lo = int(pad_np[s.row]) // bs
+            while new_lo < s.blk_lo:
+                try:
+                    ids = self.pool.allocator.alloc(s.blk_lo - new_lo)
+                except PoolExhausted:
+                    if not self._preempt_lowest(state):
+                        raise
+                    if state.slots[s.row] is not s:
+                        break
+                    continue
+                state.tables[s.row, new_lo:s.blk_lo] = ids
+                s.blk_ids = ids + s.blk_ids
+                s.blk_lo = new_lo
+
+    def _preempt_lowest(self, state: _BatchState) -> bool:
+        """Park the lowest-priority live row (latest admission order):
+        fetch its emitted tokens (host sync — the preemption path is
+        the slow path by design), free its blocks, and queue it for
+        recompute-resume. The victim set is EVERY live row, including
+        the one whose growth triggered the call — priority alone
+        decides (the growth loops detect their own row parking and
+        stop)."""
+        live = [s for s in state.slots if s is not None]
+        if not live:
+            return False
+        victim = max(live, key=lambda s: s.order)
+        tokens = np.asarray(self._row_tokens(victim), dtype=np.int32)
+        spec_key = None
+        if state.spec_mode and state.sampling.mode != "greedy":
+            spec_key = np.asarray(state.keys[victim.row])
+        parked = _Parked(req=victim.req, plen=victim.plen,
+                         emitted=min(victim.emitted,
+                                     victim.req.max_new_tokens),
+                         tokens=tokens, order=victim.order, t0=victim.t0,
+                         preempt_t=time.perf_counter(),
+                         spec_key=spec_key)
+        self._release_blocks(state, victim.row)
+        state.slots[victim.row] = None
+        # oldest-first resume order (sorted by admission order)
+        self._parked.append(parked)
+        self._parked.sort(key=lambda p: p.order)
+        if victim.req.trace is not None:
+            victim.req.trace.labels["preempted"] = (
+                victim.req.trace.labels.get("preempted", 0) + 1)
+        with self._stats_lock:
+            self.preemptions += 1
+        REGISTRY.inc("kv_pool_preemptions_total")
+        return True
 
     # -- the segment step ----------------------------------------------------
 
@@ -695,8 +1137,12 @@ class IterBatchingEngine:
         REGISTRY.gauge("iter_live_rows", live)
         REGISTRY.gauge("batch_occupancy", round(live / max(width, 1), 4),
                        scheduler="iter")
-        REGISTRY.gauge("kv_cache_slots_in_use", state.depth * live,
-                       component="iter")
+        if self.pool is not None:
+            # exact allocator numbers (live rows + prefix entries)
+            self.pool.note_gauges(component="iter")
+        else:
+            kv_block_gauges("iter", state.depth * live,
+                            width * self.engine._cache_seq)
         REGISTRY.gauge("queue_depth", self._queue.qsize(),
                        scheduler="iter")
 
@@ -708,11 +1154,27 @@ class IterBatchingEngine:
         n = min(self.seg_steps, eng.max_seq - d)
         assert n >= 1, "active rows past max_seq (admission bug)"
         window = eng._decode_window(d + n)   # shared bucket policy
+        pooled = self.pool is not None
+        if pooled:
+            # grow every live row's block range to cover this segment's
+            # writes — THE preemption point (youngest row parks when
+            # even LRU eviction cannot free enough blocks)
+            self._ensure_blocks(state, d + n)
+            if not state.active():
+                return  # everyone preempted (single-row pool squeeze)
+            cache = self.pool.gather(state.tables, d)
+        else:
+            cache = state.cache
         step_keys = self._segment_keys(state, n)
         t0 = time.perf_counter()
-        out, state.cache = eng._decode_seg(
-            eng._run_params(), state.token, state.cache, state.pad_j,
+        out, cache = eng._decode_seg(
+            eng._run_params(), state.token, cache, state.pad_j,
             step_keys, sampling=state.sampling, window=window)
+        if pooled:
+            self.pool.scatter(cache, state.tables)
+            self.pool.note_compiles()
+        else:
+            state.cache = cache
         state.token = out[:, -1]
         state.depth = d + n
         seg = _SegOut(out)
@@ -728,9 +1190,10 @@ class IterBatchingEngine:
                 if s.req.trace is not None:
                     # dispatch wall time (segments queue asynchronously
                     # on the device — the serving-thread view)
-                    s.req.trace.add_span("decode", t0, t1, seg=True,
-                                         steps=n, width=len(state.slots),
-                                         depth=state.depth)
+                    s.req.trace.add_span(
+                        "decode", t0, t1, seg=True, steps=n,
+                        width=len(state.slots), depth=state.depth,
+                        **({"blocks": len(s.blk_ids)} if pooled else {}))
         self._retire_finished(state)
         self._set_gauges(state)
 
@@ -751,29 +1214,73 @@ class IterBatchingEngine:
         here, before the next segment donates the buffer."""
         eng = self.engine
         K = self.spec.draft_len
+        max_verify = max(1, self.seg_steps // (K + 1))
+        pooled = self.pool is not None
+        if pooled:
+            # verify headroom: writes reach depth + K within a verify,
+            # and the segment can emit up to max_verify * (K + 1) new
+            # tokens — cover the worst case before dispatch (preempting
+            # youngest rows if the allocator cannot stretch)
+            worst = min(state.depth + max_verify * (K + 1) + K,
+                        eng.max_seq)
+            self._ensure_blocks(state, worst)
+            if not state.active():
+                return
+            in_cache = self.pool.gather(state.tables, state.depth)
+        else:
+            in_cache = state.cache
+        # budgets AFTER any preemption above: a row parked at this
+        # boundary must enter the segment as an inert ghost (budget 0),
+        # not keep drafting into the trash block
         b = len(state.slots)
         budgets = np.zeros((b,), np.int32)
         for i, s in enumerate(state.slots):
             if s is not None:
                 budgets[i] = max(s.req.max_new_tokens - s.emitted, 0)
-        max_verify = max(1, self.seg_steps // (K + 1))
         t0 = time.perf_counter()
         # the spec flag is routing metadata: normalize it out of the
         # static sampling arg so the segment program is shared with (and
         # byte-identical to) the solo spec engine's acceptance math
         sampling = dataclasses.replace(state.sampling, spec=False)
         buf, total, cache, pad, emitted, steps, keys = self.spec._seg_b(
-            eng._run_params(), state.buf, state.cache,
+            eng._run_params(), state.buf, in_cache,
             jnp.asarray(state.depth + 1, jnp.int32), state.pad_j,
             state.keys, jnp.asarray(budgets),
             max_verify=max_verify, sampling=sampling)
-        state.buf, state.cache = buf, cache
+        state.buf = buf
         state.pad_j, state.keys = pad, keys
         seg = _SegOut(buf)
         emitted_np = np.asarray(emitted)          # THE per-segment sync
         pad_np = np.asarray(pad)
         steps_i = int(steps)
         state.depth = int(total) - 1
+        # slot progress updates FIRST: a preemption triggered by the
+        # pool handoff below must park a POST-segment-consistent
+        # snapshot (emitted, buffer, key chain all advanced together)
+        for s in state.slots:
+            if s is not None:
+                s.emitted += int(emitted_np[s.row])
+                s.spec_buf = seg
+                s.spec_pad = int(pad_np[s.row])
+        if pooled:
+            # The spec segment's per-row rewind/re-sync ROLLS whole
+            # cache rows (spec_decode._roll_cache_rows — a permutation
+            # of every slot, not an append), so (a) a row's content can
+            # extend DOWNWARD into what used to be pad — any table
+            # column the roll made live must own a real block before
+            # the handoff, or the scatter would drop content into the
+            # trash block — and (b) the handoff must rewrite the full
+            # row, never just the new columns. The declared contract
+            # keeps the two modules honest.
+            from .spec_decode import SEG_REWRITES_FULL_CACHE
+            assert SEG_REWRITES_FULL_CACHE, (
+                "spec segments no longer rewrite whole cache rows; the "
+                "pool handoff can narrow to the new columns")
+            self._extend_blocks_down(state, pad_np)
+            self.pool.scatter(cache, state.tables)
+            self.pool.note_compiles()
+        else:
+            state.cache = cache
         _ = seg.np  # materialize: the next segment donates ``buf``
         with self._stats_lock:
             self.segments_run += 1
@@ -788,16 +1295,13 @@ class IterBatchingEngine:
         self.spec._note_compiles()
         t1 = time.perf_counter()
         for s in state.slots:
-            if s is not None:
-                s.emitted += int(emitted_np[s.row])
-                s.spec_buf = seg
-                s.spec_pad = int(pad_np[s.row])
-                if s.req.trace is not None:
-                    s.req.trace.add_span(
-                        "decode", t0, t1, seg=True, spec=True,
-                        verify_steps=steps_i,
-                        emitted=int(emitted_np[s.row]),
-                        width=len(state.slots), depth=state.depth)
+            if s is not None and s.req.trace is not None:
+                s.req.trace.add_span(
+                    "decode", t0, t1, seg=True, spec=True,
+                    verify_steps=steps_i,
+                    emitted=int(emitted_np[s.row]),
+                    width=len(state.slots), depth=state.depth,
+                    **({"blocks": len(s.blk_ids)} if pooled else {}))
         self._retire_finished(state)
         self._set_gauges(state)
 
@@ -830,6 +1334,7 @@ class IterBatchingEngine:
                 # Caller timed out and left: free the slot instead of
                 # decoding dead tokens for nobody. Nothing is delivered
                 # (the payload has no reader).
+                self._release_blocks(state, i)
                 state.slots[i] = None
                 continue
             done = s.emitted >= s.req.max_new_tokens
@@ -849,11 +1354,18 @@ class IterBatchingEngine:
         if s.spec_buf is not None:
             # spec rows: the buffer IS the stream — prompt at
             # [pad, pad + plen), everything emitted right after it
+            # (resumed rows included: the resume splice rebuilt the
+            # lane with the full emitted stream in place)
             row = s.spec_buf.np[s.row]
             start = s.spec_pad + s.plen
             n = min(s.emitted, s.req.max_new_tokens)
             return row[start:start + n]
-        parts = [s.first_ref.np[s.first_idx:s.first_idx + 1]]
+        if s.resumed_prefix is not None:
+            # a resumed row's pre-preemption tokens were fetched at the
+            # park; segments since the resume append after them
+            parts = [s.resumed_prefix]
+        else:
+            parts = [s.first_ref.np[s.first_idx:s.first_idx + 1]]
         parts += [seg.np[s.row] for seg, _ in s.segs]
         return np.concatenate(parts)[:s.req.max_new_tokens]
 
@@ -868,6 +1380,7 @@ class IterBatchingEngine:
         s.done_t = time.monotonic()
         s.req.payload = (s, eos_at)
         s.req.done.set()
+        self._release_blocks(state, i)
         state.slots[i] = None
         with self._stats_lock:
             self.rows_served += 1

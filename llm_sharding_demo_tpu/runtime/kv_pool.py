@@ -1,0 +1,650 @@
+"""Paged KV-cache memory subsystem: block pool, CoW sharing, admission.
+
+After PRs 1-3 the binding serving constraint is KV memory, not
+scheduling: every decode row owns a contiguous ``max_seq`` cache for its
+whole lifetime, the prefix store duplicated entire prefill states per
+entry, and nothing sheds load under pressure — the reference, of course,
+has no KV state at all (it re-forwards the full sequence per token,
+reference server.py:169-181). This module is the first-class manager:
+
+- ``BlockAllocator`` — host-side, device-free accounting: ref-counted
+  blocks, a content-keyed prefix registry whose entries share blocks
+  structurally (entry for chunks [0, m) references the same physical
+  blocks as the deeper entry for [0, m+k) — the duplication the old
+  store paid is gone), LRU eviction of zero-ref prefix blocks, and
+  watermark admission (``can_admit`` holds back a growth reserve so
+  live batches can deepen without instantly preempting).
+- ``KVBlockPool`` — the device pool (one
+  ``[L, num_blocks+1, 2, Hkv, block_size, hd]`` buffer; per layer the
+  ``[num_blocks, 2, n_kv_head, block_size, head_dim]`` block array,
+  plus the shared trash block) + the jitted gather/scatter/copy
+  programs over it (``ops.paged_attention``) and the pool-derived
+  ``kv_cache_blocks_*`` gauges.
+- ``PagedKVRunner`` — solo/batched paged decode over an unmodified
+  ``DecodeEngine``: prefill with THE engine's program, scatter the
+  state into blocks, then per decode segment gather -> run the
+  engine's OWN ``_decode_seg`` -> scatter back. The compiled model
+  programs are untouched and shared with contiguous serving, so paged
+  decode is byte-equal by construction (greedy and seeded sample,
+  pinned). With a pool-backed ``PrefixCachingEngine`` attached, a
+  prefix hit REFERENCES the store's blocks in the row's table instead
+  of copying the prefill state — live decode and the prefix store
+  share one physical copy, with the partially-filled frontier block
+  copy-on-write'd before the row's first write into it.
+
+Preemption (the admission story's other half) lives in
+``runtime.iterbatch``: under pool exhaustion the scheduler parks the
+lowest-priority row, frees its blocks, and later resumes it by
+RECOMPUTE — re-prefilling prompt + already-emitted tokens and
+continuing the row's own per-step PRNG chain, which reproduces the
+un-preempted stream byte-identically (prefix-stable key splits +
+prefill/incremental KV equality, pinned by tests). ``serving.app``
+turns sustained exhaustion into 429 + Retry-After instead of queueing
+unboundedly.
+
+Block lifecycle (docs/ARCHITECTURE.md has the full diagram)::
+
+    free -> allocated (ref=1, private)
+         -> shared    (ref>1: live table refs and/or prefix entries)
+         -> evictable (ref held only by prefix entries, LRU-ordered)
+         -> free      (last ref dropped / entry evicted)
+
+Writers never mutate a shared block: extension into a shared frontier
+block goes through ``cow_copy`` (allocate, copy, retarget the table
+entry, deref the original).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import paged_attention as PA
+from ..ops.attention import KVCache
+from ..utils import tracing
+from ..utils.metrics import DEFAULT_KV_BLOCK_SIZE, REGISTRY, CompileWatch
+from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
+                     _eos_capped_segments, _split_keys, _step_keys,
+                     prepare_generate, select_token)
+
+# Static-analysis contract (tools/graftcheck): every ``jax.jit`` site in
+# this module, by holding attribute — enumerated by the recompile-budget
+# certifier; an undeclared site is a lint finding.
+JIT_ENTRY_POINTS = ("_gather", "_scatter", "_scatter_row", "_copy")
+
+
+class PoolExhausted(RuntimeError):
+    """No allocation possible even after evicting every zero-ref prefix
+    entry. Schedulers catch this and preempt; serving turns sustained
+    exhaustion into 429."""
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolStats:
+    blocks_total: int
+    blocks_free: int
+    blocks_in_use: int      # any ref (live rows and/or prefix entries)
+    blocks_evictable: int   # in_use blocks whose refs are ALL prefix refs
+    prefix_entries: int
+    evictions: int
+    cow_copies: int
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class BlockAllocator:
+    """Host-side ref-counted block accounting. Pure bookkeeping — no
+    device arrays — so every policy (refcounts, CoW, LRU, watermarks)
+    is unit-testable without a pool.
+
+    ``watermark`` bounds ADMISSION, not allocation: ``can_admit(n)``
+    refuses while ``n`` would push referenced blocks past
+    ``watermark * num_blocks``, keeping the remainder free as growth
+    headroom for already-admitted rows (so preemption stays the
+    exception, not the steady state). ``alloc`` itself may use the
+    reserve — that is what it is for.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 watermark: float = 0.9):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks={num_blocks} must be >= 1")
+        if block_size < 1:
+            raise ValueError(f"block_size={block_size} must be >= 1")
+        if not 0.0 < watermark <= 1.0:
+            raise ValueError(f"watermark={watermark} must be in (0, 1]")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.watermark = watermark
+        self._lock = threading.RLock()
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._ref: Dict[int, int] = {}
+        # content-key -> tuple(block ids); insertion order IS the LRU
+        # order (lookups move_to_end). Each entry holds one ref per id,
+        # tracked separately in _prefix_ref so "evictable" is decidable.
+        self._prefix: "OrderedDict[bytes, Tuple[int, ...]]" = OrderedDict()
+        self._prefix_ref: Dict[int, int] = {}
+        self.evictions = 0
+        self.cow_copies = 0
+
+    # -- sizing --------------------------------------------------------------
+
+    def blocks_for(self, n_slots: int) -> int:
+        return max(0, -(-n_slots // self.block_size))
+
+    # -- allocation ----------------------------------------------------------
+
+    def _evictable_blocks_locked(self) -> int:
+        return sum(1 for b, r in self._ref.items()
+                   if r > 0 and r == self._prefix_ref.get(b, 0))
+
+    def available(self) -> int:
+        """Blocks obtainable right now: free + freeable-by-eviction."""
+        with self._lock:
+            return len(self._free) + self._evictable_blocks_locked()
+
+    def can_admit(self, n_blocks: int) -> bool:
+        """Watermark admission: would granting ``n_blocks`` keep
+        referenced blocks at or under the watermark (after evicting
+        prefix entries as needed)?"""
+        with self._lock:
+            if n_blocks > len(self._free) + self._evictable_blocks_locked():
+                return False
+            live = len(self._ref) - self._evictable_blocks_locked()
+            return live + n_blocks <= self.watermark * self.num_blocks
+
+    def alloc(self, n: int) -> List[int]:
+        """Allocate ``n`` blocks at ref=1, LRU-evicting zero-ref prefix
+        entries as needed. All-or-nothing: raises ``PoolExhausted``
+        without taking anything when ``n`` cannot be satisfied."""
+        if n == 0:
+            return []
+        with self._lock:
+            while len(self._free) < n and self._prefix:
+                self._evict_lru_locked()
+            if len(self._free) < n:
+                raise PoolExhausted(
+                    f"need {n} blocks, {len(self._free)} free and no "
+                    f"evictable prefix entries ({len(self._ref)} blocks "
+                    "referenced)")
+            out = [self._free.pop() for _ in range(n)]
+            for b in out:
+                self._ref[b] = 1
+            return out
+
+    def ref(self, ids) -> None:
+        with self._lock:
+            for b in ids:
+                if b not in self._ref:
+                    raise ValueError(f"ref of unallocated block {b}")
+                self._ref[b] += 1
+
+    def free(self, ids) -> None:
+        """Drop one ref per id; zero-ref blocks return to the free
+        list (idempotence is the caller's problem — double-frees raise)."""
+        with self._lock:
+            for b in ids:
+                r = self._ref.get(b)
+                if r is None:
+                    raise ValueError(f"free of unallocated block {b}")
+                if r == 1:
+                    del self._ref[b]
+                    self._free.append(b)
+                else:
+                    self._ref[b] = r - 1
+
+    def refcount(self, block: int) -> int:
+        with self._lock:
+            return self._ref.get(block, 0)
+
+    # -- prefix registry -----------------------------------------------------
+
+    def register_prefix(self, key: bytes, ids) -> None:
+        """Register ``ids`` as the cached state for content ``key``.
+        The entry takes its OWN ref on every block (the caller keeps
+        any refs it holds); re-registering an existing key is a no-op
+        beyond an LRU touch."""
+        with self._lock:
+            if key in self._prefix:
+                self._prefix.move_to_end(key)
+                return
+            ids = tuple(ids)
+            for b in ids:
+                if b not in self._ref:
+                    raise ValueError(
+                        f"register_prefix of unallocated block {b}")
+                self._ref[b] += 1
+                self._prefix_ref[b] = self._prefix_ref.get(b, 0) + 1
+            self._prefix[key] = ids
+
+    def lookup_prefix(self, key: bytes) -> Optional[Tuple[int, ...]]:
+        """Hit -> the entry's block ids with one caller ref added per
+        block (release with ``free``); miss -> None. Hits refresh LRU
+        recency."""
+        with self._lock:
+            ids = self._prefix.get(key)
+            if ids is None:
+                return None
+            self._prefix.move_to_end(key)
+            for b in ids:
+                self._ref[b] += 1
+            return ids
+
+    def has_prefix(self, key: bytes) -> bool:
+        with self._lock:
+            return key in self._prefix
+
+    def drop_prefix(self, key: bytes) -> bool:
+        with self._lock:
+            ids = self._prefix.pop(key, None)
+            if ids is None:
+                return False
+            self._deref_prefix_locked(ids)
+            return True
+
+    def prefix_len(self) -> int:
+        with self._lock:
+            return len(self._prefix)
+
+    def _deref_prefix_locked(self, ids) -> None:
+        for b in ids:
+            self._prefix_ref[b] -= 1
+            if self._prefix_ref[b] == 0:
+                del self._prefix_ref[b]
+            if self._ref[b] == 1:
+                del self._ref[b]
+                self._free.append(b)
+            else:
+                self._ref[b] -= 1
+
+    def _evict_lru_locked(self) -> None:
+        key, ids = self._prefix.popitem(last=False)
+        self._deref_prefix_locked(ids)
+        self.evictions += 1
+        REGISTRY.inc("kv_pool_evictions_total")
+
+    def evict_lru(self) -> None:
+        with self._lock:
+            if self._prefix:
+                self._evict_lru_locked()
+
+    # -- stats ---------------------------------------------------------------
+
+    def stats(self) -> PoolStats:
+        with self._lock:
+            ev = self._evictable_blocks_locked()
+            return PoolStats(
+                blocks_total=self.num_blocks,
+                blocks_free=len(self._free),
+                blocks_in_use=len(self._ref),
+                blocks_evictable=ev,
+                prefix_entries=len(self._prefix),
+                evictions=self.evictions,
+                cow_copies=self.cow_copies)
+
+
+class KVBlockPool:
+    """The device block pool + its allocator + its compiled programs.
+
+    One buffer ``[L, num_blocks+1, 2, Hkv, bs, hd]`` (index
+    ``num_blocks`` is the shared trash block — see
+    ``ops.paged_attention``). All device mutation goes through the
+    jitted programs here, serialized by ``_dev_lock`` (the pool buffer
+    is donated through every scatter, and concurrent front ends — a
+    solo runner, the prefix store, the iteration scheduler — may share
+    one pool).
+    """
+
+    def __init__(self, n_layer: int, num_blocks: int, n_kv_head: int,
+                 block_size: int, head_dim: int, max_seq: int,
+                 dtype=jnp.float32, watermark: float = 0.9):
+        self.nbm = PA.blocks_per_row(max_seq, block_size)
+        if num_blocks < self.nbm:
+            raise ValueError(
+                f"num_blocks={num_blocks} cannot hold even one full "
+                f"row ({self.nbm} blocks at max_seq={max_seq}, "
+                f"block_size={block_size}) — nothing could ever decode "
+                "to budget")
+        self.block_size = block_size
+        self.max_seq = max_seq
+        self.trash = num_blocks
+        self.dtype = dtype
+        self.allocator = BlockAllocator(num_blocks, block_size,
+                                        watermark=watermark)
+        self.data = jnp.zeros(
+            PA.pool_shape(n_layer, num_blocks, n_kv_head, block_size,
+                          head_dim), dtype=dtype)
+        self._dev_lock = threading.RLock()
+
+        # per-instance defs (not the module-level ops directly): each
+        # pool owns its jitted-program caches, so ``_cache_size()`` is
+        # THIS pool's program count — the recompile-budget certifier
+        # pins it per workload, which a function-identity-shared cache
+        # would smear across instances
+        def _gather_impl(pool, tables):
+            return PA.gather_kv(pool, tables)
+
+        def _scatter_impl(pool, k, v, tables):
+            return PA.scatter_kv(pool, k, v, tables)
+
+        def _scatter_one_rolled(pool, k, v, table_row, roll):
+            # admission merge: roll a solo-prefilled row's K/V content
+            # along the slot axis (engine left-pad convention — wrap
+            # garbage lands in masked pad slots), then scatter the full
+            # row. roll/table are traced: one program per solo shape.
+            k = jnp.roll(k, roll, axis=-2)
+            v = jnp.roll(v, roll, axis=-2)
+            return PA.scatter_kv(pool, k, v, table_row[None])
+
+        def _copy_impl(pool, src, dst):
+            return PA.copy_blocks(pool, src, dst)
+
+        self._gather = jax.jit(_gather_impl)
+        self._scatter = jax.jit(_scatter_impl, donate_argnums=(0,))
+        self._scatter_row = jax.jit(_scatter_one_rolled, donate_argnums=(0,))
+        self._copy = jax.jit(_copy_impl, donate_argnums=(0,))
+        self._compile_watches = (
+            CompileWatch("kv_pool", self._gather),
+            CompileWatch("kv_pool", self._scatter),
+            CompileWatch("kv_pool", self._scatter_row),
+            CompileWatch("kv_pool", self._copy))
+
+    @classmethod
+    def for_engine(cls, engine: DecodeEngine, num_blocks: int,
+                   block_size: int = DEFAULT_KV_BLOCK_SIZE,
+                   watermark: float = 0.9) -> "KVBlockPool":
+        """Build a pool matching an engine's cache geometry. The paged
+        path drives the engine's OWN compiled programs on gathered
+        views, so the engine must run the plain XLA single-device
+        layout: no Pallas decode kernel (fused layout + in-place DMA),
+        no stage partitioning (per-stage cache lists), no mesh."""
+        if engine._decode_kernel is not None:
+            raise NotImplementedError(
+                "KV pool paging drives the XLA cache layout; the Pallas "
+                "decode kernel owns its fused in-place cache "
+                "(decode_kernel='xla' composes)")
+        if engine.specs is not None:
+            raise NotImplementedError(
+                "KV pool paging covers the unstaged engine; staged "
+                "per-stage cache lists page in a later PR")
+        if engine._mesh is not None:
+            raise NotImplementedError(
+                "KV pool paging is single-device; mesh decode (tp/ep) "
+                "keeps contiguous caches")
+        cfg = engine.config
+        heads = getattr(cfg, "n_kv_head", cfg.n_head)
+        return cls(cfg.n_layer, num_blocks, heads, block_size,
+                   cfg.head_dim, engine._cache_seq, dtype=engine.dtype,
+                   watermark=watermark)
+
+    # -- device ops (all under _dev_lock) ------------------------------------
+
+    def gather(self, tables: np.ndarray, length: int) -> KVCache:
+        """Contiguous working cache for the tabled rows (a FRESH buffer
+        — downstream decode may donate it). ``length`` is the logical
+        depth the caller tracks host-side."""
+        with self._dev_lock:
+            k, v = self._gather(self.data, jnp.asarray(tables, jnp.int32))
+        return KVCache(k=k, v=v, length=jnp.asarray(length, jnp.int32))
+
+    def scatter(self, cache: KVCache, tables: np.ndarray) -> None:
+        with self._dev_lock:
+            self.data = self._scatter(self.data, cache.k, cache.v,
+                                      jnp.asarray(tables, jnp.int32))
+
+    def scatter_columns(self, cache: KVCache, tables: np.ndarray,
+                        nb_lo: int) -> None:
+        """Scatter only table columns ``[nb_lo, NBm)`` of a full-width
+        contiguous cache — THE column-offset convention for writing a
+        privately-owned tail behind a shared (immutable) prefix, used
+        by both the prefix store's insert and the paged runner's
+        shared-prefix placement. One program per nb_lo value
+        (``scatter_kv`` derives the block size from the view widths) —
+        bounded by the store's chunk grid."""
+        bs = self.block_size
+        sub = KVCache(k=cache.k[..., nb_lo * bs:, :],
+                      v=cache.v[..., nb_lo * bs:, :], length=cache.length)
+        self.scatter(sub, tables[:, nb_lo:])
+
+    def scatter_row(self, cache: KVCache, table_row: np.ndarray,
+                    roll: int) -> None:
+        """Merge one solo-prefilled row (content at ``[sp - plen, sp)``)
+        into its blocks at logical ``[d - plen, d)`` (``roll = d - sp``,
+        the iterbatch admission move)."""
+        with self._dev_lock:
+            self.data = self._scatter_row(
+                self.data, cache.k, cache.v,
+                jnp.asarray(table_row, jnp.int32),
+                jnp.asarray(roll, jnp.int32))
+
+    def cow_copy(self, src: int) -> int:
+        """Copy-on-write: allocate a private block, copy ``src`` into
+        it, and return the new id. The caller retargets its table entry
+        and drops its own ref on ``src``."""
+        dst = self.allocator.alloc(1)[0]
+        with self._dev_lock:
+            self.data = self._copy(self.data,
+                                   jnp.asarray([src], jnp.int32),
+                                   jnp.asarray([dst], jnp.int32))
+        self.allocator.cow_copies += 1
+        REGISTRY.inc("kv_pool_cow_copies_total")
+        return dst
+
+    # -- observability -------------------------------------------------------
+
+    def note_compiles(self) -> None:
+        for w in self._compile_watches:
+            w.check()
+
+    def note_gauges(self, component: str = "pool") -> None:
+        st = self.allocator.stats()
+        REGISTRY.gauge("kv_cache_blocks_in_use",
+                       st.blocks_in_use - st.blocks_evictable,
+                       component=component)
+        REGISTRY.gauge("kv_cache_blocks_total", st.blocks_total,
+                       component=component)
+
+    def stats(self) -> dict:
+        return {**self.allocator.stats().as_dict(),
+                "block_size": self.block_size,
+                "blocks_per_row": self.nbm}
+
+
+class PagedKVRunner:
+    """Solo/batched paged generate: the engine's compiled programs on
+    pool-backed storage (same calling convention as
+    ``DecodeEngine.generate``; byte-equal output, pinned by
+    tests/test_kv_pool.py).
+
+    With ``prefix`` (a pool-backed ``PrefixCachingEngine`` wrapping the
+    SAME engine and pool), a prompt whose prefix is stored prefills
+    only its suffix AND shares the store's physical blocks in its own
+    table — the full-depth duplication the old store paid per entry is
+    gone; only the partially-filled frontier block is copy-on-write'd
+    (the row will write into it).
+    """
+
+    def __init__(self, engine: DecodeEngine, pool: KVBlockPool,
+                 prefix=None):
+        if pool.max_seq != engine._cache_seq:
+            raise ValueError(
+                f"pool rows span {pool.max_seq} slots, engine cache is "
+                f"{engine._cache_seq} — gathered views must match the "
+                "compiled programs' cache width exactly")
+        if engine.prefill_chunk:
+            raise NotImplementedError(
+                "PagedKVRunner prefills monolithically (one scatter per "
+                "admission); build the engine without prefill_chunk")
+        if prefix is not None:
+            if prefix.plain is not engine:
+                raise ValueError("prefix must wrap the same DecodeEngine")
+            if getattr(prefix, "_pool", None) is not pool:
+                raise ValueError(
+                    "prefix store must be backed by the same pool "
+                    "(pass pool= to PrefixCachingEngine) — block "
+                    "sharing is the point")
+        self.engine = engine
+        self.pool = pool
+        self.prefix = prefix
+        # one generation at a time: the pool buffer is donated through
+        # every scatter, and the allocator's alloc/free pairs must not
+        # interleave between concurrent generates
+        self._lock = threading.Lock()
+
+    def generate(self, prompt_ids, max_new_tokens: int,
+                 sampling: SamplingConfig = SamplingConfig(),
+                 key: Optional[jax.Array] = None,
+                 pad: Optional[np.ndarray] = None,
+                 eos_id: Optional[int] = None) -> GenerateResult:
+        eng = self.engine
+        ids, batch, prompt_len, key, pad = prepare_generate(
+            prompt_ids, max_new_tokens, eng.max_seq, sampling, key, pad=pad)
+        alloc = self.pool.allocator
+        with self._lock:
+            t0 = time.perf_counter()
+            prefill_key, decode_key = _split_keys(key)
+            run_params = eng._run_params()
+            # tables rows cover the full logical row; entries past the
+            # owned/shared range are trash (masked garbage)
+            logits, tables, owned, shared = self._prefill_tables(
+                ids, batch, prompt_len, max_new_tokens, pad, run_params)
+            first = select_token(logits, sampling, prefill_key)
+            first.block_until_ready()
+            t1 = time.perf_counter()
+            tracing.record("prefill", t0, t1, batch=batch,
+                           prompt_len=prompt_len, paged=True)
+            self.pool.note_gauges(component="paged")
+            try:
+                return self._decode(run_params, ids, pad, first, tables,
+                                    decode_key, max_new_tokens, sampling,
+                                    prompt_len, t1 - t0, eos_id)
+            finally:
+                for row_ids in owned:
+                    alloc.free(row_ids)
+                for row_ids in shared:
+                    alloc.free(row_ids)
+                self.pool.note_gauges(component="paged")
+
+    # -- prefill + placement -------------------------------------------------
+
+    def _prefill_tables(self, ids, batch, prompt_len, max_new, pad,
+                        run_params):
+        """Prefill (through the prefix store when attached), allocate
+        each row's blocks, scatter the state. Returns
+        ``(last_logits [B, V], tables [B, NBm], owned_ids per row,
+        shared_ids per row)``."""
+        eng = self.engine
+        pool = self.pool
+        alloc = pool.allocator
+        bs = pool.block_size
+        nbm = pool.nbm
+        need = alloc.blocks_for(prompt_len + max_new)
+        tables = np.full((batch, nbm), pool.trash, dtype=np.int32)
+        owned: List[List[int]] = []
+        shared: List[List[int]] = []
+
+        use_store = (self.prefix is not None and batch == 1
+                     and not pad.any())
+        frontier: List[int] = []
+        try:
+            if use_store:
+                logits, cache, keep_ids, hit_depth = \
+                    self.prefix.prefill_shared(ids[0])
+                # shared full blocks stay shared; a partially-filled
+                # frontier block is CoW'd (this row writes into it)
+                n_full = hit_depth // bs
+                row_shared = list(keep_ids[:n_full])
+                shared.append(row_shared)
+                row_owned: List[int] = []
+                owned.append(row_owned)
+                frontier = list(keep_ids[n_full:])
+                while frontier:
+                    row_owned.append(pool.cow_copy(frontier[0]))
+                    alloc.free([frontier.pop(0)])
+                row_owned.extend(alloc.alloc(need - n_full - len(row_owned)))
+                tables[0, :n_full] = row_shared
+                tables[0, n_full:need] = row_owned
+                # scatter ONLY the privately owned tail: shared prefix
+                # blocks already hold these bytes (the walk gathered
+                # from them) and registry blocks are immutable by
+                # contract
+                pool.scatter_columns(cache, tables, n_full)
+            else:
+                ids_j = jnp.asarray(ids, dtype=jnp.int32)
+                pad_j = jnp.asarray(pad) if pad.any() else None
+                logits, cache = eng._prefill(run_params, ids_j, pad_j)
+                for b in range(batch):
+                    row = alloc.alloc(need)
+                    tables[b, :need] = row
+                    owned.append(row)
+                    shared.append([])
+                pool.scatter(cache, tables)
+        except BaseException:
+            # all-or-nothing: a mid-placement failure (e.g. exhaustion
+            # after the CoW copy) must not leak the refs taken so far
+            for row_ids in owned:
+                alloc.free(row_ids)
+            for row_ids in shared:
+                alloc.free(row_ids)
+            alloc.free(frontier)
+            raise
+        return logits, tables, owned, shared
+
+    # -- decode --------------------------------------------------------------
+
+    def _decode(self, run_params, ids, pad, first, tables, decode_key,
+                max_new_tokens, sampling, prompt_len, prefill_seconds,
+                eos_id) -> GenerateResult:
+        eng = self.engine
+        pad_j = jnp.asarray(pad) if pad.any() else None
+        t1 = time.perf_counter()
+        steps = max_new_tokens
+        parts = [np.asarray(first)[:, None]]
+        token = first
+        segs = eng._segments(prompt_len, steps)
+        done = None
+        if eos_id is not None:
+            segs = _eos_capped_segments(segs)
+            done = parts[0][:, 0] == eos_id
+        depth = prompt_len
+        if steps > 1 and not (done is not None and done.all()):
+            step_keys = _step_keys(decode_key, steps - 1)
+            used = 0
+            for n, window in segs:
+                working = self.pool.gather(tables, depth)
+                out, working = eng._decode_seg(
+                    run_params, token, working, pad_j,
+                    step_keys[used:used + n], sampling=sampling,
+                    window=window)
+                self.pool.scatter(working, tables)
+                token = out[:, -1]
+                parts.append(np.asarray(out))
+                depth += n
+                used += n
+                if done is not None:
+                    done |= (parts[-1] == eos_id).any(axis=1)
+                    if done.all():
+                        break
+        new = np.concatenate(parts, axis=1)
+        t2 = time.perf_counter()
+        tracing.record("decode", t1, t2, batch=new.shape[0],
+                       steps=new.shape[1], paged=True,
+                       blocks_held=int(
+                           (tables != self.pool.trash).sum()))
+        eng._note_compiles()
+        self.pool.note_compiles()
+        tokens = np.concatenate([ids, new], axis=1)
+        return GenerateResult(tokens=tokens, prompt_len=prompt_len,
+                              prefill_seconds=prefill_seconds,
+                              decode_seconds=t2 - t1,
+                              new_tokens=new.shape[1],
+                              decode_steps=new.shape[1] - 1,
+                              pad=pad if pad.any() else None)

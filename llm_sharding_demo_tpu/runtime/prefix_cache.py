@@ -74,12 +74,29 @@ class PrefixCachingEngine:
     """
 
     def __init__(self, engine: DecodeEngine, capacity: int = 4,
-                 chunk: int = 64, spec=None):
+                 chunk: int = 64, spec=None, pool=None):
         """``spec`` (optional ``SpecDecodeEngine`` wrapping THIS
         ``engine``) composes speculation with prefix reuse: the prefix
         path builds the cache, the verify loop decodes off it. Requests
         speculation can't serve (short prompts, no draft headroom) fall
-        back to the plain decode scan."""
+        back to the plain decode scan.
+
+        ``pool`` (optional ``runtime.kv_pool.KVBlockPool`` matching THIS
+        engine's cache geometry) re-homes the store into the shared
+        block pool: entries hold ref-counted BLOCK IDS instead of full
+        ``[L, 1, H, max_seq, hd]`` buffer copies, so (a) an entry costs
+        ``ceil(depth / block_size)`` blocks, not a whole ``max_seq``
+        allocation, (b) entries that extend each other SHARE their
+        common chunks' physical blocks structurally (the entry for
+        chunks [0, m) and the deeper [0, m+k) entry reference the same
+        blocks — the old store stored both in full), (c) eviction is
+        the allocator's LRU over zero-ref prefix blocks (pool pressure
+        evicts cold entries even below ``capacity``), and (d) live
+        paged decode rows can reference entry blocks directly
+        (``prefill_shared`` — zero-copy reuse, the partially-filled
+        frontier block CoW'd by the consumer). Byte-exactness is
+        unchanged: a hit gathers the entry into a fresh contiguous
+        buffer and replays the same extend programs."""
         from ..models import is_window_independent
         if not is_window_independent(engine.config):
             # same routing-semantics gate as speculation and chunked
@@ -98,8 +115,14 @@ class PrefixCachingEngine:
         if spec is not None and spec.plain is not engine:
             raise ValueError("spec must wrap the same DecodeEngine (shared "
                              "weights/programs), got a different instance")
+        if pool is not None and pool.max_seq != engine._cache_seq:
+            raise ValueError(
+                f"pool rows span {pool.max_seq} slots, engine cache is "
+                f"{engine._cache_seq}; gathered entries must match the "
+                "extend programs' cache width")
         self._eng = engine
         self._spec = spec
+        self._pool = pool
         self.capacity = capacity
         self.chunk = chunk
         self._store: "OrderedDict[Tuple[int, ...], object]" = OrderedDict()
@@ -141,8 +164,18 @@ class PrefixCachingEngine:
             prompt[:m_chunks * chunk], dtype=np.int32).tobytes()
 
     def _lookup(self, prompt: np.ndarray) -> Tuple[int, Optional[object]]:
-        """Longest cached prefix of ``prompt`` -> (n_chunks_hit, entry)."""
+        """Longest cached prefix of ``prompt`` -> (n_chunks_hit, entry).
+        Non-pool entries are stored cache pytrees; pool entries are
+        block-id tuples with one caller ref added per block (release
+        with ``allocator.free``)."""
         m_max = (len(prompt) - 1) // self.chunk  # leave >=1 token to forward
+        if self._pool is not None:
+            for m in range(m_max, 0, -1):
+                ids = self._pool.allocator.lookup_prefix(
+                    self._key(prompt, m, self.chunk))
+                if ids is not None:
+                    return m, ids
+            return 0, None
         with self._store_lock:
             for m in range(m_max, 0, -1):
                 key = self._key(prompt, m, self.chunk)
@@ -151,6 +184,50 @@ class PrefixCachingEngine:
                     self._store.move_to_end(key)
                     return m, entry
         return 0, None
+
+    def _gather_entry(self, ids, depth: int):
+        """Pool mode: assemble an entry's blocks into a fresh
+        contiguous full-width cache (trash-padded past the entry, where
+        every slot is masked anyway) — byte-equal to the stored state,
+        and safely donatable by the extend/decode programs."""
+        import numpy as _np
+        table = _np.full((1, self._pool.nbm), self._pool.trash,
+                         dtype=_np.int32)
+        table[0, :len(ids)] = ids
+        return self._pool.gather(table, depth)
+
+    def _insert_pool(self, prompt: np.ndarray, m_total: int, cache,
+                     hit_ids, m_hit: int) -> None:
+        """Pool-mode insert: the new entry SHARES the hit entry's full
+        blocks and allocates fresh ones only for the new chunks (the
+        frontier region is re-scattered from the walk cache into a
+        fresh block — registry blocks stay immutable). A full pool
+        skips the insert instead of failing the request."""
+        from .kv_pool import PoolExhausted
+        alloc = self._pool.allocator
+        key = self._key(prompt, m_total, self.chunk)
+        if alloc.has_prefix(key):
+            return
+        bs = self._pool.block_size
+        nb_new = alloc.blocks_for(m_total * self.chunk)
+        n_share = (m_hit * self.chunk) // bs if hit_ids else 0
+        share = list(hit_ids[:n_share]) if hit_ids else []
+        try:
+            fresh = alloc.alloc(nb_new - n_share)
+        except PoolExhausted:
+            return
+        try:
+            table = np.full((1, self._pool.nbm), self._pool.trash,
+                            dtype=np.int32)
+            table[0, :n_share] = share
+            table[0, n_share:nb_new] = fresh
+            self._pool.scatter_columns(cache, table, n_share)
+            alloc.register_prefix(key, share + fresh)
+        finally:
+            alloc.free(fresh)  # entry refs (if registered) keep them;
+            # on a scatter/register failure this is the leak guard
+        while alloc.prefix_len() > self.capacity:
+            alloc.evict_lru()
 
     def _insert(self, prompt: np.ndarray, m_chunks: int, cache) -> None:
         """Store a COPY of ``cache`` as the state after ``m_chunks`` full
@@ -176,6 +253,7 @@ class PrefixCachingEngine:
         decode may donate it."""
         run_params = self._eng._run_params()
         m_hit, entry = self._lookup(prompt)
+        hit_ids = None
         if entry is not None:
             with self._store_lock:
                 self.hits += 1
@@ -186,7 +264,16 @@ class PrefixCachingEngine:
             # flight-recorder timeline shows hit depth, not just speed
             tracing.annotate_span(prefix_hit=True,
                                   reused_tokens=m_hit * self.chunk)
-            cache = entry
+            if self._pool is not None:
+                hit_ids = entry                 # ref'd block ids
+                try:
+                    cache = self._gather_entry(hit_ids,
+                                               m_hit * self.chunk)
+                except BaseException:
+                    self._pool.allocator.free(hit_ids)
+                    raise
+            else:
+                cache = entry
         else:
             with self._store_lock:
                 self.misses += 1
@@ -197,9 +284,11 @@ class PrefixCachingEngine:
         # extend chunk by chunk (one shared program), snapshotting the
         # deepest full-chunk state for the store before the ragged
         # tail consumes the buffers. The first step off a stored
-        # entry must not donate it (see _extend_keep).
+        # entry must not donate it (see _extend_keep) — unless the
+        # entry came from the pool, where the gather already produced
+        # a fresh buffer.
         m_total = (prompt_len - 1) // self.chunk
-        from_store = entry is not None
+        from_store = entry is not None and self._pool is None
 
         def step(cache, ids):
             nonlocal from_store
@@ -207,13 +296,24 @@ class PrefixCachingEngine:
             from_store = False
             return fn(run_params, cache, ids)
 
-        logits = None
-        for m in range(m_hit, m_total):
-            piece = jnp.asarray(
-                prompt[None, m * self.chunk:(m + 1) * self.chunk])
-            logits, cache = step(cache, piece)
-        if m_total > m_hit:
-            self._insert(prompt, m_total, cache)
+        try:
+            logits = None
+            for m in range(m_hit, m_total):
+                piece = jnp.asarray(
+                    prompt[None, m * self.chunk:(m + 1) * self.chunk])
+                logits, cache = step(cache, piece)
+            if m_total > m_hit:
+                if self._pool is not None:
+                    self._insert_pool(prompt, m_total, cache, hit_ids,
+                                      m_hit)
+                else:
+                    self._insert(prompt, m_total, cache)
+        finally:
+            # the caller refs taken by the pool lookup must not outlive
+            # the walk even when an extend step raises — a phantom ref
+            # would pin the entry's blocks past its own eviction
+            if hit_ids is not None:
+                self._pool.allocator.free(hit_ids)
         tail = jnp.asarray(prompt[None, m_total * self.chunk:])
         logits, cache = step(cache, tail)
         return logits, cache
@@ -229,6 +329,26 @@ class PrefixCachingEngine:
                               prompt_len=len(prompt)):
                 logits, cache = self._prefill_walk(prompt, len(prompt))
         return logits[:, -1], cache, len(prompt)
+
+    def prefill_shared(self, prompt: np.ndarray):
+        """Paged-runner entry (pool mode only): walk the store, then
+        return ``(last_logits [1, V], cache, shared_ids, hit_depth)``
+        where ``shared_ids`` are the block ids of the DEEPEST entry now
+        covering the prompt (including one the walk just inserted),
+        with one caller ref per block — the runner references them in
+        its own table instead of duplicating the prefill state, and
+        releases them at retirement."""
+        if self._pool is None:
+            raise ValueError("prefill_shared requires a pool-backed "
+                             "store (pass pool= at construction)")
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        with self._lock:
+            with tracing.span("prefill", prefix=True,
+                              prompt_len=len(prompt)):
+                logits, cache = self._prefill_walk(prompt, len(prompt))
+            m, ids = self._lookup(prompt)
+        return (logits[:, -1], cache, list(ids or ()),
+                m * self.chunk)
 
     def generate(self, prompt_ids, max_new_tokens: int,
                  sampling: SamplingConfig = SamplingConfig(),
@@ -274,6 +394,11 @@ class PrefixCachingEngine:
 
     def stats(self) -> dict:
         with self._store_lock:
-            return {"entries": len(self._store), "hits": self.hits,
-                    "misses": self.misses, "capacity": self.capacity,
-                    "chunk": self.chunk}
+            entries = (self._pool.allocator.prefix_len()
+                       if self._pool is not None else len(self._store))
+            out = {"entries": entries, "hits": self.hits,
+                   "misses": self.misses, "capacity": self.capacity,
+                   "chunk": self.chunk}
+            if self._pool is not None:
+                out["pooled"] = True
+            return out

@@ -87,6 +87,12 @@ from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
 # certifier; an undeclared site is a lint finding.
 JIT_ENTRY_POINTS = ("_loop", "_loop_b", "_seg_b")
 
+# Block-handoff contract for pool-backed schedulers (see
+# ``_seg_b_impl``): True means a spec segment may rewrite ANY slot of a
+# row's cache (the re-sync roll), so paged storage must scatter whole
+# rows back, never just the newly decoded columns.
+SEG_REWRITES_FULL_CACHE = True
+
 
 class SpecDecodeEngine:
     """Speculative decode engine (single stream; greedy + sample modes).
@@ -475,7 +481,17 @@ class SpecDecodeEngine:
         cache, pad, emitted [B], steps, keys)`` — the same carry it
         takes, so segments resume exactly where the last one stopped
         (per-row key chains included: a row's verify sequence across
-        segments is identical to its uninterrupted solo run)."""
+        segments is identical to its uninterrupted solo run).
+
+        Paged-KV block handoff contract (runtime.kv_pool x
+        runtime.iterbatch): the per-row rewind/re-sync inside
+        ``_step_b`` ROLLS entire cache rows (``_roll_cache_rows`` — a
+        permutation of every slot, not an append at the frontier), so a
+        pool-backed scheduler must scatter the FULL row back into its
+        blocks after each spec segment; a new-columns-only handoff
+        would silently keep pre-roll bytes for the untouched blocks.
+        ``SEG_REWRITES_FULL_CACHE`` declares this; iterbatch asserts it
+        before choosing its scatter range."""
         b = buf.shape[0]
         carry = (buf, total, cache, pad,
                  jnp.zeros((b,), jnp.int32), jnp.int32(0), keys)

@@ -200,6 +200,36 @@ def create_app(cfg: Optional[ServingConfig] = None,
             raise ValueError(
                 f"EP_DECODE: n_experts={config.n_experts} not divisible "
                 f"by the {ep_size}-device ep axis")
+    if cfg.kv_pool_blocks > 0:
+        if not (cfg.shard_role == "coordinator" and cfg.dispatch == "local"):
+            raise ValueError("KV_POOL_BLOCKS applies to the coordinator's "
+                             "local decode path only")
+        if cfg.pp_decode or cfg.ep_decode or cfg.tp_decode:
+            raise ValueError(
+                "KV_POOL_BLOCKS drives the single-device engine's paged "
+                "storage; PP/EP/TP_DECODE keep contiguous caches")
+        if cfg.prefill_chunk > 0:
+            raise ValueError(
+                "KV_POOL_BLOCKS prefills monolithically (one block "
+                "scatter per admission); PREFILL_CHUNK owns another "
+                "prefill program structure")
+        if cfg.spec_decode > 0 and cfg.batch_mode != "iter":
+            raise ValueError(
+                "KV_POOL_BLOCKS composes with SPEC_DECODE through "
+                "BATCH_MODE=iter (paged draft-verify segments); the "
+                "solo paged runner decodes one token per forward")
+        if cfg.max_batch > 1 and cfg.batch_mode != "iter":
+            raise ValueError(
+                "KV_POOL_BLOCKS batches through BATCH_MODE=iter "
+                "(watermark admission + preemption live at segment "
+                "boundaries); the admission batcher keeps contiguous "
+                "round caches")
+        from ..models import is_window_independent as _wi
+        if not _wi(config):
+            raise ValueError(
+                "KV_POOL_BLOCKS requires window-independent routing "
+                f"(dense families); {type(config).__name__} serves "
+                "unpaged")
     if cfg.batch_mode == "iter":
         if cfg.max_batch <= 1:
             raise ValueError("BATCH_MODE=iter requires MAX_BATCH > 1 "
@@ -274,6 +304,7 @@ def create_app(cfg: Optional[ServingConfig] = None,
                 f"stage); this pod sees {len(jax.devices())}")
     runner = None
     spec_runner = None
+    kv_pool = None
     # What /healthz reports as n_stages: the decode topology actually
     # serving /generate, not just the configured partition — a monitoring
     # read of "3 stages" while an unstaged engine answers requests is the
@@ -344,7 +375,7 @@ def create_app(cfg: Optional[ServingConfig] = None,
                                   mesh=mesh)
             decode_stages = 1  # unstaged (tensor axis, not stage axis)
         elif (cfg.max_batch > 1 or cfg.inference_dtype == "int8" or pchunk
-              or cfg.prefix_cache > 0):
+              or cfg.prefix_cache > 0 or cfg.kv_pool_blocks > 0):
             # Continuous batching multiplexes concurrent requests onto
             # shared ragged batched decodes (runtime.batcher), riding the
             # staged DecodeEngine (single program per phase, ragged +
@@ -354,21 +385,42 @@ def create_app(cfg: Optional[ServingConfig] = None,
             # chunks its prefill, nor holds reusable KV state).
             # The PipelineRunner stays the plain single-stream path.
             from ..runtime.engine import DecodeEngine
-            runner = DecodeEngine(params, config, max_seq=cfg.max_seq,
-                                  boundaries=list(cfg.boundaries),
-                                  dtype=dtype, prefill_chunk=pchunk)
+            if cfg.kv_pool_blocks > 0:
+                # paged KV storage gathers/scatters whole-model cache
+                # rows, so the engine runs unstaged (per-stage cache
+                # lists page in a later PR)
+                runner = DecodeEngine(params, config, max_seq=cfg.max_seq,
+                                      dtype=dtype)
+                decode_stages = 1
+            else:
+                runner = DecodeEngine(params, config, max_seq=cfg.max_seq,
+                                      boundaries=list(cfg.boundaries),
+                                      dtype=dtype, prefill_chunk=pchunk)
         else:
             runner = PipelineRunner(params, config, list(cfg.boundaries),
                                     max_seq=cfg.max_seq, dtype=dtype)
+        if cfg.kv_pool_blocks > 0:
+            # the paged KV block pool (runtime.kv_pool): one ref-counted
+            # block store shared by the prefix store and whichever
+            # decode front end serves /generate
+            from ..runtime.kv_pool import KVBlockPool
+            kv_pool = KVBlockPool.for_engine(
+                spec_runner.plain if spec_runner is not None else runner,
+                num_blocks=cfg.kv_pool_blocks,
+                block_size=cfg.kv_block_size)
         prefix_runner = None
         if cfg.prefix_cache > 0:
             # cross-request KV reuse (runtime.prefix_cache): wraps the
             # plain single-stream engine built above; with SPEC_DECODE
-            # also on, the verify loop decodes off the prefix-built cache
+            # also on, the verify loop decodes off the prefix-built
+            # cache. With a KV pool, store entries hold ref-counted
+            # block ids (structural sharing + LRU under pool pressure)
+            # instead of full cache copies.
             from ..runtime.prefix_cache import PrefixCachingEngine
             prefix_runner = PrefixCachingEngine(
                 runner, capacity=cfg.prefix_cache,
-                chunk=cfg.prefill_chunk or 64, spec=spec_runner)
+                chunk=cfg.prefill_chunk or 64, spec=spec_runner,
+                pool=kv_pool)
             runner = prefix_runner
         if cfg.max_batch > 1:
             base = (prefix_runner.plain if prefix_runner is not None
@@ -379,19 +431,30 @@ def create_app(cfg: Optional[ServingConfig] = None,
                 # their slot (runtime.iterbatch; exclusions validated
                 # above, so ``base`` here is always a DecodeEngine).
                 # SPEC_DECODE batches advance by draft-verify segments;
-                # PREFIX_CACHE backs admission prefills with the store.
+                # PREFIX_CACHE backs admission prefills with the store;
+                # KV_POOL_BLOCKS pages row state with watermark
+                # admission and preemption/resume.
                 from ..runtime.iterbatch import IterBatchingEngine
                 runner = IterBatchingEngine(base,
                                             max_batch=cfg.max_batch,
                                             max_wait_ms=cfg.batch_wait_ms,
                                             spec=spec_runner,
-                                            prefix=prefix_runner)
+                                            prefix=prefix_runner,
+                                            pool=kv_pool)
             else:
                 from ..runtime.batcher import BatchingEngine
                 runner = BatchingEngine(base, max_batch=cfg.max_batch,
                                         max_wait_ms=cfg.batch_wait_ms,
                                         prefix=prefix_runner,
                                         spec=spec_runner)
+        elif kv_pool is not None:
+            # solo paged decode: the engine's own programs on
+            # pool-backed storage; a prefix hit REFERENCES store blocks
+            # instead of copying the prefill state
+            from ..runtime.kv_pool import PagedKVRunner
+            runner = PagedKVRunner(
+                prefix_runner.plain if prefix_runner is not None
+                else runner, kv_pool, prefix=prefix_runner)
     if not partitionable:
         compat_specs = compat_params = None
     else:
@@ -429,6 +492,8 @@ def create_app(cfg: Optional[ServingConfig] = None,
             "pp_decode": cfg.pp_decode,
             "ep_decode": cfg.ep_decode,
             "tp_decode": cfg.tp_decode,
+            "kv_pool_blocks": cfg.kv_pool_blocks,
+            "kv_block_size": cfg.kv_block_size,
         }
 
     @app.get("/healthz")
@@ -452,6 +517,8 @@ def create_app(cfg: Optional[ServingConfig] = None,
                 live["prefix_cache_stats"] = prefix_src.stats()
         if spec_runner is not None:  # speculation: live acceptance stats
             live["spec_decode_stats"] = spec_runner.stats()
+        if kv_pool is not None:  # paged KV memory: allocator truth
+            live["kv_pool_stats"] = kv_pool.stats()
         return {
             **live,
             "status": "ok",
@@ -538,8 +605,9 @@ def create_app(cfg: Optional[ServingConfig] = None,
             sampling = _dc.replace(sampling, spec=True)
         elif eligible and cfg.prefix_cache == 0:
             eng = spec_runner
+        from ..runtime.kv_pool import PagedKVRunner as _PR
         kw = {}
-        if eos_id is not None and isinstance(eng, (_DE, _IB)):
+        if eos_id is not None and isinstance(eng, (_DE, _IB, _PR)):
             # segment-boundary early exit: stop_at_eos requests stop
             # paying device time for dead tokens past the stop (tokens
             # emitted are the exact prefix of the uncapped stream; the
@@ -680,6 +748,32 @@ def create_app(cfg: Optional[ServingConfig] = None,
             if not 0 <= eos_id < config.vocab_size:
                 return out(
                     {"error": f"eos_token_id {eos_id} out of vocab range"})
+        if kv_pool is not None and cfg.dispatch == "local":
+            # Admission control (runtime.kv_pool): a request the KV
+            # pool cannot host — with the waiting line already at its
+            # limit — is SHED with 429 + Retry-After instead of queued
+            # unboundedly (the pre-pool behavior let the queue grow
+            # without bound under sustained overload, trading it for
+            # timeout storms). The iter scheduler owns the policy;
+            # the solo paged runner rejects only what the pool could
+            # never host right now.
+            from ..runtime.iterbatch import IterBatchingEngine as _IB2
+            if isinstance(runner, _IB2):
+                ok, retry = runner.admission_load(len(prompt_ids),
+                                                  req.max_new_tokens)
+            else:
+                need = kv_pool.allocator.blocks_for(
+                    len(prompt_ids) + req.max_new_tokens)
+                ok, retry = kv_pool.allocator.available() >= need, 1.0
+            if not ok:
+                reg.inc("kv_pool_admission_rejections_total")
+                hdrs["Retry-After"] = str(max(1, int(round(retry))))
+                trace.labels.update(error="kv_pool_saturated")
+                rec.record(trace)
+                return out({"error": "kv_pool_saturated",
+                            "detail": "KV memory pool cannot admit this "
+                                      "request; retry after the "
+                                      "indicated backoff"}, status=429)
         # The ambient trace rides the generation: solo runners record
         # prefill/decode spans directly; the batch schedulers capture it
         # onto their queue entry and stamp queue wait + shared phases

@@ -91,6 +91,17 @@ class ServingConfig:
     # GSPMD-derived per-block all-reduces. Requires the device count to
     # divide n_head (and n_kv_head). fp32/bf16 only. Off by default.
     tp_decode: bool = False
+    # Paged KV-cache memory pool (runtime.kv_pool): >0 allocates this
+    # many KV blocks and serves /generate off block tables instead of
+    # per-row contiguous caches — ref-counted prefix sharing, LRU
+    # eviction, and (BATCH_MODE=iter) watermark admission with
+    # preemption/resume; sustained exhaustion answers 429 +
+    # Retry-After instead of queueing unboundedly. 0 = off (the
+    # contiguous allocator). Size it to HBM: one block is
+    # n_layer * 2 * n_kv_head * KV_BLOCK_SIZE * head_dim * dtype bytes.
+    kv_pool_blocks: int = 0
+    # Cache slots per pool block; MAX_SEQ must be a multiple of it.
+    kv_block_size: int = 16
 
     def __post_init__(self):
         if self.shard_role not in VALID_ROLES:
@@ -131,6 +142,19 @@ class ServingConfig:
             raise ValueError(
                 f"PREFIX_CACHE={self.prefix_cache} must be >= 0 "
                 "(0 disables, >0 is the resident-entry capacity)")
+        if self.kv_pool_blocks < 0:
+            raise ValueError(
+                f"KV_POOL_BLOCKS={self.kv_pool_blocks} must be >= 0 "
+                "(0 disables paging, >0 is the pool's block count)")
+        if self.kv_block_size < 1:
+            raise ValueError(
+                f"KV_BLOCK_SIZE={self.kv_block_size} must be >= 1")
+        if self.kv_pool_blocks > 0 and self.max_seq % self.kv_block_size:
+            raise ValueError(
+                f"MAX_SEQ={self.max_seq} must be a multiple of "
+                f"KV_BLOCK_SIZE={self.kv_block_size}: the paged decode "
+                "path gathers whole-block rows at exactly the compiled "
+                "programs' cache width")
 
     @property
     def split_at(self) -> int:
@@ -211,4 +235,6 @@ def from_env() -> ServingConfig:
         ep_decode=_env_bool("EP_DECODE"),
         tp_decode=_env_bool("TP_DECODE"),
         batch_mode=os.environ.get("BATCH_MODE", "admission"),
+        kv_pool_blocks=_env_int("KV_POOL_BLOCKS", 0),
+        kv_block_size=_env_int("KV_BLOCK_SIZE", 16),
     )

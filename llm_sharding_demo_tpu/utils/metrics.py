@@ -67,18 +67,62 @@ METRIC_CATALOG: Dict[str, str] = {
     # compile storm is visible as a burst here, distinguishable from
     # steady-state latency
     "compile_events_total": "counter",
+    # paged KV pool (runtime/kv_pool.py)
+    "kv_pool_evictions_total": "counter",   # LRU prefix-entry evictions
+    "kv_pool_cow_copies_total": "counter",  # copy-on-write block copies
+    "kv_pool_preemptions_total": "counter",  # rows parked under pressure
+    "kv_pool_resumes_total": "counter",     # parked rows recomputed back in
+    # serving admission control: /generate requests turned away with
+    # 429 + Retry-After because the KV pool could not host them
+    "kv_pool_admission_rejections_total": "counter",
     # live-state gauges
     "queue_depth": "gauge",                 # waiting requests per scheduler
     "batch_occupancy": "gauge",             # live rows / compiled width
     "iter_live_rows": "gauge",              # live iterbatch rows
-    # KV-cache slots holding live request state, labeled by the writer
-    # (component="engine": the in-flight solo generate's reservation,
-    # back to 0 when it finishes; component="iter": depth x live rows of
-    # the running batch) — distinct series, never mixed semantics
-    "kv_cache_slots_in_use": "gauge",
+    # KV memory in BLOCK denomination, labeled by the writer component
+    # (component="pool"/"paged"/"iter": exact allocator numbers;
+    # component="engine"/"batcher": the contiguous arena expressed in
+    # equivalent blocks via kv_block_gauges) — one unit across the
+    # whole serving surface, so "how full is KV memory" is one query.
+    # Replaces the retired per-component kv_cache_slots_in_use series
+    # (see RETIRED_METRICS).
+    "kv_cache_blocks_in_use": "gauge",
+    "kv_cache_blocks_total": "gauge",
     "jit_program_cache_size": "gauge",      # compiled programs per component
     "spec_acceptance_rate": "gauge",        # emitted tokens per verify
 }
+
+# Metric names that USED to exist and were replaced: a call site (or a
+# catalog entry) reviving one of these fails the graftcheck
+# metric-catalog rule with the replacement spelled out — dashboards
+# migrated once and must not silently fork back to the dead series.
+RETIRED_METRICS: Dict[str, str] = {
+    "kv_cache_slots_in_use":
+        "kv_cache_blocks_in_use / kv_cache_blocks_total (block "
+        "denomination, same component labels)",
+}
+
+# Block width used to express contiguous (non-pooled) KV arenas in the
+# pool's block denomination — and runtime.kv_pool's default physical
+# block size, so the two denominations agree by default.
+DEFAULT_KV_BLOCK_SIZE = 16
+
+
+def kv_block_gauges(component: str, used_slots: int, total_slots: int,
+                    block_size: int = DEFAULT_KV_BLOCK_SIZE,
+                    registry: "MetricsRegistry" = None) -> None:
+    """Set the ``kv_cache_blocks_*`` gauge pair for a component that
+    manages contiguous slot arenas (solo engine, admission batcher,
+    non-pooled iterbatch): slots are converted to equivalent blocks
+    (ceil). Pool-backed components bypass this and publish the
+    allocator's exact numbers (``KVBlockPool.note_gauges``)."""
+    reg = registry or REGISTRY
+    reg.gauge("kv_cache_blocks_in_use",
+              -(-int(used_slots) // block_size) if used_slots > 0 else 0,
+              component=component)
+    reg.gauge("kv_cache_blocks_total",
+              -(-int(total_slots) // block_size) if total_slots > 0 else 0,
+              component=component)
 
 
 class MetricsRegistry:

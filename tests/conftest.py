@@ -65,6 +65,9 @@ _QUICK_MODULES = {
     "test_iterbatch",       # continuous batching + spec/prefix segments
     "test_spec_decode",     # speculation: solo + batched verify loops
     "test_prefix_cache",    # cross-request KV reuse byte-exactness
+    "test_kv_pool",         # paged KV pool: paged ≡ contiguous, CoW,
+                            # preempt/resume recompute exactness
+    "test_paged_attention", # block gather/scatter + paged attention ops
     "test_chunked_prefill", # chunked ≡ monolithic prefill
     "test_subproc",         # watchdog attribution (bench/CI harness)
     "test_tokenizer",       # offline BPE round-trips

@@ -388,7 +388,12 @@ def test_spec_compile_events_and_acceptance_gauge(model):
 def test_metric_catalog_covers_runtime_names():
     """Spot-check the catalog knows the series this PR's tests assert."""
     for name in ("ttft_seconds", "tpot_seconds", "compile_events_total",
-                 "queue_depth", "iter_live_rows", "kv_cache_slots_in_use",
+                 "queue_depth", "iter_live_rows", "kv_cache_blocks_in_use",
+                 "kv_cache_blocks_total", "kv_pool_preemptions_total",
                  "jit_program_cache_size", "spec_acceptance_rate",
                  "batch_occupancy"):
         assert name in METRIC_CATALOG, name
+    # the slot-denominated series is retired, not silently forked back
+    from llm_sharding_demo_tpu.utils.metrics import RETIRED_METRICS
+    assert "kv_cache_slots_in_use" not in METRIC_CATALOG
+    assert "kv_cache_slots_in_use" in RETIRED_METRICS

@@ -51,6 +51,10 @@ def run(root: str = None, lint_only: bool = False,
                         semantic_checks += 1
                 bounds[label] = recompile.certify(desc, calls)
                 semantic_checks += len(calls)
+            for label, desc, paged, pcalls in registry.paged_workloads():
+                bounds[label] = recompile.certify_paged(desc, paged,
+                                                        pcalls)
+                semantic_checks += len(pcalls)
     finally:
         if added:
             try:

@@ -49,13 +49,19 @@ def _iter_sources(root: str) -> List[str]:
     return sorted(out)
 
 
-def find_violations(paths: List[str],
-                    catalog=None) -> List[Tuple[str, int, str, str]]:
+def find_violations(paths: List[str], catalog=None,
+                    retired=None) -> List[Tuple[str, int, str, str]]:
     """(path, line_no, name, problem) for every call-site metric name
-    missing from the catalog or used with the wrong instrument kind."""
+    missing from the catalog, used with the wrong instrument kind, or
+    REVIVING a retired name (``utils.metrics.RETIRED_METRICS``): a
+    replaced series must not silently fork back — dashboards migrated
+    once, and a revived name would read as a fresh, unwatched series."""
     if catalog is None:
         from llm_sharding_demo_tpu.utils.metrics import METRIC_CATALOG
         catalog = METRIC_CATALOG
+    if retired is None:
+        from llm_sharding_demo_tpu.utils.metrics import RETIRED_METRICS
+        retired = RETIRED_METRICS
     bad = []
     for path in paths:
         with open(path, encoding="utf-8") as f:
@@ -71,7 +77,10 @@ def find_violations(paths: List[str],
         for m in _CALL_RE.finditer(text):
             call, name = m.group(1), m.group(2)
             want = catalog.get(name)
-            if want is None:
+            if name in retired:
+                bad.append((path, lineno(m.start()), name,
+                            f"retired metric; use {retired[name]}"))
+            elif want is None:
                 bad.append((path, lineno(m.start()), name,
                             "not in METRIC_CATALOG"))
             elif want != _KIND_OF_CALL[call]:
@@ -81,7 +90,10 @@ def find_violations(paths: List[str],
         for m in _TIMED_RE.finditer(text):
             name = m.group(1)
             want = catalog.get(name)
-            if want is None:
+            if name in retired:
+                bad.append((path, lineno(m.start()), name,
+                            f"retired metric; use {retired[name]}"))
+            elif want is None:
                 bad.append((path, lineno(m.start()), name,
                             "not in METRIC_CATALOG"))
             elif want != "histogram":
@@ -102,6 +114,18 @@ def as_findings(root: str, catalog=None) -> list:
         out.append(Finding(rule="metric-catalog", path=rel, line=line,
                            scope="<module>",
                            message=f"metric {name!r}: {problem}"))
+    # a retired name re-added to the catalog is a config error in its
+    # own right, reported against the catalog module itself
+    from llm_sharding_demo_tpu.utils.metrics import (METRIC_CATALOG,
+                                                     RETIRED_METRICS)
+    cat = METRIC_CATALOG if catalog is None else catalog
+    for name in sorted(set(cat) & set(RETIRED_METRICS)):
+        out.append(Finding(
+            rule="metric-catalog",
+            path="llm_sharding_demo_tpu/utils/metrics.py", line=1,
+            scope="<module>",
+            message=f"metric {name!r}: retired name re-added to "
+                    f"METRIC_CATALOG; use {RETIRED_METRICS[name]}"))
     return out
 
 

@@ -60,6 +60,19 @@ class SpecDesc:
 
 
 @dataclasses.dataclass(frozen=True)
+class PagedDesc:
+    """The KVBlockPool fields that shape its program space (the pool's
+    block COUNT never keys programs — tables are traced)."""
+
+    max_seq: int
+    block_size: int
+
+    @property
+    def nbm(self) -> int:
+        return self.max_seq // self.block_size
+
+
+@dataclasses.dataclass(frozen=True)
 class GenerateCall:
     """One ``generate()`` invocation, by shape."""
 
@@ -157,6 +170,42 @@ def spec_call_keys(desc: EngineDesc, spec: SpecDesc,
     else:
         keys["_loop_b"].add((b, call.max_new, norm))
     return keys
+
+
+def paged_runner_keys(desc: EngineDesc, paged: PagedDesc,
+                      call: GenerateCall) -> Dict[str, set]:
+    """Program keys one ``PagedKVRunner.generate`` call touches: the
+    engine's own prefill/decode keys (the paged path runs THE same
+    compiled programs on gathered views — that identity is the
+    byte-equality argument) plus the pool's data movers:
+
+    - ``_gather``/``_scatter``: one program per (batch, table width) —
+      tables and block ids are traced operands, so PLACEMENT never
+      keys anything;
+    - ``_scatter`` additionally mints one program per shared-prefix
+      column offset (the narrower owned-tail view after a store hit);
+      plain runs stay on the full-width key;
+    - ``_scatter_row``/``_copy``: admission/CoW movers — unused by a
+      plain generate (the iteration scheduler and prefix sharing mint
+      them), so their bound here is zero.
+    """
+    keys = engine_call_keys(desc, call)
+    b = len(call.prompt_lens)
+    keys["_gather"] = ({(b, paged.nbm)} if call.max_new > 1 else set())
+    keys["_scatter"] = {(b, paged.nbm)}
+    keys["_scatter_row"] = set()
+    keys["_copy"] = set()
+    return keys
+
+
+def certify_paged(desc: EngineDesc, paged: PagedDesc,
+                  calls: Sequence[GenerateCall]) -> Dict[str, int]:
+    """Distinct-program bound per entry point for a paged workload."""
+    pools: Dict[str, set] = {}
+    for call in calls:
+        for name, ks in paged_runner_keys(desc, paged, call).items():
+            pools.setdefault(name, set()).update(ks)
+    return {name: len(ks) for name, ks in pools.items()}
 
 
 def iter_spec_segment_keys(spec: SpecDesc, seg_steps: int,

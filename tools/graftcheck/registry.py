@@ -56,6 +56,21 @@ MESHES: Dict[str, Dict[str, int]] = {
 # stage-axis sizes the ppermute ring is verified over
 RING_SIZES: Tuple[int, ...] = (1, 2, 3, 4, 8)
 
+# Paged KV-pool geometries (runtime.kv_pool / ops.paged_attention) the
+# block-table contract family is verified over: (label, kwargs for
+# semantic.check_paged_contracts). Covers GQA (n_kv_head < n_head
+# analog: kv heads independent of table math), a non-power-of-two
+# block count, and batch widths 1/2/4 — every shape class the
+# gather/scatter/attend programs see in serving.
+PAGED_GEOMETRIES: Tuple[Tuple[str, dict], ...] = (
+    ("paged-tiny", dict(n_layer=2, num_blocks=8, n_kv_head=2,
+                        block_size=8, head_dim=4, max_seq=32,
+                        batches=(1, 2))),
+    ("paged-gqa", dict(n_layer=3, num_blocks=13, n_kv_head=1,
+                       block_size=16, head_dim=8, max_seq=64,
+                       batches=(1, 4))),
+)
+
 
 def serving_workloads() -> List[tuple]:
     """(label, EngineDesc kwargs, workload) rows the CLI certifies —
@@ -73,5 +88,24 @@ def serving_workloads() -> List[tuple]:
          [R.GenerateCall(prompt_lens=(40,), max_new=8, sampling=greedy)]),
         ("long-decode-windows", R.EngineDesc(max_seq=1024),
          [R.GenerateCall(prompt_lens=(16,), max_new=700,
+                         sampling=greedy)]),
+    ]
+
+
+def paged_workloads() -> List[tuple]:
+    """(label, EngineDesc kwargs, PagedDesc, workload) rows for the
+    paged-decode recompile bounds: the PagedKVRunner's program space is
+    the engine's own prefill/decode keys PLUS the pool's
+    gather/scatter keys (one per batch-width x table-width pair) —
+    certified equal to observed cache sizes in tests/test_kv_pool.py."""
+    from . import recompile as R
+    greedy = R.greedy_sampling()
+    return [
+        ("paged-solo", R.EngineDesc(max_seq=64),
+         R.PagedDesc(max_seq=64, block_size=8),
+         [R.GenerateCall(prompt_lens=(8,), max_new=12, sampling=greedy)]),
+        ("paged-batch2", R.EngineDesc(max_seq=64),
+         R.PagedDesc(max_seq=64, block_size=8),
+         [R.GenerateCall(prompt_lens=(8, 8), max_new=12,
                          sampling=greedy)]),
     ]

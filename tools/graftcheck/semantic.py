@@ -391,6 +391,103 @@ def check_ring_program(n_stages: int, where: str) -> List[Finding]:
     return findings
 
 
+# -- paged KV block-table contracts ------------------------------------------
+
+_KV_POOL_PATH = "llm_sharding_demo_tpu/runtime/kv_pool.py"
+_PAGED_OPS_PATH = "llm_sharding_demo_tpu/ops/paged_attention.py"
+
+
+def check_paged_contracts(n_layer: int, num_blocks: int, n_kv_head: int,
+                          block_size: int, head_dim: int, max_seq: int,
+                          batches: Sequence[int] = (1, 2),
+                          where: str = "") -> List[Finding]:
+    """The paged block-table contract family, by abstract eval (no
+    device, no compile):
+
+    - the pool aval is the declared ``pool_shape`` (per layer
+      ``[num_blocks, 2, n_kv_head, block_size, head_dim]`` + the trash
+      block);
+    - block tables are int32 and ``blocks_per_row * block_size ==
+      max_seq`` (the gathered view must equal the engine's compiled
+      cache width EXACTLY — any mismatch would silently mint new
+      decode programs per width);
+    - ``gather_kv`` emits the engine's contiguous cache aval and
+      ``scatter_kv(gather_kv(...))`` round-trips the pool aval;
+    - ``paged_decode_attention`` preserves the pool aval and emits the
+      attention output aval ``[B, H, 1, hd]``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from llm_sharding_demo_tpu.ops import paged_attention as PA
+    findings: List[Finding] = []
+    try:
+        nbm = PA.blocks_per_row(max_seq, block_size)
+    except ValueError as e:
+        return [Finding("paged-contract", _PAGED_OPS_PATH, 1, where,
+                        f"rejected geometry: {e}")]
+    pool_aval = jax.ShapeDtypeStruct(
+        PA.pool_shape(n_layer, num_blocks, n_kv_head, block_size,
+                      head_dim), jnp.float32)
+    if pool_aval.shape[1:] != (num_blocks + 1, 2, n_kv_head, block_size,
+                               head_dim):
+        findings.append(Finding(
+            "paged-contract", _PAGED_OPS_PATH, 1, where,
+            f"pool aval {pool_aval.shape} breaks the per-layer "
+            "[num_blocks+1, 2, n_kv_head, block_size, head_dim] "
+            "contract"))
+    for b in batches:
+        tab = jax.ShapeDtypeStruct((b, nbm), jnp.int32)
+        kv = jax.eval_shape(PA.gather_kv, pool_aval, tab)
+        want = (n_layer, b, n_kv_head, max_seq, head_dim)
+        for name, side in (("k", kv[0]), ("v", kv[1])):
+            if tuple(side.shape) != want:
+                findings.append(Finding(
+                    "paged-contract", _PAGED_OPS_PATH, 1, where,
+                    f"gather_kv {name} aval {tuple(side.shape)} != "
+                    f"engine cache aval {want} at B={b} — the paged "
+                    "path would not share the compiled decode "
+                    "programs"))
+        rt = jax.eval_shape(PA.scatter_kv, pool_aval, kv[0], kv[1], tab)
+        if (tuple(rt.shape) != tuple(pool_aval.shape)
+                or rt.dtype != pool_aval.dtype):
+            findings.append(Finding(
+                "paged-contract", _PAGED_OPS_PATH, 1, where,
+                f"scatter(gather(pool)) aval {tuple(rt.shape)}/"
+                f"{rt.dtype} does not round-trip the pool aval at "
+                f"B={b}"))
+        # float block tables must be REJECTED at trace time (a float
+        # table would silently truncate placement)
+        bad_tab = jax.ShapeDtypeStruct((b, nbm), jnp.float32)
+        try:
+            jax.eval_shape(PA.gather_kv, pool_aval, bad_tab)
+            findings.append(Finding(
+                "paged-contract", _PAGED_OPS_PATH, 1, where,
+                "gather_kv accepted a float block table — tables must "
+                "be int32"))
+        except Exception:  # noqa: BLE001 — the rejection IS the contract
+            pass
+        h = n_kv_head * 2  # a GQA-grouped query head count
+        q = jax.ShapeDtypeStruct((b, h, 1, head_dim), jnp.float32)
+        knew = jax.ShapeDtypeStruct((b, n_kv_head, 1, head_dim),
+                                    jnp.float32)
+        out, pool_out = jax.eval_shape(
+            lambda q, kn, vn, p, t: PA._paged_decode_attention_impl(
+                q, kn, vn, p, t, jnp.int32(0), jnp.int32(4)),
+            q, knew, knew, pool_aval, tab)
+        if tuple(out.shape) != (b, h, 1, head_dim):
+            findings.append(Finding(
+                "paged-contract", _PAGED_OPS_PATH, 1, where,
+                f"paged_decode_attention out aval {tuple(out.shape)} "
+                f"!= {(b, h, 1, head_dim)}"))
+        if tuple(pool_out.shape) != tuple(pool_aval.shape):
+            findings.append(Finding(
+                "paged-contract", _PAGED_OPS_PATH, 1, where,
+                "paged_decode_attention does not preserve the pool "
+                "aval"))
+    return findings
+
+
 # -- registry-driven pass ----------------------------------------------------
 
 
@@ -453,6 +550,11 @@ def run_semantic() -> Tuple[List[Finding], int]:
     # ppermute ring bijection per registered stage-axis size
     for n in registry.RING_SIZES:
         findings.extend(check_ring_program(n, f"ring/pp={n}"))
+        checks += 1
+
+    # paged KV block-table contracts per registered pool geometry
+    for label, kwargs in registry.PAGED_GEOMETRIES:
+        findings.extend(check_paged_contracts(where=label, **kwargs))
         checks += 1
 
     return findings, checks
