@@ -1273,7 +1273,44 @@ def main() -> None:
             "recompile_bounds": payload["recompile_bounds"],
         }
 
+    def cfg_graftplan():
+        """Chosen-plan journal row (ISSUE 6): the auto-sharding
+        planner's pick for the bench model on this host's devices rides
+        the perf matrix next to graftcheck_static_analysis, so a cost-
+        model change that flips the chosen serving config shows up in
+        the same trajectory as the timings it would cause. Compile-free
+        (abstract eval only), no tunnel dependency."""
+        import sys as _sys
+        here = os.path.dirname(os.path.abspath(__file__))
+        added = here not in _sys.path
+        if added:
+            _sys.path.insert(0, here)
+        try:
+            import jax as _jax
+
+            from tools.graftcheck import costmodel as _cm, registry as _reg
+            module, config = _reg.planner_families()["gpt2-tiny"]
+            payload = _cm.plan_for_serving(
+                config, len(_jax.devices()), max_seq=64,
+                traffic=_cm.parse_traffic("16/32x8"), max_batch_cap=8,
+                kv_pool_blocks=32)
+        finally:
+            if added:
+                try:
+                    _sys.path.remove(here)
+                except ValueError:
+                    pass
+        chosen = payload["chosen"]
+        return {
+            "devices": len(_jax.devices()),
+            "traffic": "16/32x8",
+            "chosen": chosen,
+            "candidates": len(payload["plan"]),
+            "rejected": payload["rejected"],
+        }
+
     safe("graftcheck_static_analysis", cfg_graftcheck)
+    safe("graftcheck_chosen_plan", cfg_graftplan)
     safe("cfg1_tiny_gpt2_2shard_20tok", cfg1)
 
     if args.quick:

@@ -71,6 +71,26 @@ class GPT2Config:
                 f"attention_impl={self.attention_impl!r} not xla|pallas|ring")
 
 
+# Static-analysis/planner contract (tools/graftcheck/costmodel): how this
+# family's stacked param tree shards, as architectural facts rather than
+# hand-written PartitionSpecs. ``column``/``row`` name the ops (kernel +
+# optional bias siblings) that are Megatron column-/row-parallel over a
+# ``tp`` axis; ``expert`` names ops stacked on an expert axis (dim 1 of
+# the block leaf, after the layer axis) shardable over ``ep``;
+# ``tp_divisors``/``ep_divisors`` name config fields the corresponding
+# mesh axis size must divide for the plan to be runnable (the engine's
+# own guards). ``costmodel.derive_pspecs`` turns this into the full
+# PartitionSpec tree — pinned equal to the hand-tuned ``spmd``
+# layouts by tests/test_graftplan.py.
+SHARDING_DESCRIPTOR = {
+    "column": ("blocks.attn.c_attn", "blocks.mlp.c_fc"),
+    "row": ("blocks.attn.c_proj", "blocks.mlp.c_proj"),
+    "expert": (),
+    "tp_divisors": ("n_head",),
+    "ep_divisors": (),
+}
+
+
 # Named configs for the BASELINE.json measurement matrix. "tiny-gpt2" matches
 # sshleifer/tiny-gpt2 (the reference's default MODEL_ID, server.py:20);
 # "gpt2" is GPT-2 124M; "gpt2-medium" the 355M config (4-stage target).

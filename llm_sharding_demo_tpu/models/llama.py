@@ -77,6 +77,24 @@ class LlamaConfig:
                 f"attention_impl={self.attention_impl!r} not xla|pallas|ring")
 
 
+# Static-analysis/planner contract (tools/graftcheck/costmodel): the
+# family's sharding facts — see ``models.gpt2.SHARDING_DESCRIPTOR`` for
+# the schema. The GQA head-ratio lives in ``tp_divisors``: a tensor axis
+# must divide BOTH head counts (attention shards whole q heads AND whole
+# kv heads; a tp that splits a kv group would replicate cache writes),
+# which is exactly the engine's own TP_DECODE guard. The derived
+# PartitionSpec tree is pinned equal to ``spmd.llama_param_pspecs`` by
+# tests/test_graftplan.py.
+SHARDING_DESCRIPTOR = {
+    "column": ("blocks.attn.wq", "blocks.attn.wk", "blocks.attn.wv",
+               "blocks.mlp.gate", "blocks.mlp.up"),
+    "row": ("blocks.attn.wo", "blocks.mlp.down"),
+    "expert": (),
+    "tp_divisors": ("n_head", "n_kv_head"),
+    "ep_divisors": (),
+}
+
+
 # "llama-124m" is the GPT-2-124M-comparable geometry used by the bench;
 # "llama-tiny" a test/smoke size. Both use GQA (n_kv_head < n_head) so the
 # family's distinguishing feature is always exercised.

@@ -56,6 +56,23 @@ class MoEConfig(GPT2Config):
                 "kernel is wired into the dense model path)")
 
 
+# Static-analysis/planner contract (tools/graftcheck/costmodel): the
+# family's sharding facts — see ``models.gpt2.SHARDING_DESCRIPTOR`` for
+# the schema. The expert-axis descriptor: expert-stacked ops shard dim 1
+# (the ``E`` axis after the layer axis) over ``ep``, composing with
+# Megatron column/row tp WITHIN each expert — the derived tree is pinned
+# equal to ``spmd.moe_param_pspecs`` by tests/test_graftplan.py.
+# ``ep_divisors``: the ep axis must divide ``n_experts`` (the serving
+# EP_DECODE guard).
+SHARDING_DESCRIPTOR = {
+    "column": ("blocks.attn.c_attn", "blocks.moe.experts.c_fc"),
+    "row": ("blocks.attn.c_proj", "blocks.moe.experts.c_proj"),
+    "expert": ("blocks.moe.experts.c_fc", "blocks.moe.experts.c_proj"),
+    "tp_divisors": ("n_head",),
+    "ep_divisors": ("n_experts",),
+}
+
+
 def expert_capacity(config: MoEConfig, seq_len: int) -> int:
     """Static per-expert slot count for one batch row."""
     cap = int(config.capacity_factor * config.expert_top_k * seq_len

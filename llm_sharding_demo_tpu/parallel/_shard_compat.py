@@ -1,0 +1,50 @@
+"""API-skew shim for ``shard_map``/``pcast`` across JAX versions.
+
+The ``parallel/`` sibling of ``ops/_pallas_compat.py``: the manual SPMD
+modules (``ppdecode``, ``gpipe``, ``pipeline_1f1b``,
+``ops.ring_attention``) were written against the current JAX spelling —
+``jax.shard_map(..., axis_names=...)`` plus ``jax.lax.pcast(x, axis,
+to="varying")`` for varying-type carry signatures. Older JAX (0.4.x)
+ships ``jax.experimental.shard_map.shard_map`` (axis names come from the
+mesh, no varying types, ``check_rep`` instead) and no ``pcast`` at all,
+so every manual pipeline program died at trace time with
+``AttributeError`` on those containers.
+
+Two shims, one semantic each:
+
+- ``shard_map(f, mesh, in_specs, out_specs, axis_names)``: the new
+  call shape, delegating to whichever implementation exists. The legacy
+  path disables ``check_rep`` — replication checking is the old type
+  system's stand-in for what varying types now track, and the manual
+  ring programs here legitimately mix invariant and varying values
+  (every replicated output is made so by an explicit ``psum``).
+- ``pcast_varying(x, axis)``: mark a value axis-varying where varying
+  types exist; identity where they don't (on legacy JAX every value is
+  untyped with respect to the axis, so the no-op is exact).
+
+Like the pallas shim, this keeps exactly one spelling at every call
+site and quarantines the version probe here.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, axis_names):
+    """``jax.shard_map`` with the current signature, on any JAX."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, axis_names=axis_names)
+    from jax.experimental.shard_map import shard_map as _legacy
+    return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+
+
+def pcast_varying(x, axis_name):
+    """``jax.lax.pcast(x, axis, to="varying")`` where varying types
+    exist; identity elsewhere (exact on legacy JAX — see module doc)."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, axis_name, to="varying")

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.gpt2 import GPT2Config, Params, apply_blocks
+from ._shard_compat import pcast_varying, shard_map
 
 
 def microbatch(h: jnp.ndarray, n_microbatches: int) -> jnp.ndarray:
@@ -136,8 +137,8 @@ def _compiled_pipeline(mesh: Mesh, config: GPT2Config, pp_axis: str,
         zeros_state = jnp.zeros(h_all.shape[1:], h_all.dtype)
         # mark the scan carry as pp-varying up front (it becomes varying
         # via ppermute/masked writes; the carry signature must agree)
-        init = (jax.lax.pcast(zeros_state, pp_axis, to="varying"),
-                jax.lax.pcast(jnp.zeros_like(h_all), pp_axis, to="varying"))
+        init = (pcast_varying(zeros_state, pp_axis),
+                pcast_varying(jnp.zeros_like(h_all), pp_axis))
 
         def tick(carry, t):
             state, outputs = carry
@@ -177,11 +178,11 @@ def _compiled_pipeline(mesh: Mesh, config: GPT2Config, pp_axis: str,
         return jax.lax.psum(outputs, pp_axis)
 
     if not has_valid:
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             lambda b, h: per_stage(b, None, h), mesh=mesh,
             in_specs=(P(pp_axis), P()), out_specs=P(),
             axis_names={pp_axis}))
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         per_stage, mesh=mesh,
         in_specs=(P(pp_axis), P(pp_axis), P()), out_specs=P(),
         axis_names={pp_axis}))

@@ -48,6 +48,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.gpt2 import GPT2Config, Params
+from ._shard_compat import pcast_varying, shard_map
 from .gpipe import microbatch
 
 
@@ -187,7 +188,7 @@ def _compiled_1f1b(mesh: Mesh, config: GPT2Config, pp_axis: str,
             # block slice) are already varying — pcast rejects the no-op.
             def f(a):
                 try:
-                    return jax.lax.pcast(a, pp_axis, to="varying")
+                    return pcast_varying(a, pp_axis)
                 except ValueError:
                     return a
             return jax.tree_util.tree_map(f, tree)
@@ -384,7 +385,7 @@ def _compiled_1f1b(mesh: Mesh, config: GPT2Config, pp_axis: str,
             blocks = jax.tree_util.tree_map(lambda x: x[:, None], blocks)
             if valid is not None:
                 valid = valid[:, None]
-        run = jax.shard_map(
+        run = shard_map(
             per_stage if has_valid else
             (lambda b, e, h, i: per_stage(b, None, e, h, i)),
             mesh=mesh,

@@ -49,6 +49,17 @@ from ..ops.attention import KVCache
 from ..runtime.engine import (GenerateResult, SamplingConfig, _split_keys,
                               _step_keys, prepare_generate, select_token)
 from . import partition as Pt
+from ._shard_compat import pcast_varying, shard_map
+
+
+# Static-analysis contract (tools/graftcheck): the scope whose traced
+# jaxpr the overlap lint walks — the manual pipeline step every compiled
+# program (prefill and decode) runs its ticks through. The lint flags
+# collectives sitting on a scan's loop-carry critical path fed by
+# in-body compute (a serial transfer double-buffering would hide,
+# TokenWeave-style); the two currently-serial handoffs here are
+# baselined with justifications in tools/graftcheck/baseline.txt.
+GRAFTCHECK_DECODE_ENTRY_POINTS = ("_pp_blocks",)
 
 
 def stage_ring_permutation(n_stages: int) -> list:
@@ -92,6 +103,9 @@ class PipelinedDecoder:
         self.config = config
         self.mesh = mesh
         self.max_seq = max_seq
+        # compiled cache width (no window buckets here): the attribute
+        # the batcher's kv_block_gauges contract reads off any engine
+        self._cache_seq = max_seq
         self.pp_axis = pp_axis
         self.n_stages = mesh.shape[pp_axis]
 
@@ -172,8 +186,8 @@ class PipelinedDecoder:
             if has_pad:
                 pad_b = extra[i]               # [B]
             stage = jax.lax.axis_index(pp)
-            h_var = jax.lax.pcast(h, pp, to="varying")
-            final0 = jax.lax.pcast(jnp.zeros_like(h), pp, to="varying")
+            h_var = pcast_varying(h, pp)
+            final0 = pcast_varying(jnp.zeros_like(h), pp)
 
             def tick(carry, t):
                 h_in, ck, cv, final = carry
@@ -218,7 +232,7 @@ class PipelinedDecoder:
         if has_pad:
             in_specs.append(P())
             args.append(pad)
-        return jax.shard_map(
+        return shard_map(
             per_device, mesh=self.mesh,
             in_specs=tuple(in_specs),
             out_specs=(P(), P(pp), P(pp)),

@@ -128,6 +128,63 @@ def create_app(cfg: Optional[ServingConfig] = None,
     tokenizer = tokenizer or get_tokenizer(cfg.model_id,
                                            checkpoint_dir=cfg.checkpoint_dir)
 
+    # AUTO_PLAN (tools/graftcheck/costmodel): resolve the decode
+    # topology/batching/KV knobs at startup from the compile-free cost
+    # model — every candidate is gated through the graftcheck semantic
+    # verifier before scoring, so a plan this block installs is exactly
+    # as validated as a hand-written one (the guards below still run on
+    # the resolved values). The chosen plan is logged and reported
+    # under /healthz "auto_plan".
+    auto_plan_info = None
+    if cfg.auto_plan:
+        if not (cfg.shard_role == "coordinator" and cfg.dispatch == "local"):
+            raise ValueError("AUTO_PLAN applies to the coordinator's local "
+                             "decode path only")
+        try:
+            from tools.graftcheck import costmodel as _cm
+        except ImportError as e:
+            raise ValueError(
+                "AUTO_PLAN=1 needs the repo's tools/ package importable "
+                "(run from the repo checkout root)") from e
+        plan_traffic = (_cm.parse_traffic(cfg.auto_plan_traffic)
+                        if cfg.auto_plan_traffic else None)
+        payload = _cm.plan_for_serving(
+            config, len(jax.devices()), max_seq=cfg.max_seq,
+            traffic=plan_traffic, max_batch_cap=max(cfg.max_batch, 1),
+            kv_pool_blocks=cfg.kv_pool_blocks,
+            kv_block_size=cfg.kv_block_size)
+        chosen = payload["chosen"]
+        if chosen is None:
+            raise ValueError(
+                "AUTO_PLAN: no candidate serving config survived the "
+                "graftcheck verifier for this model/mesh/traffic")
+        import dataclasses as _dc
+        c = chosen["config"]
+        cfg = _dc.replace(
+            cfg,
+            batch_mode=c["batch_mode"], max_batch=c["max_batch"],
+            kv_pool_blocks=c["kv_pool_blocks"],
+            kv_block_size=c["kv_block_size"],
+            pp_decode=c["topology"] == "pp",
+            tp_decode=c["topology"] == "tp",
+            ep_decode=c["topology"] == "ep",
+            boundaries=(tuple(c["boundaries"]) if c["topology"] == "pp"
+                        else cfg.boundaries))
+        auto_plan_info = {
+            "chosen": chosen["label"],
+            "mesh": chosen.get("mesh", {}),
+            "cost_per_token": chosen["cost_per_token"],
+            "comm_bytes_per_token": chosen["comm_bytes_per_token"],
+            "hbm_bytes_per_device": chosen["hbm_bytes_per_device"],
+            "programs_exact": chosen["programs_exact"],
+            "candidates": len(payload["plan"]),
+            "rejected": payload["rejected"],
+        }
+        log.info('{"event": "auto_plan", "chosen": "%s", '
+                 '"cost_per_token": %s, "candidates": %d, "rejected": %d}',
+                 chosen["label"], chosen["cost_per_token"],
+                 len(payload["plan"]), payload["rejected"])
+
     n_layer = config.n_layer
     for b in cfg.boundaries:
         if not 1 <= b <= n_layer - 1:
@@ -478,7 +535,7 @@ def create_app(cfg: Optional[ServingConfig] = None,
         """The decode topology/composition ACTUALLY serving /generate —
         the single source for /healthz and the flight-recorder header
         (/debug/requests), so the two can never disagree."""
-        return {
+        topo = {
             "role": cfg.shard_role,
             "model": cfg.model_id,
             "n_stages": decode_stages,
@@ -495,6 +552,12 @@ def create_app(cfg: Optional[ServingConfig] = None,
             "kv_pool_blocks": cfg.kv_pool_blocks,
             "kv_block_size": cfg.kv_block_size,
         }
+        if auto_plan_info is not None:
+            # how the knobs above were resolved (AUTO_PLAN=1): the
+            # planner's chosen row, so monitoring can tell a planned
+            # topology from a hand-tuned one
+            topo["auto_plan"] = auto_plan_info
+        return topo
 
     @app.get("/healthz")
     def healthz():

@@ -102,6 +102,23 @@ class ServingConfig:
     kv_pool_blocks: int = 0
     # Cache slots per pool block; MAX_SEQ must be a multiple of it.
     kv_block_size: int = 16
+    # Auto-sharding planner (tools/graftcheck/costmodel): AUTO_PLAN=1
+    # resolves the decode topology/batching/KV knobs at startup by
+    # running the compile-free planner over the loaded model config and
+    # this pod's visible devices — every candidate is gated through the
+    # graftcheck semantic verifier before scoring, and the chosen plan
+    # overrides BATCH_MODE / MAX_BATCH / PP|TP|EP_DECODE / BOUNDARIES /
+    # KV_POOL_BLOCKS / KV_BLOCK_SIZE wholesale (those env vars become
+    # planner INPUTS: MAX_BATCH caps candidate widths, KV_POOL_BLOCKS
+    # sizes the paged candidates). The resolved plan is logged and
+    # reported under /healthz "auto_plan". Coordinator + local dispatch
+    # only. 0 = off (hand-tuned knobs serve as-is).
+    auto_plan: bool = False
+    # Traffic mix the planner scores against, as the planner's
+    # 'prompt/new[xcount],...' syntax (e.g. "16/64x8,256/32"). Empty =
+    # a single interactive stream (the planner's default), which
+    # reproduces the hand-tuned single-stream serving config.
+    auto_plan_traffic: str = ""
 
     def __post_init__(self):
         if self.shard_role not in VALID_ROLES:
@@ -237,4 +254,6 @@ def from_env() -> ServingConfig:
         batch_mode=os.environ.get("BATCH_MODE", "admission"),
         kv_pool_blocks=_env_int("KV_POOL_BLOCKS", 0),
         kv_block_size=_env_int("KV_BLOCK_SIZE", 16),
+        auto_plan=_env_bool("AUTO_PLAN"),
+        auto_plan_traffic=os.environ.get("AUTO_PLAN_TRAFFIC", ""),
     )

@@ -72,6 +72,7 @@ _QUICK_MODULES = {
     "test_subproc",         # watchdog attribution (bench/CI harness)
     "test_tokenizer",       # offline BPE round-trips
     "test_graftcheck",      # static contract verifier + lint (whole-repo)
+    "test_graftplan",       # cost model goldens + planner rankings
 }
 
 

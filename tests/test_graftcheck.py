@@ -46,10 +46,16 @@ def params():
 
 
 def test_repo_passes_graftcheck():
-    payload = cli.run(root=REPO)
+    # strict: a stale baseline entry (dead suppression) fails the suite
+    # too, not just the explicit stale_baseline assert below — CI
+    # catches suppressions that outlive their findings
+    payload = cli.run(root=REPO, strict=True)
+    assert payload["strict"] is True
     assert payload["ok"], "\n".join(
         f"{f['path']}:{f['line']}: [{f['rule']}] {f['message']}"
-        for f in payload["findings"])
+        for f in payload["findings"]) or (
+        "stale baseline entries under --strict: "
+        f"{payload['stale_baseline']}")
     assert payload["stale_baseline"] == [], (
         "baseline entries whose findings are gone — delete the lines: "
         f"{payload['stale_baseline']}")

@@ -1,8 +1,23 @@
-"""graftcheck CLI: ``python -m tools.graftcheck [--json] [--lint-only]``.
+"""graftcheck CLI.
 
-Exit code 0 iff every finding from both passes is baselined. ``--json``
-emits one machine-readable object (journaled by bench.py alongside the
-perf matrix, so contract drift shows up in the perf trajectory too).
+Two modes, one module entry point:
+
+- ``python -m tools.graftcheck [--json] [--lint-only] [--strict]`` —
+  the verifier (exit 0 iff every finding from both passes is baselined;
+  under ``--strict`` a STALE baseline entry — a suppression whose
+  finding no longer exists — is also a failure, so dead suppressions
+  cannot rot in CI).
+- ``python -m tools.graftcheck plan --model M --mesh SPEC --traffic T``
+  — the planner (tools/graftcheck/costmodel.py): gate every candidate
+  serving config through the verifier, score the survivors
+  compile-free, print the ranked table and the chosen config's env
+  vars. ``--json`` emits the full payload (schema:
+  docs/ARCHITECTURE.md "Planning").
+
+``--json`` payloads are journaled by bench.py alongside the perf matrix
+(rows ``graftcheck_static_analysis`` and ``graftcheck_chosen_plan``),
+so contract drift and plan drift land in the same trajectory as the
+timings.
 """
 
 from __future__ import annotations
@@ -21,9 +36,12 @@ def _repo_root() -> str:
 
 
 def run(root: str = None, lint_only: bool = False,
-        baseline_path: str = None) -> dict:
+        baseline_path: str = None, strict: bool = False) -> dict:
     """Both passes -> one JSON-able payload. Import-light until called;
-    the semantic pass imports jax (CPU stand-ins only)."""
+    the semantic pass imports jax (CPU stand-ins only). ``strict``
+    fails the run on stale baseline entries too (the in-suite driver
+    runs strict so CI catches dead suppressions; the standalone default
+    stays report-only)."""
     root = root or _repo_root()
     # scoped insert (the same leak-class hygiene as the check_metrics
     # shim): in-suite callers run() in-process, and a permanent prepend
@@ -65,7 +83,8 @@ def run(root: str = None, lint_only: bool = False,
     baseline = load_baseline(baseline_path)
     active, suppressed, stale = split_findings(findings, baseline)
     return {
-        "ok": not active,
+        "ok": not active and not (strict and stale),
+        "strict": strict,
         "findings": [f.to_dict() for f in active],
         "suppressed": len(suppressed),
         "stale_baseline": sorted("::".join(k[1:]) + f" [{k[0]}]"
@@ -75,16 +94,130 @@ def run(root: str = None, lint_only: bool = False,
     }
 
 
+def _parse_mesh(spec: str) -> dict:
+    """``"tp=2"`` / ``"ep=2,tp=2"`` -> {axis: size}; ``"1"`` (or empty)
+    = single device, no mesh axes."""
+    spec = (spec or "").strip()
+    if spec in ("", "1", "none"):
+        return {}
+    axes = {}
+    for part in spec.split(","):
+        name, sep, size = part.partition("=")
+        try:
+            n = int(size)
+        except ValueError:
+            n = 0
+        if not sep or n < 1:
+            raise ValueError(
+                f"bad mesh element {part!r}: want axis=size with size "
+                ">= 1, e.g. tp=2")
+        axes[name.strip()] = n
+    return axes
+
+
+def run_plan(args) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    root = args.root or _repo_root()
+    added = root not in sys.path
+    if added:
+        sys.path.insert(0, root)
+    try:
+        from . import costmodel, registry
+        fams = registry.planner_families()
+        if args.model not in fams:
+            print(f"unknown --model {args.model!r}; registered planner "
+                  f"families: {sorted(fams)}", file=sys.stderr)
+            return 2
+        module, config = fams[args.model]
+        try:
+            mesh_axes = _parse_mesh(args.mesh)
+            traffic = (costmodel.parse_traffic(args.traffic)
+                       if args.traffic else None)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        payload = costmodel.plan(
+            module, config, mesh_axes, max_seq=args.max_seq,
+            traffic=traffic, max_batch_cap=args.max_batch,
+            kv_pool_blocks=args.kv_blocks, kv_block_size=args.kv_block_size,
+            hbm_gb=args.hbm_gb)
+    finally:
+        if added:
+            try:
+                sys.path.remove(root)
+            except ValueError:
+                pass
+
+    if args.json:
+        print(json.dumps(payload, indent=2, default=str))
+        return 0 if payload["chosen"] is not None else 1
+    print(f"graftplan: {args.model} on mesh {payload['mesh'] or '1 device'}"
+          f", traffic {args.traffic or 'default'}")
+    for i, row in enumerate(payload["plan"][:args.top]):
+        mark = "*" if payload["chosen"] and \
+            row["label"] == payload["chosen"]["label"] else " "
+        if row["ok"]:
+            print(f" {mark} {i + 1:2d}. {row['label']:<32} "
+                  f"cost/token {row['cost_per_token']:>12} "
+                  f"comm {row['comm_bytes_per_token']:>8} "
+                  f"hbm {row['hbm_bytes_per_device']:>10} "
+                  f"programs {row['program_total']}"
+                  f"{'' if row['programs_exact'] else ' (bound)'}")
+        else:
+            why = (row["findings"][0]["message"] if row["findings"]
+                   else row["note"])
+            print(f"   --. {row['label']:<32} REJECTED: {why[:80]}")
+    if payload["chosen"] is None:
+        print("graftplan: no candidate survived the verifier")
+        return 1
+    print("chosen serving env:")
+    for k, v in sorted(payload["chosen"]["serving_env"].items()):
+        print(f"  {k}={v}")
+    return 0
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "plan":
+        ap = argparse.ArgumentParser(
+            prog="python -m tools.graftcheck plan",
+            description="compile-free cost model + auto-sharding planner")
+        ap.add_argument("--model", default="gpt2-tiny",
+                        help="planner family (registry.planner_families)")
+        ap.add_argument("--mesh", default="1",
+                        help="mesh axes, e.g. 'tp=2' / 'ep=2'; '1' = "
+                        "single device")
+        ap.add_argument("--traffic", default=None,
+                        help="traffic mix 'prompt/new[xcount],...', e.g. "
+                        "'16/32x8,64/16'")
+        ap.add_argument("--max-seq", type=int, default=64)
+        ap.add_argument("--max-batch", type=int, default=8,
+                        help="largest batch width candidates may use")
+        ap.add_argument("--kv-blocks", type=int, default=0,
+                        help="paged-pool block count to consider (0: only "
+                        "contiguous candidates)")
+        ap.add_argument("--kv-block-size", type=int, default=16)
+        ap.add_argument("--hbm-gb", type=float, default=16.0,
+                        help="per-device HBM feasibility budget")
+        ap.add_argument("--top", type=int, default=12,
+                        help="table rows to print (text mode)")
+        ap.add_argument("--root", default=None)
+        ap.add_argument("--json", action="store_true")
+        return run_plan(ap.parse_args(argv[1:]))
+
     ap = argparse.ArgumentParser(
         prog="python -m tools.graftcheck",
-        description="compile-free contract verifier + TPU-footgun lints")
+        description="compile-free contract verifier + TPU-footgun lints "
+                    "(see also: the 'plan' subcommand)")
     ap.add_argument("--root", default=None, help="repo root (default: "
                     "the checkout containing this tool)")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON object instead of text")
     ap.add_argument("--lint-only", action="store_true",
                     help="skip the semantic (jax-tracing) pass")
+    ap.add_argument("--strict", action="store_true",
+                    help="fail on stale baseline entries too (dead "
+                    "suppressions)")
     ap.add_argument("--baseline", default=None,
                     help="baseline file (default: tools/graftcheck/"
                     "baseline.txt)")
@@ -96,7 +229,7 @@ def main(argv=None) -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     payload = run(root=args.root, lint_only=args.lint_only,
-                  baseline_path=args.baseline)
+                  baseline_path=args.baseline, strict=args.strict)
     if args.json:
         print(json.dumps(payload, indent=2, default=str))
     else:
@@ -104,7 +237,8 @@ def main(argv=None) -> int:
             print(f"{f['path']}:{f['line']}: [{f['rule']}] {f['message']}"
                   f"  (scope: {f['scope']})")
         for s in payload["stale_baseline"]:
-            print(f"stale baseline entry (fixed? delete the line): {s}")
+            print(f"stale baseline entry (fixed? delete the line): {s}"
+                  + (" [FAIL under --strict]" if args.strict else ""))
         n = len(payload["findings"])
         print(f"graftcheck: {n} active finding(s), "
               f"{payload['suppressed']} baselined, "

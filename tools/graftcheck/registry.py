@@ -56,6 +56,10 @@ MESHES: Dict[str, Dict[str, int]] = {
 # stage-axis sizes the ppermute ring is verified over
 RING_SIZES: Tuple[int, ...] = (1, 2, 3, 4, 8)
 
+# stage counts the overlap lint traces the real PipelinedDecoder step at
+# (n_layer=4 stand-ins: 2 balanced-even, 4 one-block stages)
+OVERLAP_RING_SIZES: Tuple[int, ...] = (2, 4)
+
 # Paged KV-pool geometries (runtime.kv_pool / ops.paged_attention) the
 # block-table contract family is verified over: (label, kwargs for
 # semantic.check_paged_contracts). Covers GQA (n_kv_head < n_head
@@ -70,6 +74,25 @@ PAGED_GEOMETRIES: Tuple[Tuple[str, dict], ...] = (
                        block_size=16, head_dim=8, max_seq=64,
                        batches=(1, 4))),
 )
+
+
+def planner_families() -> Dict[str, tuple]:
+    """name -> (family module, tiny config) rows ``plan`` mode resolves
+    ``--model`` against. Same trace-instant philosophy as ``families()``
+    but with planner-relevant structure: the llama stand-in keeps a
+    GQA ratio whose head counts a 2-wide tp axis divides (the
+    ``families()`` stand-in's n_kv_head=1 deliberately exercises the
+    indivisible case instead), and the moe stand-in's expert count
+    divides a 2-wide ep axis."""
+    from llm_sharding_demo_tpu.models import llama
+    fams = families()
+    return {
+        "gpt2-tiny": fams["gpt2-tiny"],
+        "llama-gqa": (llama, llama.LlamaConfig(
+            vocab_size=96, n_positions=64, n_embd=16, n_layer=4, n_head=4,
+            n_kv_head=2, intermediate_size=32)),
+        "moe-tiny": fams["moe-tiny"],
+    }
 
 
 def serving_workloads() -> List[tuple]:

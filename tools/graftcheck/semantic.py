@@ -391,6 +391,201 @@ def check_ring_program(n_stages: int, where: str) -> List[Finding]:
     return findings
 
 
+# -- overlap lint (collectives vs compute) -----------------------------------
+
+# comm primitives the overlap rule (and the cost model's byte walker,
+# tools/graftcheck/costmodel.py) recognize in a traced jaxpr
+COMM_PRIMITIVES = ("ppermute", "psum", "all_gather", "all_to_all",
+                   "reduce_scatter", "pmax", "pmin")
+
+# primitives that are pure data movement/bookkeeping — never the compute
+# a transfer could overlap with
+_TRIVIAL_PRIMITIVES = frozenset({
+    "broadcast_in_dim", "reshape", "squeeze", "expand_dims", "transpose",
+    "convert_element_type", "slice", "concatenate", "iota", "select_n",
+    "pad", "rev", "copy", "stop_gradient", "eq", "ne", "lt", "le", "gt",
+    "ge", "add", "sub", "and", "or", "not", "pvary", "pcast",
+    "axis_index", "squeeze_p",
+})
+
+
+def _sub_jaxprs(eqn):
+    """Every sub-jaxpr a primitive's params carry (scan/while/cond/pjit/
+    shard_map bodies), normalized to plain Jaxpr objects."""
+    subs = []
+    for v in eqn.params.values():
+        items = v if isinstance(v, (tuple, list)) else (v,)
+        for item in items:
+            inner = getattr(item, "jaxpr", None)
+            if inner is not None and hasattr(inner, "eqns"):
+                subs.append(inner)
+            elif hasattr(item, "eqns"):
+                subs.append(item)
+    return subs
+
+
+def check_overlap_jaxpr(jaxpr, where: str, path: str,
+                        scope: str) -> List[Finding]:
+    """Walk a traced jaxpr; inside every ``scan`` body, flag each
+    collective that (a) feeds the scan's carry outputs and (b) consumes
+    in-body compute — i.e. the transfer for step k sits strictly between
+    step k's compute and step k+1's compute with nothing scheduled to
+    hide it. That is the serial-handoff shape TokenWeave-style
+    double-buffering (split the per-stage batch, overlap microbatch k's
+    collective with k+1's compute) removes; a baselined finding is the
+    declared decision NOT to overlap yet."""
+    findings: List[Finding] = []
+
+    def analyze_scan_body(body, num_carry: int, where_in: str):
+        from jax.core import Literal
+        eqns = list(body.eqns)
+        producer = {}
+        for i, eqn in enumerate(eqns):
+            for ov in eqn.outvars:
+                producer[ov] = i
+        # backward dependency closure per eqn (eqn indices it reads from)
+        back: List[set] = []
+        for i, eqn in enumerate(eqns):
+            deps = set()
+            for iv in eqn.invars:
+                if isinstance(iv, Literal):
+                    continue
+                j = producer.get(iv)
+                if j is not None:
+                    deps.add(j)
+                    deps |= back[j]
+            back.append(deps)
+        carry_outs = set(body.outvars[:num_carry])
+        for i, eqn in enumerate(eqns):
+            if eqn.primitive.name not in COMM_PRIMITIVES:
+                continue
+            # forward reach from this collective to the carry outputs
+            reached = set(eqn.outvars)
+            feeds_carry = bool(reached & carry_outs)
+            for j in range(i + 1, len(eqns)):
+                if i in back[j] or any(v in reached for v in eqns[j].invars):
+                    back[j].add(i)
+                    reached |= set(eqns[j].outvars)
+            feeds_carry = feeds_carry or bool(reached & carry_outs)
+            fed_by_compute = any(
+                eqns[j].primitive.name not in _TRIVIAL_PRIMITIVES
+                for j in back[i])
+            if feeds_carry and fed_by_compute:
+                findings.append(Finding(
+                    "overlap", path, 1, scope,
+                    f"{eqn.primitive.name} in {where_in} rides the scan "
+                    "carry and consumes in-body compute: the transfer for "
+                    "step k is strictly ordered between step k's and step "
+                    "k+1's compute with no independent work to hide it "
+                    "(double-buffer the microbatch to overlap, "
+                    "TokenWeave-style)"))
+
+    def walk(jxp):
+        for eqn in jxp.eqns:
+            if eqn.primitive.name == "scan":
+                body = eqn.params["jaxpr"].jaxpr
+                analyze_scan_body(body, eqn.params["num_carry"],
+                                  f"{where}: scan@{eqn.params.get('length')}")
+            for sub in _sub_jaxprs(eqn):
+                walk(sub)
+
+    walk(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr)
+    return findings
+
+
+def build_ppdecode_programs(n_stages: int, batch: int = 1, seq: int = 8,
+                            max_seq: int = 32, family: str = "gpt2",
+                            module=None, config=None) -> List[tuple]:
+    """Trace the REAL ``PipelinedDecoder._pp_blocks`` step (the manual
+    pipeline program both compiled phases run) over an ``AbstractMesh``
+    stand-in — zero devices, zero compile. Returns ``(label, scope, fn,
+    args)`` rows: one prefill-shaped step ([B, S, D] in) and one
+    decode-shaped step ([B, 1, D] in). The overlap lint walks these; the
+    cost model (costmodel.py) reads collective comm bytes off the same
+    traced decode step, so what is linted and what is priced is the one
+    program serving would run.
+
+    ``module``/``config`` override the registry stand-in — the cost
+    model passes the config actually being scored so the priced
+    activations are that model's, not the tiny stand-in's; the overlap
+    lint keeps the stand-ins (the property is shape-independent)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import AbstractMesh
+
+    from llm_sharding_demo_tpu.models.llama import LlamaConfig
+    from llm_sharding_demo_tpu.parallel import partition as Pt
+    from llm_sharding_demo_tpu.parallel.ppdecode import (
+        GRAFTCHECK_DECODE_ENTRY_POINTS, PipelinedDecoder)
+    from . import registry
+
+    if module is None or config is None:
+        fams = registry.families()
+        module, config = fams["llama-tiny" if family == "llama"
+                              else "gpt2-tiny"]
+    if "_pp_blocks" not in GRAFTCHECK_DECODE_ENTRY_POINTS:
+        raise ValueError(
+            "ppdecode no longer declares _pp_blocks in "
+            "GRAFTCHECK_DECODE_ENTRY_POINTS — update this builder to "
+            "trace the declared entry points")
+    bounds = Pt.balanced_boundaries(config.n_layer, n_stages)
+    specs = Pt.make_stage_specs(config.n_layer, bounds)
+    dec = PipelinedDecoder.__new__(PipelinedDecoder)
+    dec.config = config
+    dec.mesh = AbstractMesh((("pp", n_stages),))
+    dec.max_seq = max_seq
+    dec.pp_axis = "pp"
+    dec.n_stages = n_stages
+    dec.dtype = jnp.float32
+    dec._llama = isinstance(config, LlamaConfig)
+    if len({s.n_blocks for s in specs}) == 1:
+        dec._valid = None
+        dec.per_stage = specs[0].n_blocks
+    else:
+        dec._valid = Pt.stage_valid_mask(specs)
+        dec.per_stage = max(s.n_blocks for s in specs)
+
+    pavals = _param_avals(module, config)
+    if dec._valid is None:
+        blocks = jax.eval_shape(
+            lambda p: Pt.stack_stage_params(p, specs), pavals)
+    else:
+        blocks = jax.eval_shape(
+            lambda p: Pt.stack_stage_params_padded(p, specs)[0], pavals)
+    heads = getattr(config, "n_kv_head", config.n_head)
+    cache = jax.ShapeDtypeStruct(
+        (n_stages, dec.per_stage, batch, heads, max_seq, config.head_dim),
+        jnp.float32)
+    length = jax.ShapeDtypeStruct((), jnp.int32)
+
+    def step_fn(s: int):
+        h = jax.ShapeDtypeStruct((batch, s, config.n_embd), jnp.float32)
+
+        def fn(blocks, ck, cv, h, length):
+            return dec._pp_blocks(blocks, ck, cv, h, length)
+
+        return fn, (blocks, cache, cache, h, length)
+
+    rows = []
+    for label, s in (("prefill-step", seq), ("decode-step", 1)):
+        fn, args = step_fn(s)
+        rows.append((f"ppdecode/pp={n_stages}/{label}",
+                     "PipelinedDecoder._pp_blocks", fn, args))
+    return rows
+
+
+def check_decode_overlap(n_stages: int, where: str) -> List[Finding]:
+    """The registry-driven overlap pass: trace every declared pipelined
+    decode program at this stage count and run the overlap rule on it."""
+    import jax
+    findings: List[Finding] = []
+    for label, scope, fn, args in build_ppdecode_programs(n_stages):
+        jaxpr = jax.make_jaxpr(fn)(*args)
+        findings.extend(check_overlap_jaxpr(
+            jaxpr, f"{where}/{label}", _PPDECODE_PATH, scope))
+    return findings
+
+
 # -- paged KV block-table contracts ------------------------------------------
 
 _KV_POOL_PATH = "llm_sharding_demo_tpu/runtime/kv_pool.py"
@@ -555,6 +750,14 @@ def run_semantic() -> Tuple[List[Finding], int]:
     # paged KV block-table contracts per registered pool geometry
     for label, kwargs in registry.PAGED_GEOMETRIES:
         findings.extend(check_paged_contracts(where=label, **kwargs))
+        checks += 1
+
+    # overlap lint over the declared pipelined-decode programs (ROADMAP
+    # item 3 seed): the currently-serial ppdecode handoffs surface here
+    # and stay baselined with justifications until double-buffering
+    # lands — at which point the stale suppressions fail --strict
+    for n in registry.OVERLAP_RING_SIZES:
+        findings.extend(check_decode_overlap(n, f"overlap/pp={n}"))
         checks += 1
 
     return findings, checks
