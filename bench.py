@@ -1309,8 +1309,69 @@ def main() -> None:
             "rejected": payload["rejected"],
         }
 
+    def cfg_ici_calibration():
+        """ICI_BYTE_WEIGHT calibration row (ROADMAP item 5 follow-on):
+        measured-vs-modeled comm bytes for the pp=2 ppdecode ring. The
+        cost model walks collective bytes off the traced decode step
+        (tools/graftcheck/costmodel.py, ICI_BYTE_WEIGHT = relative cost
+        of an ICI byte vs an HBM byte); this row compiles THE SAME step
+        on the real 2-device pp mesh and journals what the executable's
+        own cost analysis reports for the transfer, so a drift between
+        the model's byte formula and what XLA actually schedules lands
+        in the perf trajectory. Needs the bench chip with >= 2 devices:
+        CPU 'collectives' are host memcpys and would calibrate nothing.
+        """
+        import jax as _jax
+
+        from tools.graftcheck import costmodel as _cm
+
+        if _jax.default_backend() != "tpu":
+            return {"skipped": "ICI calibration needs the bench chip "
+                               "(CPU collectives are host memcpys; a "
+                               "measured/modeled ratio there would "
+                               "mislead the planner's ICI_BYTE_WEIGHT)"}
+        if len(_jax.devices()) < 2:
+            return {"skipped": "ICI calibration needs >= 2 devices for "
+                               "a real pp=2 ring; this host exposes "
+                               f"{len(_jax.devices())}"}
+
+        from llm_sharding_demo_tpu.models import gpt2 as _g
+        from llm_sharding_demo_tpu.parallel.spmd import make_mesh
+        modeled = _cm.pp_decode_comm_bytes(2, batch=1, module=_g,
+                                           config=tiny)
+        mesh = make_mesh({"pp": 2}, _jax.devices()[:2])
+        fn, args = _cm.pp_decode_step_program(2, batch=1, module=_g,
+                                              config=tiny, mesh=mesh)
+        compiled = _jax.jit(fn).lower(*args).compile()
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else {}
+        measured = None
+        measured_key = None
+        for key, val in sorted((analysis or {}).items()):
+            if "network" in key.lower():
+                measured = (measured or 0.0) + float(val)
+                measured_key = key if measured_key is None \
+                    else f"{measured_key}+{key}"
+        hlo_permutes = compiled.as_text().count("collective-permute")
+        row = {
+            "modeled_comm_bytes_per_token": modeled,
+            "measured_comm_bytes_per_token": measured,
+            "measured_source": measured_key or "cost_analysis had no "
+                                               "network counters",
+            "hlo_collective_permutes": hlo_permutes,
+            "ici_byte_weight": _cm.ICI_BYTE_WEIGHT,
+            "note": "pp=2 ppdecode ring decode step; ratio calibrates "
+                    "the planner's ICI byte weight against the "
+                    "compiled executable",
+        }
+        if measured and modeled:
+            row["measured_over_modeled"] = round(measured / modeled, 3)
+        return row
+
     safe("graftcheck_static_analysis", cfg_graftcheck)
     safe("graftcheck_chosen_plan", cfg_graftplan)
+    safe("ici_byte_weight_calibration", cfg_ici_calibration)
     safe("cfg1_tiny_gpt2_2shard_20tok", cfg1)
 
     if args.quick:

@@ -38,6 +38,11 @@ from ..runtime.engine import (GenerateResult, SamplingConfig,
                               prepare_generate, select_token)
 from . import partition as P
 
+# Donation contract (tools/graftcheck sanitize pass): every per-stage
+# jit in ``_stage_fns`` consumes its cache argument (arg 2) — callers
+# always continue with the RETURNED caches (see ``forward``'s docstring).
+DONATED_ARGS = {"_stage_fns": (2,)}
+
 
 class PipelineRunner:
     """N pipeline stages resident on N devices of a 1×N mesh.

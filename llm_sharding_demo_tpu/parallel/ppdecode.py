@@ -61,6 +61,12 @@ from ._shard_compat import pcast_varying, shard_map
 # baselined with justifications in tools/graftcheck/baseline.txt.
 GRAFTCHECK_DECODE_ENTRY_POINTS = ("_pp_blocks",)
 
+# Donation contract (tools/graftcheck sanitize pass): ``_decode``
+# consumes the per-stage cache stacks (args 2 and 3) — callers re-bind
+# both from the call's outputs; a host view of either taken before the
+# call would read donated storage.
+DONATED_ARGS = {"_decode": (2, 3)}
+
 
 def stage_ring_permutation(n_stages: int) -> list:
     """THE ppermute pairs for one hop along the stage ring:

@@ -59,6 +59,14 @@ EOS_SEGMENT = 32
 # compiled-program population the budget would silently miss).
 JIT_ENTRY_POINTS = ("_prefill", "_prefill_chunked", "_decode_seg")
 
+# Donation contract (tools/graftcheck sanitize pass): the positional
+# arguments each jitted entry point CONSUMES (donate_argnums). Callers
+# must not re-read a donated buffer after the call, and any host view
+# (np.asarray of a CPU jax array is zero-copy) of a value that flows
+# into a donated slot must take an owning copy first — the
+# donation-aliasing rules resolve call sites through this declaration.
+DONATED_ARGS = {"_decode_seg": (2,)}
+
 # Decode hot-loop scopes (tools/graftcheck host-sync rule): functions
 # whose loop bodies sit between compiled decode dispatches, where an
 # accidental ``.item()``/``np.asarray``/``float()`` on a device value

@@ -126,6 +126,20 @@ from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
 # certifier; an undeclared site is a lint finding.
 JIT_ENTRY_POINTS = ("_admit_cache",)
 
+# Donation contract (tools/graftcheck sanitize pass): ``_admit_cache``
+# consumes the live batch cache (arg 0) — callers re-bind
+# ``state.cache`` from its output, never the donated input.
+DONATED_ARGS = {"_admit_cache": (0,)}
+
+# Pool-mover lease scopes (tools/graftcheck sanitize pass): the only
+# functions allowed to invoke pool gather/scatter movers — each holds a
+# live BlockAllocator lease on every block id it moves (table entries
+# are this batch's ``_Slot.blk_ids`` allocations or the trash block).
+POOL_MOVER_SCOPES = ("IterBatchingEngine._init_tables",
+                     "IterBatchingEngine._place_admitted",
+                     "IterBatchingEngine._advance",
+                     "IterBatchingEngine._advance_spec")
+
 # Decode hot-loop scopes (tools/graftcheck host-sync rule): the segment
 # dispatch loop is the zero-sync fast path; the spec variant's syncs are
 # the documented per-segment price and are baselined.

@@ -57,10 +57,13 @@ entry, deref the original).
 from __future__ import annotations
 
 import dataclasses
+import os
+import sys
 import threading
 import time
+import weakref
 from collections import OrderedDict
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -76,14 +79,72 @@ from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
 
 # Static-analysis contract (tools/graftcheck): every ``jax.jit`` site in
 # this module, by holding attribute — enumerated by the recompile-budget
-# certifier; an undeclared site is a lint finding.
-JIT_ENTRY_POINTS = ("_gather", "_scatter", "_scatter_row", "_copy")
+# certifier; an undeclared site is a lint finding. ``_poison`` is the
+# sanitizer's free-block poisoner (GRAFTSAN=1 only — see GraftsanError).
+JIT_ENTRY_POINTS = ("_gather", "_scatter", "_scatter_row", "_copy",
+                    "_poison")
+
+# Donation contract (tools/graftcheck sanitize pass): the pool movers
+# all consume the pool buffer itself (arg 0) — ``self.data`` is re-bound
+# from every call's output under ``_dev_lock``, and nothing may hold a
+# host view of it.
+DONATED_ARGS = {"_scatter": (0,), "_scatter_row": (0,), "_copy": (0,),
+                "_poison": (0,)}
+
+# Pool-mover lease scopes (tools/graftcheck sanitize pass): the paged
+# runner's two mover sites — every block id they move is a live
+# allocation of this generate (owned/shared row ids) or the trash block.
+POOL_MOVER_SCOPES = ("PagedKVRunner._prefill_tables",
+                     "PagedKVRunner._decode")
 
 
 class PoolExhausted(RuntimeError):
     """No allocation possible even after evicting every zero-ref prefix
     entry. Schedulers catch this and preempt; serving turns sustained
     exhaustion into 429."""
+
+
+class GraftsanError(RuntimeError, ValueError):
+    """A memory-safety invariant violation caught by the graftsan
+    dynamic sanitizer (``GRAFTSAN=1``): double-free, use-after-free
+    gather/scatter, CoW write to a shared block, refcount-conservation
+    drift, or a leak at teardown. Messages carry the offending block id
+    and the provenance (call sites) of the grants/frees involved.
+
+    Also a ``ValueError``: the sanitizer UPGRADES the allocator's plain
+    double-free ValueError with provenance, and callers (and tests)
+    catching the documented ValueError contract must keep working when
+    the sanitizer is armed."""
+
+
+def _graftsan_enabled() -> bool:
+    return os.environ.get("GRAFTSAN", "") not in ("", "0")
+
+
+def _call_site(skip_file: str = __file__) -> str:
+    """``file.py:line (func)`` of the nearest caller frame outside this
+    module — the provenance unit the sanitizer records per grant/free."""
+    f = sys._getframe(1)
+    while f is not None and f.f_code.co_filename == skip_file:
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    return (f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno} "
+            f"({f.f_code.co_name})")
+
+
+# live sanitizing allocators, for suite-level teardown sweeps
+# (``graftsan_sweep`` — the conftest hook under GRAFTSAN=1)
+_SAN_ALLOCATORS: "weakref.WeakSet[BlockAllocator]" = weakref.WeakSet()
+
+
+def graftsan_sweep(timeout: float = 2.0) -> None:
+    """Assert every live sanitizing allocator is quiesced (no leaked
+    caller refs): the teardown hook the suite runs after each test
+    under ``GRAFTSAN=1``. Raises ``GraftsanError`` listing each leaked
+    block with its grant-site provenance."""
+    for alloc in list(_SAN_ALLOCATORS):
+        alloc.graftsan_assert_quiesced(timeout=timeout)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -114,7 +175,8 @@ class BlockAllocator:
     """
 
     def __init__(self, num_blocks: int, block_size: int,
-                 watermark: float = 0.9):
+                 watermark: float = 0.9,
+                 sanitize: Optional[bool] = None):
         if num_blocks < 1:
             raise ValueError(f"num_blocks={num_blocks} must be >= 1")
         if block_size < 1:
@@ -134,6 +196,103 @@ class BlockAllocator:
         self._prefix_ref: Dict[int, int] = {}
         self.evictions = 0
         self.cow_copies = 0
+        # graftsan dynamic sanitizer (GRAFTSAN=1, or explicit flag):
+        # per-block grant-site provenance, refcount-conservation asserts
+        # at every boundary, freed-block poisoning (via _on_free — the
+        # owning pool wires its trash-copy writer in), and leak reports
+        # at teardown (graftsan_report / graftsan_assert_quiesced).
+        self.sanitize = (_graftsan_enabled() if sanitize is None
+                         else sanitize)
+        self._san_owner: Dict[int, List[str]] = {}   # grant sites, LIFO
+        self._san_freed: Dict[int, str] = {}         # last freeing site
+        self._san_grants = 0
+        self._san_drops = 0
+        self._on_free: Optional[Callable[[List[int]], None]] = None
+        if self.sanitize:
+            _SAN_ALLOCATORS.add(self)
+
+    # -- sanitizer bookkeeping (all under self._lock) ------------------------
+
+    def _san_grant_locked(self, b: int, site: str) -> None:
+        self._san_grants += 1
+        self._san_owner.setdefault(b, []).append(site)
+        self._san_freed.pop(b, None)
+
+    def _san_drop_locked(self, b: int, site: str,
+                         fully_freed: bool) -> None:
+        self._san_drops += 1
+        owners = self._san_owner.get(b)
+        if owners:
+            owners.pop()
+        if fully_freed:
+            self._san_owner.pop(b, None)
+            self._san_freed[b] = site
+
+    def _san_check_locked(self, boundary: str) -> None:
+        """Refcount conservation at a boundary: free + referenced ==
+        total, grants - drops == live refs, prefix refs bounded by
+        total refs. A violation is an accounting bug — raise with the
+        numbers, not a silent drift."""
+        free_n, ref_n = len(self._free), len(self._ref)
+        if free_n + ref_n != self.num_blocks:
+            raise GraftsanError(
+                f"[{boundary}] block conservation broken: {free_n} free "
+                f"+ {ref_n} referenced != {self.num_blocks} total")
+        live = sum(self._ref.values())
+        if self._san_grants - self._san_drops != live:
+            raise GraftsanError(
+                f"[{boundary}] refcount conservation broken: "
+                f"{self._san_grants} grants - {self._san_drops} drops "
+                f"!= {live} live refs")
+        for b, pr in self._prefix_ref.items():
+            if pr > self._ref.get(b, 0):
+                raise GraftsanError(
+                    f"[{boundary}] block {b} holds {pr} prefix refs but "
+                    f"only {self._ref.get(b, 0)} total refs")
+
+    def freed_provenance(self, block: int) -> Optional[str]:
+        """The site that last freed ``block`` (sanitizer mode), if it is
+        currently free because of an explicit free/eviction."""
+        with self._lock:
+            return self._san_freed.get(block)
+
+    def graftsan_report(self) -> List[dict]:
+        """Leak report: blocks whose refcount exceeds their prefix-entry
+        refs once all client work has retired — every such ref was
+        granted to a caller that never released it. Each row carries
+        the live grant-site provenance."""
+        with self._lock:
+            out = []
+            for b in sorted(self._ref):
+                extra = self._ref[b] - self._prefix_ref.get(b, 0)
+                if extra > 0:
+                    out.append({
+                        "block": b,
+                        "leaked_refs": extra,
+                        "prefix_refs": self._prefix_ref.get(b, 0),
+                        "grant_sites": list(self._san_owner.get(b, [])),
+                    })
+            return out
+
+    def graftsan_assert_quiesced(self, timeout: float = 2.0) -> None:
+        """Poll until no caller refs remain beyond prefix entries (block
+        release can trail request delivery by a scheduler beat), then
+        raise ``GraftsanError`` with provenance if leaks persist."""
+        deadline = time.monotonic() + timeout
+        leaks = self.graftsan_report()
+        while leaks and time.monotonic() < deadline:
+            time.sleep(0.01)
+            leaks = self.graftsan_report()
+        if leaks:
+            lines = "; ".join(
+                f"block {r['block']}: {r['leaked_refs']} leaked ref(s), "
+                f"granted at {r['grant_sites']}" for r in leaks)
+            raise GraftsanError(
+                f"pool teardown leak: {len(leaks)} block(s) still hold "
+                f"caller refs — {lines}")
+        with self._lock:
+            if self.sanitize:
+                self._san_check_locked("teardown")
 
     # -- sizing --------------------------------------------------------------
 
@@ -156,10 +315,20 @@ class BlockAllocator:
         referenced blocks at or under the watermark (after evicting
         prefix entries as needed)?"""
         with self._lock:
+            if self.sanitize:
+                self._san_check_locked("admission")
             if n_blocks > len(self._free) + self._evictable_blocks_locked():
                 return False
             live = len(self._ref) - self._evictable_blocks_locked()
             return live + n_blocks <= self.watermark * self.num_blocks
+
+    def _notify_freed(self, freed: List[int]) -> None:
+        """Fire the sanitizer's poison hook for fully-freed blocks —
+        OUTSIDE ``self._lock`` (the pool's writer takes ``_dev_lock``,
+        and gather/scatter validation reads allocator state under it;
+        firing inside would invert the lock order)."""
+        if freed and self._on_free is not None:
+            self._on_free(freed)
 
     def alloc(self, n: int) -> List[int]:
         """Allocate ``n`` blocks at ref=1, LRU-evicting zero-ref prefix
@@ -167,9 +336,10 @@ class BlockAllocator:
         without taking anything when ``n`` cannot be satisfied."""
         if n == 0:
             return []
+        evict_freed: List[int] = []
         with self._lock:
             while len(self._free) < n and self._prefix:
-                self._evict_lru_locked()
+                evict_freed.extend(self._evict_lru_locked())
             if len(self._free) < n:
                 raise PoolExhausted(
                     f"need {n} blocks, {len(self._free)} free and no "
@@ -178,28 +348,60 @@ class BlockAllocator:
             out = [self._free.pop() for _ in range(n)]
             for b in out:
                 self._ref[b] = 1
-            return out
+            if self.sanitize:
+                site = _call_site()
+                for b in out:
+                    self._san_grant_locked(b, site)
+                self._san_check_locked("alloc")
+            # eviction-freed blocks this alloc immediately re-took are
+            # live again — only the remainder gets poisoned
+            evict_freed = [b for b in evict_freed if b not in self._ref]
+        self._notify_freed(evict_freed)
+        return out
 
     def ref(self, ids) -> None:
         with self._lock:
+            site = _call_site() if self.sanitize else ""
             for b in ids:
                 if b not in self._ref:
                     raise ValueError(f"ref of unallocated block {b}")
                 self._ref[b] += 1
+                if self.sanitize:
+                    self._san_grant_locked(b, site)
+            if self.sanitize:
+                self._san_check_locked("ref")
 
     def free(self, ids) -> None:
         """Drop one ref per id; zero-ref blocks return to the free
-        list (idempotence is the caller's problem — double-frees raise)."""
+        list (idempotence is the caller's problem — double-frees raise;
+        the sanitizer upgrades them to ``GraftsanError`` with the
+        original freeing site's provenance)."""
+        freed: List[int] = []
         with self._lock:
+            site = _call_site() if self.sanitize else ""
             for b in ids:
                 r = self._ref.get(b)
                 if r is None:
+                    if self.sanitize:
+                        prior = self._san_freed.get(b)
+                        raise GraftsanError(
+                            f"double-free of block {b} at {site}: "
+                            + (f"previously freed at {prior}" if prior
+                               else "block was never allocated"))
                     raise ValueError(f"free of unallocated block {b}")
                 if r == 1:
                     del self._ref[b]
                     self._free.append(b)
+                    freed.append(b)
+                    if self.sanitize:
+                        self._san_drop_locked(b, site, fully_freed=True)
                 else:
                     self._ref[b] = r - 1
+                    if self.sanitize:
+                        self._san_drop_locked(b, site, fully_freed=False)
+            if self.sanitize:
+                self._san_check_locked("free")
+        self._notify_freed(freed)
 
     def refcount(self, block: int) -> int:
         with self._lock:
@@ -217,13 +419,18 @@ class BlockAllocator:
                 self._prefix.move_to_end(key)
                 return
             ids = tuple(ids)
+            site = f"prefix:{_call_site()}" if self.sanitize else ""
             for b in ids:
                 if b not in self._ref:
                     raise ValueError(
                         f"register_prefix of unallocated block {b}")
                 self._ref[b] += 1
                 self._prefix_ref[b] = self._prefix_ref.get(b, 0) + 1
+                if self.sanitize:
+                    self._san_grant_locked(b, site)
             self._prefix[key] = ids
+            if self.sanitize:
+                self._san_check_locked("register_prefix")
 
     def lookup_prefix(self, key: bytes) -> Optional[Tuple[int, ...]]:
         """Hit -> the entry's block ids with one caller ref added per
@@ -234,8 +441,13 @@ class BlockAllocator:
             if ids is None:
                 return None
             self._prefix.move_to_end(key)
+            site = _call_site() if self.sanitize else ""
             for b in ids:
                 self._ref[b] += 1
+                if self.sanitize:
+                    self._san_grant_locked(b, site)
+            if self.sanitize:
+                self._san_check_locked("lookup_prefix")
             return ids
 
     def has_prefix(self, key: bytes) -> bool:
@@ -243,18 +455,24 @@ class BlockAllocator:
             return key in self._prefix
 
     def drop_prefix(self, key: bytes) -> bool:
+        freed: List[int] = []
         with self._lock:
             ids = self._prefix.pop(key, None)
             if ids is None:
                 return False
-            self._deref_prefix_locked(ids)
-            return True
+            freed = self._deref_prefix_locked(ids)
+            if self.sanitize:
+                self._san_check_locked("drop_prefix")
+        self._notify_freed(freed)
+        return True
 
     def prefix_len(self) -> int:
         with self._lock:
             return len(self._prefix)
 
-    def _deref_prefix_locked(self, ids) -> None:
+    def _deref_prefix_locked(self, ids) -> List[int]:
+        freed: List[int] = []
+        site = _call_site() if self.sanitize else ""
         for b in ids:
             self._prefix_ref[b] -= 1
             if self._prefix_ref[b] == 0:
@@ -262,19 +480,30 @@ class BlockAllocator:
             if self._ref[b] == 1:
                 del self._ref[b]
                 self._free.append(b)
+                freed.append(b)
+                if self.sanitize:
+                    self._san_drop_locked(b, site, fully_freed=True)
             else:
                 self._ref[b] -= 1
+                if self.sanitize:
+                    self._san_drop_locked(b, site, fully_freed=False)
+        return freed
 
-    def _evict_lru_locked(self) -> None:
+    def _evict_lru_locked(self) -> List[int]:
         key, ids = self._prefix.popitem(last=False)
-        self._deref_prefix_locked(ids)
+        freed = self._deref_prefix_locked(ids)
         self.evictions += 1
         REGISTRY.inc("kv_pool_evictions_total")
+        if self.sanitize:
+            self._san_check_locked("eviction")
+        return freed
 
     def evict_lru(self) -> None:
+        freed: List[int] = []
         with self._lock:
             if self._prefix:
-                self._evict_lru_locked()
+                freed = self._evict_lru_locked()
+        self._notify_freed(freed)
 
     # -- stats ---------------------------------------------------------------
 
@@ -305,7 +534,8 @@ class KVBlockPool:
 
     def __init__(self, n_layer: int, num_blocks: int, n_kv_head: int,
                  block_size: int, head_dim: int, max_seq: int,
-                 dtype=jnp.float32, watermark: float = 0.9):
+                 dtype=jnp.float32, watermark: float = 0.9,
+                 sanitize: Optional[bool] = None):
         self.nbm = PA.blocks_per_row(max_seq, block_size)
         if num_blocks < self.nbm:
             raise ValueError(
@@ -318,7 +548,8 @@ class KVBlockPool:
         self.trash = num_blocks
         self.dtype = dtype
         self.allocator = BlockAllocator(num_blocks, block_size,
-                                        watermark=watermark)
+                                        watermark=watermark,
+                                        sanitize=sanitize)
         self.data = jnp.zeros(
             PA.pool_shape(n_layer, num_blocks, n_kv_head, block_size,
                           head_dim), dtype=dtype)
@@ -351,16 +582,81 @@ class KVBlockPool:
         self._scatter = jax.jit(_scatter_impl, donate_argnums=(0,))
         self._scatter_row = jax.jit(_scatter_one_rolled, donate_argnums=(0,))
         self._copy = jax.jit(_copy_impl, donate_argnums=(0,))
-        self._compile_watches = (
+        watches = [
             CompileWatch("kv_pool", self._gather),
             CompileWatch("kv_pool", self._scatter),
             CompileWatch("kv_pool", self._scatter_row),
-            CompileWatch("kv_pool", self._copy))
+            CompileWatch("kv_pool", self._copy)]
+        if self.allocator.sanitize:
+            # graftsan free-block poisoner: rewrite each freed block
+            # THROUGH the trash-block write path (the same copy mover
+            # CoW uses, one block per dispatch so the program shape is
+            # the existing [1]-id copy — no new compiled programs under
+            # GRAFTSAN beyond this instance's own jit). The content
+            # becomes trash-block garbage on device; the authoritative
+            # use-after-free TRAP is the host-side table validation in
+            # gather/scatter, which raises with the freeing site's
+            # provenance.
+            def _poison_impl(pool, src, dst):
+                return PA.copy_blocks(pool, src, dst)
+
+            self._poison = jax.jit(_poison_impl, donate_argnums=(0,))
+            self.allocator._on_free = self._graftsan_poison
+            watches.append(CompileWatch("kv_pool", self._poison))
+        self._compile_watches = tuple(watches)
+
+    # -- graftsan (GRAFTSAN=1) -----------------------------------------------
+
+    def _graftsan_poison(self, ids: List[int]) -> None:
+        """``BlockAllocator._on_free`` hook: poison each fully-freed
+        block by copying the trash block over it (fired outside the
+        allocator lock — see ``_notify_freed``)."""
+        trash = jnp.asarray([self.trash], jnp.int32)
+        with self._dev_lock:
+            for b in ids:
+                if self.allocator.refcount(b) > 0:
+                    continue  # re-allocated between free and poison
+                self.data = self._poison(self.data, trash,
+                                         jnp.asarray([b], jnp.int32))
+
+    def _graftsan_check_tables(self, tables, op: str,
+                               write: bool = False) -> None:
+        """Use-after-free trap: every table id a mover touches must be
+        the trash block or a live (refcount >= 1) allocation. A freed
+        id raises with the freeing site's provenance; a never-allocated
+        id is an uninitialized-placement bug. Writes (``write=True``)
+        additionally trap on SHARED blocks (refcount > 1): the module
+        contract is that writers never mutate a shared block — extension
+        into a shared frontier goes through ``cow_copy`` first."""
+        alloc = self.allocator
+        for b in {int(x) for x in np.asarray(tables).reshape(-1)}:
+            if b == self.trash:
+                continue
+            if not 0 <= b < alloc.num_blocks:
+                raise GraftsanError(
+                    f"{op} touches out-of-range block id {b} "
+                    f"(pool has {alloc.num_blocks} blocks)")
+            refs = alloc.refcount(b)
+            if refs == 0:
+                site = alloc.freed_provenance(b)
+                raise GraftsanError(
+                    f"use-after-free: {op} touches poisoned block {b}"
+                    + (f", freed at {site}" if site
+                       else ", which was never allocated"))
+            if write and refs > 1:
+                with alloc._lock:
+                    sites = list(alloc._san_owner.get(b, []))
+                raise GraftsanError(
+                    f"CoW violation: {op} writes shared block {b} "
+                    f"(refcount {refs}, granted at {sites}) without a "
+                    "private copy — shared blocks are immutable; "
+                    "cow_copy before the first write")
 
     @classmethod
     def for_engine(cls, engine: DecodeEngine, num_blocks: int,
                    block_size: int = DEFAULT_KV_BLOCK_SIZE,
-                   watermark: float = 0.9) -> "KVBlockPool":
+                   watermark: float = 0.9,
+                   sanitize: Optional[bool] = None) -> "KVBlockPool":
         """Build a pool matching an engine's cache geometry. The paged
         path drives the engine's OWN compiled programs on gathered
         views, so the engine must run the plain XLA single-device
@@ -383,7 +679,7 @@ class KVBlockPool:
         heads = getattr(cfg, "n_kv_head", cfg.n_head)
         return cls(cfg.n_layer, num_blocks, heads, block_size,
                    cfg.head_dim, engine._cache_seq, dtype=engine.dtype,
-                   watermark=watermark)
+                   watermark=watermark, sanitize=sanitize)
 
     # -- device ops (all under _dev_lock) ------------------------------------
 
@@ -392,11 +688,15 @@ class KVBlockPool:
         — downstream decode may donate it). ``length`` is the logical
         depth the caller tracks host-side."""
         with self._dev_lock:
+            if self.allocator.sanitize:
+                self._graftsan_check_tables(tables, "gather")
             k, v = self._gather(self.data, jnp.asarray(tables, jnp.int32))
         return KVCache(k=k, v=v, length=jnp.asarray(length, jnp.int32))
 
     def scatter(self, cache: KVCache, tables: np.ndarray) -> None:
         with self._dev_lock:
+            if self.allocator.sanitize:
+                self._graftsan_check_tables(tables, "scatter", write=True)
             self.data = self._scatter(self.data, cache.k, cache.v,
                                       jnp.asarray(tables, jnp.int32))
 
@@ -420,6 +720,8 @@ class KVBlockPool:
         into its blocks at logical ``[d - plen, d)`` (``roll = d - sp``,
         the iterbatch admission move)."""
         with self._dev_lock:
+            if self.allocator.sanitize:
+                self._graftsan_check_tables(table_row, "scatter_row", write=True)
             self.data = self._scatter_row(
                 self.data, cache.k, cache.v,
                 jnp.asarray(table_row, jnp.int32),
@@ -429,6 +731,8 @@ class KVBlockPool:
         """Copy-on-write: allocate a private block, copy ``src`` into
         it, and return the new id. The caller retargets its table entry
         and drops its own ref on ``src``."""
+        if self.allocator.sanitize:
+            self._graftsan_check_tables(np.asarray([src]), "cow_copy")
         dst = self.allocator.alloc(1)[0]
         with self._dev_lock:
             self.data = self._copy(self.data,
@@ -455,7 +759,8 @@ class KVBlockPool:
     def stats(self) -> dict:
         return {**self.allocator.stats().as_dict(),
                 "block_size": self.block_size,
-                "blocks_per_row": self.nbm}
+                "blocks_per_row": self.nbm,
+                "graftsan": self.allocator.sanitize}
 
 
 class PagedKVRunner:
@@ -522,10 +827,17 @@ class PagedKVRunner:
             tracing.record("prefill", t0, t1, batch=batch,
                            prompt_len=prompt_len, paged=True)
             self.pool.note_gauges(component="paged")
+            # columns below every row's shared-prefix floor hold
+            # IMMUTABLE registry blocks: decode never writes them, so
+            # the per-segment scatter narrows to the owned tail — same
+            # program key as the prefill placement's narrowed scatter,
+            # and the graftsan CoW trap stays precise (a write to a
+            # shared block is always a bug, never segment round-trip).
+            nb_lo = min((len(s) for s in shared), default=0)
             try:
                 return self._decode(run_params, ids, pad, first, tables,
                                     decode_key, max_new_tokens, sampling,
-                                    prompt_len, t1 - t0, eos_id)
+                                    prompt_len, t1 - t0, eos_id, nb_lo)
             finally:
                 for row_ids in owned:
                     alloc.free(row_ids)
@@ -602,7 +914,7 @@ class PagedKVRunner:
 
     def _decode(self, run_params, ids, pad, first, tables, decode_key,
                 max_new_tokens, sampling, prompt_len, prefill_seconds,
-                eos_id) -> GenerateResult:
+                eos_id, nb_lo: int = 0) -> GenerateResult:
         eng = self.engine
         pad_j = jnp.asarray(pad) if pad.any() else None
         t1 = time.perf_counter()
@@ -624,7 +936,7 @@ class PagedKVRunner:
                     run_params, token, working, pad_j,
                     step_keys[used:used + n], sampling=sampling,
                     window=window)
-                self.pool.scatter(working, tables)
+                self.pool.scatter_columns(working, tables, nb_lo)
                 token = out[:, -1]
                 parts.append(np.asarray(out))
                 depth += n

@@ -62,6 +62,18 @@ from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
 # silently miss).
 JIT_ENTRY_POINTS = ("_extend", "_extend_keep")
 
+# Donation contract (tools/graftcheck sanitize pass): ``_extend``
+# consumes its cache input (arg 1 — fresh caches and intermediate walk
+# states); ``_extend_keep`` deliberately does NOT (stored entries must
+# survive their first replay) and so declares nothing.
+DONATED_ARGS = {"_extend": (1,)}
+
+# Pool-mover lease scopes (tools/graftcheck sanitize pass): the store's
+# two pool touchpoints — both move only block ids they hold refs on
+# (the lookup's caller refs / the insert's fresh allocation).
+POOL_MOVER_SCOPES = ("PrefixCachingEngine._gather_entry",
+                     "PrefixCachingEngine._insert_pool")
+
 
 class PrefixCachingEngine:
     """Wraps a ``DecodeEngine`` with a chunk-aligned KV prefix cache.

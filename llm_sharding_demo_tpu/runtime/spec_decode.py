@@ -87,6 +87,13 @@ from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
 # certifier; an undeclared site is a lint finding.
 JIT_ENTRY_POINTS = ("_loop", "_loop_b", "_seg_b")
 
+# Donation contract (tools/graftcheck sanitize pass): consumed
+# positional arguments per entry point. ``_loop``/``_loop_b`` donate
+# the prefill cache (and the batched token buffer); ``_seg_b`` donates
+# the segment's token buffer and working cache — the iteration
+# scheduler must re-bind both from the call's outputs every segment.
+DONATED_ARGS = {"_loop": (2,), "_loop_b": (2, 3), "_seg_b": (1, 2)}
+
 # Block-handoff contract for pool-backed schedulers (see
 # ``_seg_b_impl``): True means a spec segment may rewrite ANY slot of a
 # row's cache (the re-sync roll), so paged storage must scatter whole

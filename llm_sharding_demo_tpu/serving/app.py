@@ -581,7 +581,18 @@ def create_app(cfg: Optional[ServingConfig] = None,
         if spec_runner is not None:  # speculation: live acceptance stats
             live["spec_decode_stats"] = spec_runner.stats()
         if kv_pool is not None:  # paged KV memory: allocator truth
-            live["kv_pool_stats"] = kv_pool.stats()
+            st = kv_pool.stats()
+            # Pool-stats conservation invariant (graftsan satellite):
+            # every block is free or referenced, never both or neither.
+            # Drift here means the allocator's accounting broke — turn
+            # it into a 500 (the handler's uncaught-exception path)
+            # instead of serving a silently wrong gauge.
+            if st["blocks_in_use"] + st["blocks_free"] != st["blocks_total"]:
+                raise AssertionError(
+                    "kv_pool_stats conservation violated: "
+                    f"{st['blocks_in_use']} in_use + {st['blocks_free']} "
+                    f"free != {st['blocks_total']} total")
+            live["kv_pool_stats"] = st
         return {
             **live,
             "status": "ok",

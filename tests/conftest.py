@@ -73,6 +73,7 @@ _QUICK_MODULES = {
     "test_tokenizer",       # offline BPE round-trips
     "test_graftcheck",      # static contract verifier + lint (whole-repo)
     "test_graftplan",       # cost model goldens + planner rankings
+    "test_graftsan",        # donation-aliasing pass + pool sanitizer
 }
 
 
@@ -107,6 +108,20 @@ def _metrics_isolation():
     with tracing.RECORDER._lock:
         tracing.RECORDER._traces.clear()
         tracing.RECORDER._traces.extend(saved)
+
+
+@pytest.fixture(autouse=True)
+def _graftsan_teardown_sweep():
+    """Under ``GRAFTSAN=1`` (the sanitizer tier — the whole quick tier
+    must run clean under it), every test ends with a leak sweep: any
+    live sanitizing BlockAllocator still holding caller refs beyond its
+    prefix entries fails the test with per-block grant provenance.
+    Block release can trail request delivery by a scheduler beat, so
+    the sweep polls briefly before declaring a leak."""
+    yield
+    if os.environ.get("GRAFTSAN", "") not in ("", "0"):
+        from llm_sharding_demo_tpu.runtime import kv_pool
+        kv_pool.graftsan_sweep(timeout=5.0)
 
 
 @pytest.fixture(autouse=True, scope="module")

@@ -11,6 +11,11 @@ Three layers of pinning:
 3. the recompile-budget certifier's static bound EQUALS the observed
    jit cache sizes for the workloads PR 1's compile-space tests pin —
    no looser, no tighter.
+
+The graftsan sanitize pass rides the same strict driver (a new
+undeclared-donation or aliasing finding anywhere in the tree fails
+``test_repo_passes_graftcheck``); its rule fixtures and the dynamic
+sanitizer live in tests/test_graftsan.py.
 """
 
 import json
@@ -60,6 +65,10 @@ def test_repo_passes_graftcheck():
         "baseline entries whose findings are gone — delete the lines: "
         f"{payload['stale_baseline']}")
     assert payload["semantic_checks"] >= 20, "semantic pass went vacuous"
+    assert payload["sanitize_checks"] >= 100, (
+        "graftsan sanitize pass went vacuous — a new undeclared "
+        "donation or aliasing finding anywhere in the tree fails this "
+        "strict run (see tests/test_graftsan.py for the rule fixtures)")
     assert payload["suppressed"] >= 1, (
         "the documented sync points should be baselined findings — an "
         "empty suppression set means the host-sync rule stopped seeing "

@@ -490,8 +490,9 @@ def test_verifier_json_schema_shape():
     payload = cli.run(lint_only=True)
     assert set(payload) == {"ok", "strict", "findings", "suppressed",
                             "stale_baseline", "semantic_checks",
-                            "recompile_bounds"}
+                            "sanitize_checks", "recompile_bounds"}
     assert isinstance(payload["ok"], bool)
+    assert isinstance(payload["sanitize_checks"], int)
     assert isinstance(payload["strict"], bool)
     assert isinstance(payload["findings"], list)
     assert isinstance(payload["suppressed"], int)

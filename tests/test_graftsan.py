@@ -1,0 +1,431 @@
+"""graftsan: donation-aliasing static pass + KV-pool memory sanitizer.
+
+Three layers of pinning (ISSUE 7 tentpole):
+
+1. **Static pass fixtures** — deliberately broken modules each produce
+   a failing finding with file:line: undeclared/stale/mismatched
+   DONATED_ARGS, host view of a to-be-donated value, donated-buffer
+   re-read, pool mover outside a lease scope, and the HISTORICAL PR 5
+   ``_SegOut`` bug shape (np.asarray snapshot of a buffer a later
+   segment donates) — reverted in a fixture, it must be a finding; the
+   shipped owning-copy form must be silent.
+2. **Dynamic sanitizer fixtures** — seeded memory-safety bugs each trap
+   as exactly one ``GraftsanError`` with provenance: double-free,
+   leaked block at teardown, use-after-free gather on a poisoned
+   block, CoW write to a shared block, refcount-conservation drift.
+3. **Integration** — paged decode (solo runner, pool-backed prefix
+   store, iterbatch preempt/resume) stays byte-equal to contiguous
+   with the sanitizer armed and sweeps clean at teardown; /healthz
+   enforces the pool-stats conservation invariant (500 on drift) and
+   reports sanitizer status.
+"""
+
+import os
+import textwrap
+
+import jax
+import numpy as np
+import pytest
+
+from llm_sharding_demo_tpu.models import gpt2
+from llm_sharding_demo_tpu.runtime.engine import DecodeEngine, SamplingConfig
+from llm_sharding_demo_tpu.runtime.kv_pool import (BlockAllocator,
+                                                   GraftsanError,
+                                                   KVBlockPool,
+                                                   PagedKVRunner,
+                                                   graftsan_sweep)
+from tools.graftcheck import sanitize
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- 1. static pass: broken fixtures produce findings with file:line ---------
+
+
+def _sanitize_fixture(tmp_path, relpath: str, source: str):
+    p = tmp_path / relpath
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    findings, _ = sanitize.run_sanitize(str(tmp_path), paths=[str(p)])
+    return findings
+
+
+def test_fixture_undeclared_and_stale_and_mismatched_donation(tmp_path):
+    got = _sanitize_fixture(tmp_path, "runtime/mod.py", """\
+        import jax
+
+        DONATED_ARGS = {"_gone": (0,), "_wrong": (1,)}
+
+
+        class E:
+            def __init__(self):
+                self._undeclared = jax.jit(self._f, donate_argnums=(1,))
+                self._wrong = jax.jit(self._f, donate_argnums=(2,))
+
+            def _f(self, a, b, c):
+                return b
+        """)
+    msgs = [(f.line, f.message) for f in got
+            if f.rule == "undeclared-donation"]
+    assert len(msgs) == 3
+    assert any("'_undeclared' missing" in m for _, m in msgs)
+    assert any("'_wrong' donating (1,)" in m and "(2,)" in m
+               for _, m in msgs)
+    assert any("'_gone'" in m and "stale" in m for _, m in msgs)
+    assert all(f.path == "runtime/mod.py" for f in got)
+
+
+def test_fixture_donated_view_and_reuse(tmp_path):
+    got = _sanitize_fixture(tmp_path, "runtime/mod.py", """\
+        import jax
+        import numpy as np
+
+        DONATED_ARGS = {"_step": (1,)}
+
+
+        class E:
+            def __init__(self):
+                self._step = jax.jit(self._impl, donate_argnums=(1,))
+
+            def _impl(self, params, cache):
+                return cache
+
+            def bad(self, params, cache):
+                view = np.asarray(cache)          # line 15: view ...
+                out = self._step(params, cache)   # line 16: ... donated
+                depth = cache.shape               # line 17: reused
+                return view, out, depth
+
+            def good(self, params, cache):
+                snap = np.array(cache, copy=True)
+                cache = self._step(params, cache)
+                return snap, cache
+        """)
+    views = [f for f in got if f.rule == "donated-view"]
+    reuses = [f for f in got if f.rule == "donated-reuse"]
+    assert len(views) == 1 and views[0].line == 15
+    assert views[0].scope == "E.bad"
+    assert "donated at line 16" in views[0].message
+    assert len(reuses) == 1 and reuses[0].line == 17
+    assert "donated at line 16" in reuses[0].message
+    # the owning-copy / rebind pattern in good() is silent
+    assert all(f.scope != "E.good" for f in got)
+
+
+def test_fixture_pr5_segout_shape_must_find(tmp_path):
+    """THE historical bug (PR 5 satellite 6a), reverted in a fixture:
+    ``_SegOut`` snapshots with ``np.asarray`` (zero-copy view on the
+    CPU backend) and the NEXT spec segment donates ``state.buf`` — the
+    parked row's tokens silently roll over. The sink-class analysis
+    must flag the ``_SegOut(buf)`` construction."""
+    src = """\
+        import jax
+        import numpy as np
+
+        DONATED_ARGS = {"_seg_b": (1,)}
+
+
+        class _SegOut:
+            def __init__(self, arr):
+                self.arr = arr
+
+            @property
+            def np(self):
+                return {SNAPSHOT}
+
+
+        class Scheduler:
+            def __init__(self):
+                self._seg_b = jax.jit(self._seg_impl, donate_argnums=(1,))
+
+            def _seg_impl(self, params, buf):
+                return buf + 1
+
+            def _advance_spec(self, state, params):
+                buf = self._seg_b(params, state.buf)
+                state.buf = buf
+                seg = _SegOut(buf)
+                return seg
+        """
+    reverted = _sanitize_fixture(
+        tmp_path, "runtime/reverted.py",
+        src.replace("{SNAPSHOT}", "np.asarray(self.arr)"))
+    views = [f for f in reverted if f.rule == "donated-view"]
+    assert len(views) == 1
+    assert views[0].path == "runtime/reverted.py"
+    assert views[0].scope == "Scheduler._advance_spec"
+    assert "_SegOut(...)" in views[0].message
+    assert "donated" in views[0].message
+
+    # the PR 5 FIX (owning host copy) must be silent
+    fixed = _sanitize_fixture(
+        tmp_path, "runtime/fixed.py",
+        src.replace("{SNAPSHOT}", "np.array(self.arr, copy=True)"))
+    assert [f for f in fixed if f.rule == "donated-view"] == []
+
+
+def test_fixture_pool_mover_outside_lease_scope(tmp_path):
+    got = _sanitize_fixture(tmp_path, "runtime/sched.py", """\
+        POOL_MOVER_SCOPES = ("S.good", "S.stale")
+
+
+        class S:
+            def __init__(self, pool):
+                self.pool = pool
+
+            def good(self, tables):
+                return self.pool.gather(tables, 4)
+
+            def rogue(self, tables):
+                self.pool.scatter(None, tables)
+        """)
+    hits = [f for f in got if f.rule == "pool-lease"]
+    assert len(hits) == 2
+    rogue = next(f for f in hits if f.scope == "S.rogue")
+    assert rogue.line == 12 and "pool.scatter" in rogue.message
+    stale = next(f for f in hits if "stale" in f.message)
+    assert "'S.stale'" in stale.message
+
+
+def test_repo_sanitize_pass_is_clean_and_declarations_resolve():
+    """The production tree passes the new pass with zero findings (no
+    suppressions needed), and the declared donation map actually
+    resolves the runtime's donating callables."""
+    findings, checks = sanitize.run_sanitize(REPO)
+    assert findings == [], "\n".join(f.format() for f in findings)
+    assert checks >= 100
+    mods = []
+    import tools.graftcheck.lint as L
+    for rel in ("llm_sharding_demo_tpu/runtime/engine.py",
+                "llm_sharding_demo_tpu/runtime/kv_pool.py",
+                "llm_sharding_demo_tpu/runtime/spec_decode.py",
+                "llm_sharding_demo_tpu/runtime/iterbatch.py",
+                "llm_sharding_demo_tpu/runtime/prefix_cache.py"):
+        mod = L.index_module(os.path.join(REPO, rel), REPO)
+        declared, _ = sanitize.declared_donations(mod)
+        assert declared, f"{rel} declares no DONATED_ARGS"
+        mods.append(mod)
+    donating = sanitize._donating_map(mods)
+    assert donating["_decode_seg"] == {2}
+    assert donating["_seg_b"] == {1, 2}
+    assert donating["_scatter"] == {0}
+
+
+# -- 2. dynamic sanitizer: seeded bugs trap with provenance ------------------
+
+
+CFG = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_embd=16,
+                      n_layer=2, n_head=2)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    params = jax.tree.map(lambda x: x * 4.0,
+                          gpt2.init_params(CFG, jax.random.PRNGKey(0)))
+    return DecodeEngine(params, CFG, max_seq=32)
+
+
+def _san_pool(engine, num_blocks=8, block_size=8) -> KVBlockPool:
+    """A pool with the sanitizer armed EXPLICITLY — these tests pin the
+    traps whether or not the suite itself runs under GRAFTSAN=1."""
+    pool = KVBlockPool.for_engine(engine, num_blocks=num_blocks,
+                                  block_size=block_size, sanitize=True)
+    assert pool.allocator.sanitize
+    return pool
+
+
+def test_seeded_double_free_traps_with_provenance():
+    a = BlockAllocator(8, 8, sanitize=True)
+    ids = a.alloc(2)
+    a.free(ids)
+    with pytest.raises(GraftsanError, match="double-free of block"):
+        a.free([ids[0]])
+    try:
+        a.free([ids[0]])
+    except GraftsanError as e:
+        msg = str(e)
+        assert "previously freed at" in msg
+        assert "test_graftsan.py" in msg          # file:line provenance
+    # the sanitizer error still honors the documented ValueError contract
+    with pytest.raises(ValueError):
+        a.free([ids[0]])
+
+
+def test_seeded_leak_reports_owner_provenance_at_teardown():
+    a = BlockAllocator(8, 8, sanitize=True)
+    leaked = a.alloc(1)
+    report = a.graftsan_report()
+    assert len(report) == 1
+    assert report[0]["block"] == leaked[0]
+    assert report[0]["leaked_refs"] == 1
+    assert any("test_graftsan.py" in s for s in report[0]["grant_sites"])
+    with pytest.raises(GraftsanError, match="teardown leak"):
+        a.graftsan_assert_quiesced(timeout=0.05)
+    a.free(leaked)
+    a.graftsan_assert_quiesced(timeout=0.05)      # clean after release
+    # prefix-entry refs are NOT leaks (the store legitimately holds them)
+    ids = a.alloc(2)
+    a.register_prefix(b"k", ids)
+    a.free(ids)
+    a.graftsan_assert_quiesced(timeout=0.05)
+
+
+def test_seeded_use_after_free_gather_traps_with_freeing_site(engine):
+    pool = _san_pool(engine)
+    row = pool.allocator.alloc(2)
+    tables = np.full((1, 4), pool.trash, np.int32)
+    tables[0, :2] = row
+    pool.gather(tables, 8)                        # live: fine
+    pool.allocator.free(row)                      # poisons the blocks
+    with pytest.raises(GraftsanError) as exc:
+        pool.gather(tables, 8)
+    msg = str(exc.value)
+    assert "use-after-free" in msg and "poisoned block" in msg
+    assert "freed at" in msg and "test_graftsan.py" in msg
+
+
+def test_seeded_cow_write_to_shared_block_traps(engine):
+    pool = _san_pool(engine)
+    row = pool.allocator.alloc(1)
+    pool.allocator.ref(row)                       # refcount 2: shared
+    tables = np.full((1, 4), pool.trash, np.int32)
+    tables[0, 0] = row[0]
+    cache = pool.gather(tables, 8)                # reads stay legal
+    with pytest.raises(GraftsanError, match="CoW violation"):
+        pool.scatter(cache, tables)
+    # after cow_copy the private copy is writable
+    private = pool.cow_copy(row[0])
+    tables[0, 0] = private
+    pool.scatter(cache, tables)
+    pool.allocator.free(row)
+    pool.allocator.free(row)
+    pool.allocator.free([private])
+
+
+def test_seeded_refcount_conservation_drift_traps():
+    a = BlockAllocator(8, 8, sanitize=True)
+    ids = a.alloc(2)
+    a._ref[ids[0]] += 1       # corrupt the accounting behind the API
+    with pytest.raises(GraftsanError, match="conservation"):
+        a.can_admit(1)
+    a._ref[ids[0]] -= 1
+    a.free(ids)
+
+
+def test_poison_rides_the_trash_copy_path_not_the_cow_program(engine):
+    """Poisoning reuses the dedicated ``_poison`` jit (same copy_blocks
+    impl, per-instance program) — the certified ``_copy`` program count
+    for plain paged workloads stays zero under GRAFTSAN."""
+    pool = _san_pool(engine)
+    ids = pool.allocator.alloc(2)
+    pool.allocator.free(ids)                      # fires the poisoner
+    assert pool._copy._cache_size() == 0
+    assert pool._poison._cache_size() >= 1
+    # freed-then-reallocated blocks are live again: gather must accept
+    again = pool.allocator.alloc(2)
+    tables = np.full((1, 4), pool.trash, np.int32)
+    tables[0, :2] = again
+    pool.gather(tables, 8)
+    pool.allocator.free(again)
+
+
+# -- 3. integration: the paged stack runs clean under the sanitizer ----------
+
+
+def test_paged_decode_byte_equal_with_sanitizer_armed(engine):
+    pool = _san_pool(engine, num_blocks=12)
+    runner = PagedKVRunner(engine, pool)
+    prompt = np.arange(1, 9, dtype=np.int32)
+    paged = runner.generate(prompt, 10)
+    plain = engine.generate(prompt, 10)
+    assert np.array_equal(paged.tokens, plain.tokens)
+    st = pool.stats()
+    assert st["graftsan"] is True
+    assert st["blocks_in_use"] + st["blocks_free"] == st["blocks_total"]
+    pool.allocator.graftsan_assert_quiesced(timeout=1.0)
+
+
+def test_prefix_store_sharing_and_eviction_clean_under_sanitizer(engine):
+    from llm_sharding_demo_tpu.runtime.prefix_cache import \
+        PrefixCachingEngine
+    pool = _san_pool(engine, num_blocks=12)
+    prefix = PrefixCachingEngine(engine, capacity=2, chunk=8, pool=pool)
+    runner = PagedKVRunner(engine, pool, prefix=prefix)
+    rng = np.random.default_rng(5)
+    base = rng.integers(1, CFG.vocab_size, size=(17,)).astype(np.int32)
+    cold = runner.generate(base, 6)
+    warm = runner.generate(base, 6)               # store hit, CoW frontier
+    assert np.array_equal(cold.tokens, warm.tokens)
+    # churn the registry so LRU eviction frees (and poisons) blocks
+    for i in range(3):
+        p = rng.integers(1, CFG.vocab_size, size=(17,)).astype(np.int32)
+        runner.generate(p, 4)
+    again = runner.generate(base, 6)              # may re-prefill: exact
+    assert np.array_equal(cold.tokens, again.tokens)
+    pool.allocator.graftsan_assert_quiesced(timeout=1.0)
+
+
+def test_iterbatch_preemption_resume_clean_under_sanitizer(engine):
+    """The full hazard gauntlet — admission placement, growth, LRU
+    eviction, preemption frees, recompute-resume — byte-identical to
+    the contiguous stream with every sanitizer trap armed, and zero
+    leaks at quiesce."""
+    from llm_sharding_demo_tpu.runtime.iterbatch import IterBatchingEngine
+    import threading
+    pool = _san_pool(engine, num_blocks=8, block_size=8)
+    ib = IterBatchingEngine(engine, max_batch=4, seg_steps=8,
+                            max_wait_ms=40.0, pool=pool)
+    prompt = np.asarray([5, 17, 3, 42, 9, 2, 11, 7], np.int32)
+    want = engine.generate(prompt, 20).tokens[0]
+    outs = [None] * 3
+    def run(i):
+        outs[i] = ib.generate(prompt, 20, timeout=120).tokens[0]
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for got in outs:
+        assert np.array_equal(got, want)
+    pool.allocator.graftsan_assert_quiesced(timeout=5.0)
+    graftsan_sweep(timeout=5.0)
+
+
+# -- /healthz: pool-stats invariant + sanitizer status (satellite) -----------
+
+
+def _pool_app():
+    from llm_sharding_demo_tpu.serving.app import create_app
+    from llm_sharding_demo_tpu.serving.http import TestClient
+    from llm_sharding_demo_tpu.serving.tokenizer import ByteTokenizer
+    from llm_sharding_demo_tpu.utils.config import ServingConfig
+    config = gpt2.GPT2Config(vocab_size=256, n_positions=64, n_embd=32,
+                             n_layer=2, n_head=4)
+    model = (config, gpt2.init_params(config, jax.random.PRNGKey(0)))
+    cfg = ServingConfig(model_id="test", shard_role="coordinator",
+                        max_seq=64, boundaries=(1,), kv_pool_blocks=16,
+                        kv_block_size=8)
+    return TestClient(create_app(cfg, model=model,
+                                 tokenizer=ByteTokenizer()))
+
+
+def test_healthz_pool_stats_conservation_invariant(monkeypatch):
+    client = _pool_app()
+    h = client.get("/healthz")
+    assert h.status_code == 200
+    st = h.json()["kv_pool_stats"]
+    assert st["blocks_in_use"] + st["blocks_free"] == st["blocks_total"]
+    assert "graftsan" in st                       # sanitizer status
+    # seed gauge drift: the handler must answer 500, not serve the lie
+    from llm_sharding_demo_tpu.runtime.kv_pool import KVBlockPool
+    real = KVBlockPool.stats
+
+    def drifted(self):
+        out = real(self)
+        out["blocks_in_use"] += 1
+        return out
+
+    monkeypatch.setattr(KVBlockPool, "stats", drifted)
+    r = client.get("/healthz")
+    assert r.status_code == 500
+    assert "conservation" in r.json()["detail"]

@@ -74,11 +74,16 @@ def test_allocator_alloc_free_refcount():
 
 def test_allocator_all_or_nothing_and_exhaustion():
     a = BlockAllocator(num_blocks=4, block_size=BS)
-    a.alloc(3)
+    first = a.alloc(3)
     with pytest.raises(PoolExhausted):
         a.alloc(2)                    # nothing taken on failure
     assert a.stats().blocks_free == 1
-    assert a.alloc(1)
+    last = a.alloc(1)
+    assert last
+    # release everything: under GRAFTSAN=1 the suite's teardown sweep
+    # reports still-held caller refs as leaks (with provenance)
+    a.free(first)
+    a.free(last)
 
 
 def test_allocator_prefix_sharing_and_lru_eviction():
@@ -101,7 +106,7 @@ def test_allocator_prefix_sharing_and_lru_eviction():
     # exhaustion evicts LRU-first (p2: registered later but p1 was
     # looked up last). Evicting p2 frees only ids2 — ids1 stays alive
     # through p1's refs (shared blocks survive their entry's eviction).
-    a.alloc(6)
+    ids6 = a.alloc(6)
     st = a.stats()
     assert st.prefix_entries == 1 and st.evictions == 1
     assert st.blocks_in_use == 8 and st.blocks_free == 0
@@ -110,6 +115,7 @@ def test_allocator_prefix_sharing_and_lru_eviction():
     with pytest.raises(PoolExhausted):
         a.alloc(3)                    # even evicting p1 yields only 2
     assert a.stats().evictions == 2 and a.stats().prefix_entries == 0
+    a.free(ids6)                      # GRAFTSAN teardown-sweep hygiene
 
 
 def test_allocator_watermark_admission():

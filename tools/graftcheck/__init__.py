@@ -17,6 +17,14 @@ driver ``tests/test_graftcheck.py``):
   reads under jit, metrics/tracing calls under jit (silent no-ops), and
   the metric-name catalog (the former ``tools/check_metrics.py``, now a
   rule here).
+- **Pass 3 — graftsan sanitize** (``sanitize``): donation-aliasing
+  rules — ``DONATED_ARGS`` declaration consistency (the undeclared-jit
+  idiom for ``donate_argnums``), host views of values that flow into
+  donated arguments (the PR 5 ``_SegOut`` bug shape), donated-buffer
+  re-reads, and pool movers outside declared ``POOL_MOVER_SCOPES``
+  lease scopes. Its dynamic half (``GRAFTSAN=1`` — poisoning,
+  refcount conservation, leak provenance) lives in
+  ``runtime.kv_pool``.
 
 Findings are suppressed per (rule, file, scope) by
 ``tools/graftcheck/baseline.txt`` — one line per intentional keep, with

@@ -37,7 +37,8 @@ def _repo_root() -> str:
 
 def run(root: str = None, lint_only: bool = False,
         baseline_path: str = None, strict: bool = False) -> dict:
-    """Both passes -> one JSON-able payload. Import-light until called;
+    """All passes (lint + graftsan sanitize + semantic) -> one JSON-able
+    payload. Import-light until called;
     the semantic pass imports jax (CPU stand-ins only). ``strict``
     fails the run on stale baseline entries too (the in-suite driver
     runs strict so CI catches dead suppressions; the standalone default
@@ -50,8 +51,10 @@ def run(root: str = None, lint_only: bool = False,
     if added:
         sys.path.insert(0, root)
     try:
-        from . import lint
+        from . import lint, sanitize
         findings = list(lint.run_lint(root))
+        san, sanitize_checks = sanitize.run_sanitize(root)
+        findings.extend(san)
         semantic_checks = 0
         bounds = {}
         if not lint_only:
@@ -90,6 +93,7 @@ def run(root: str = None, lint_only: bool = False,
         "stale_baseline": sorted("::".join(k[1:]) + f" [{k[0]}]"
                                  for k in stale),
         "semantic_checks": semantic_checks,
+        "sanitize_checks": sanitize_checks,
         "recompile_bounds": bounds,
     }
 
@@ -242,7 +246,8 @@ def main(argv=None) -> int:
         n = len(payload["findings"])
         print(f"graftcheck: {n} active finding(s), "
               f"{payload['suppressed']} baselined, "
-              f"{payload['semantic_checks']} semantic checks"
+              f"{payload['semantic_checks']} semantic checks, "
+              f"{payload['sanitize_checks']} sanitize checks"
               + ("" if args.lint_only else
                  f", recompile bounds for {len(payload['recompile_bounds'])}"
                  " workload(s)"))

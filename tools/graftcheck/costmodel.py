@@ -376,6 +376,21 @@ def comm_bytes_program(fn, args, mesh_axes: Dict[str, int]) -> int:
 # -- topology collective-schedule programs -----------------------------------
 
 
+def pp_decode_step_program(n_stages: int, batch: int = 1,
+                           module=None, config=None, mesh=None) -> tuple:
+    """THE pp decode-step (fn, args) selection off
+    ``semantic.build_ppdecode_programs`` — shared by the cost model's
+    byte walk and bench.py's ICI calibration row (which compiles the
+    same step on a concrete mesh), so the program being priced and the
+    program being measured cannot drift apart."""
+    from . import semantic
+    rows = [r for r in semantic.build_ppdecode_programs(
+        n_stages, batch=batch, module=module, config=config, mesh=mesh)
+        if r[0].endswith("decode-step")]
+    (_label, _scope, fn, args), = rows
+    return fn, args
+
+
 def pp_decode_comm_bytes(n_stages: int, batch: int = 1,
                          module=None, config=None) -> int:
     """Comm bytes of ONE pipelined decode token: the real
@@ -385,11 +400,8 @@ def pp_decode_comm_bytes(n_stages: int, batch: int = 1,
     scored (omitted: the registry gpt2 stand-in) — the handoff bytes
     scale with THAT model's hidden width, so pricing the stand-in
     would bias pp against tp/ep on any real config."""
-    from . import semantic
-    rows = [r for r in semantic.build_ppdecode_programs(
-        n_stages, batch=batch, module=module, config=config)
-        if r[0].endswith("decode-step")]
-    (label, scope, fn, args), = rows
+    fn, args = pp_decode_step_program(n_stages, batch=batch,
+                                      module=module, config=config)
     return comm_bytes_program(fn, args, {"pp": n_stages})
 
 

@@ -183,8 +183,10 @@ def paged_runner_keys(desc: EngineDesc, paged: PagedDesc,
       tables and block ids are traced operands, so PLACEMENT never
       keys anything;
     - ``_scatter`` additionally mints one program per shared-prefix
-      column offset (the narrower owned-tail view after a store hit);
-      plain runs stay on the full-width key;
+      column offset (the narrower owned-tail view after a store hit —
+      placement AND the decode loop's per-segment write-back both use
+      it: shared registry blocks are immutable, so decode scatters only
+      the owned columns); plain runs stay on the full-width key;
     - ``_scatter_row``/``_copy``: admission/CoW movers — unused by a
       plain generate (the iteration scheduler and prefix sharing mint
       them), so their bound here is zero.

@@ -495,7 +495,8 @@ def check_overlap_jaxpr(jaxpr, where: str, path: str,
 
 def build_ppdecode_programs(n_stages: int, batch: int = 1, seq: int = 8,
                             max_seq: int = 32, family: str = "gpt2",
-                            module=None, config=None) -> List[tuple]:
+                            module=None, config=None,
+                            mesh=None) -> List[tuple]:
     """Trace the REAL ``PipelinedDecoder._pp_blocks`` step (the manual
     pipeline program both compiled phases run) over an ``AbstractMesh``
     stand-in — zero devices, zero compile. Returns ``(label, scope, fn,
@@ -508,7 +509,11 @@ def build_ppdecode_programs(n_stages: int, batch: int = 1, seq: int = 8,
     ``module``/``config`` override the registry stand-in — the cost
     model passes the config actually being scored so the priced
     activations are that model's, not the tiny stand-in's; the overlap
-    lint keeps the stand-ins (the property is shape-independent)."""
+    lint keeps the stand-ins (the property is shape-independent).
+    ``mesh`` overrides the AbstractMesh stand-in with a CONCRETE mesh:
+    bench.py's ICI calibration row compiles the returned decode step on
+    real devices and compares the executable's measured comm bytes
+    against the cost model's walk of the same jaxpr."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import AbstractMesh
@@ -532,7 +537,8 @@ def build_ppdecode_programs(n_stages: int, batch: int = 1, seq: int = 8,
     specs = Pt.make_stage_specs(config.n_layer, bounds)
     dec = PipelinedDecoder.__new__(PipelinedDecoder)
     dec.config = config
-    dec.mesh = AbstractMesh((("pp", n_stages),))
+    dec.mesh = mesh if mesh is not None \
+        else AbstractMesh((("pp", n_stages),))
     dec.max_seq = max_seq
     dec.pp_axis = "pp"
     dec.n_stages = n_stages
