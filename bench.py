@@ -778,6 +778,107 @@ def measure_paged_kv(config, dtype="bfloat16", steps: int = 192,
     }
 
 
+def measure_concurrent_load(config, dtype="bfloat16", width: int = 6,
+                            steps: int = 96, prompt_len: int = 48,
+                            block_size: int = 16) -> dict:
+    """Concurrent-load latency + lock-contention row (ISSUE 8): ``width``
+    (>= 4) simultaneous clients through the pooled iteration scheduler,
+    with every declared lock constructed as an instrumented graftsched
+    ``TracedLock`` in accounting-only mode (``GRAFTSCHED=trace``: wait
+    totals, no schedule perturbation). Journals per-request p50/p99
+    latency AND the per-lock contention totals — so a change that makes
+    the host-side scheduler serialize on a blocked lock (exactly the
+    stall TokenWeave-style overlap cannot absorb, ROADMAP item 3) shows
+    up in the same trajectory as the latencies it causes.
+
+    Needs the bench chip: CPU decode rates make queueing, not locking,
+    the bottleneck, and the contention split would mislead.
+    """
+    import threading as _th
+
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return {"skipped": "concurrent-load lock contention needs the "
+                           "bench chip (on CPU the decode itself "
+                           "dominates and the wait split is noise)"}
+
+    from llm_sharding_demo_tpu.models import gpt2
+    from llm_sharding_demo_tpu.runtime.engine import DecodeEngine
+    from llm_sharding_demo_tpu.runtime.iterbatch import IterBatchingEngine
+    from llm_sharding_demo_tpu.runtime.kv_pool import KVBlockPool
+    from llm_sharding_demo_tpu.utils import graftsched
+
+    from llm_sharding_demo_tpu.utils import metrics as _metrics
+    from llm_sharding_demo_tpu.utils import tracing as _tracing
+
+    prior = os.environ.get("GRAFTSCHED")
+    os.environ["GRAFTSCHED"] = "trace"    # accounting only, no yields
+    # the module-singleton registry/recorder locks were constructed at
+    # import time (before the env was armed) — re-wrap them so their
+    # contention is measured too. Safe here: this row runs before its
+    # own threads start, and prior rows' worker threads are idle in
+    # queue.get (no REGISTRY call in flight).
+    reg_lock, rec_lock = _metrics.REGISTRY._lock, _tracing.RECORDER._lock
+    _metrics.REGISTRY._lock = graftsched.lock(
+        "metrics.MetricsRegistry._lock")
+    _tracing.RECORDER._lock = graftsched.lock(
+        "tracing.FlightRecorder._lock")
+    try:
+        graftsched.clear()
+        params = gpt2.init_params(config, jax.random.PRNGKey(0))
+        bucketed = (prompt_len + 15) // 16 * 16
+        max_seq = min(config.n_positions, bucketed + 2 * steps)
+        engine = DecodeEngine(params, config, max_seq=max_seq,
+                              dtype=dtype)
+        nbm = -(-max_seq // block_size)
+        pool = KVBlockPool.for_engine(engine, num_blocks=width * nbm,
+                                      block_size=block_size)
+        ib = IterBatchingEngine(engine, max_batch=width, seg_steps=32,
+                                max_wait_ms=20.0, pool=pool)
+        rng = np.random.default_rng(7)
+        prompt = rng.integers(0, config.vocab_size, size=(prompt_len,))
+        ib.generate(prompt, steps, timeout=600)       # warmup/compile
+
+        lat = [0.0] * width
+
+        def run_one(i):
+            t0 = time.perf_counter()
+            ib.generate(prompt, steps, timeout=600)
+            lat[i] = time.perf_counter() - t0
+
+        graftsched.clear()                # contention for the run only
+        threads = [_th.Thread(target=run_one, args=(i,))
+                   for i in range(width)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        cont = graftsched.contention()
+        return {
+            "width": width,
+            "steps_per_request": steps,
+            "p50_request_latency_ms": round(
+                float(np.percentile(lat, 50)) * 1e3, 1),
+            "p99_request_latency_ms": round(
+                float(np.percentile(lat, 99)) * 1e3, 1),
+            "aggregate_tokens_per_sec": round(width * steps / wall, 1),
+            "lock_contention": cont,
+            "lock_wait_total_ms": round(
+                sum(v["wait_seconds"] for v in cont.values()) * 1e3, 2),
+            "findings": [f.format() for f in graftsched.findings()],
+        }
+    finally:
+        _metrics.REGISTRY._lock = reg_lock
+        _tracing.RECORDER._lock = rec_lock
+        if prior is None:
+            os.environ.pop("GRAFTSCHED", None)
+        else:
+            os.environ["GRAFTSCHED"] = prior
+
+
 def measure_spec_iterbatch(config, dtype="bfloat16", n_requests: int = 8,
                            max_batch: int = 4, steps: int = 160,
                            prompt_len: int = 64, stagger_s: float = 0.04,
@@ -1270,6 +1371,9 @@ def main() -> None:
             "suppressed": payload["suppressed"],
             "stale_baseline": payload["stale_baseline"],
             "semantic_checks": payload["semantic_checks"],
+            "sanitize_checks": payload["sanitize_checks"],
+            "locks_checks": payload["locks_checks"],
+            "locks_vacuous": payload["locks_vacuous"],
             "recompile_bounds": payload["recompile_bounds"],
         }
 
@@ -1636,10 +1740,23 @@ def main() -> None:
                     "the bench chip",
         }
 
+    def cfg_concurrent_load():
+        return {
+            **measure_concurrent_load(g124),
+            "note": "width >= 4 concurrent clients through the pooled "
+                    "iteration scheduler with graftsched-instrumented "
+                    "locks (GRAFTSCHED=trace): p50/p99 request latency "
+                    "+ per-lock wait totals — a scheduler serializing "
+                    "on a blocked lock lands here before it lands in "
+                    "the throughput rows; skip-with-reason off the "
+                    "bench chip",
+        }
+
     safe("cfg2_gpt2_124m_2shard_single_prompt", cfg2)
     safe("cfg3_gpt2_124m_bs8", cfg3)
     safe("cfg11_iterbatch_staggered_arrivals", cfg11)
     safe("cfg14_paged_kv_vs_contiguous", cfg14)
+    safe("concurrent_load", cfg_concurrent_load)
     safe("cfg4_gpt2_medium_4shard", cfg4)
     safe("cfg5_kv_cache_vs_on2", cfg5)
     safe("cfg6_moe_8e_top2_124m_geometry", cfg6)

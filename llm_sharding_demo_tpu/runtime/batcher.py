@@ -50,7 +50,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils import tracing
+from ..utils import graftsched, tracing
 from ..utils.metrics import REGISTRY, kv_block_gauges
 from .engine import DecodeEngine, GenerateResult, SamplingConfig
 
@@ -60,6 +60,16 @@ from .engine import DecodeEngine, GenerateResult, SamplingConfig
 # finding (a compiled-program population the recompile budget would
 # silently miss).
 JIT_ENTRY_POINTS = ("_merge",)
+
+# Lock-discipline contract (tools/graftcheck locks pass): the round
+# counters and the held queue head live under ``_stats_lock``.
+# ``_pending`` is worker-written, but it shares a name (and a role)
+# with the iteration scheduler's cross-thread head — one discipline for
+# both, so the declared contract can never silently diverge.
+GUARDED_STATE = {"batches_run": "_stats_lock",
+                 "rows_served": "_stats_lock",
+                 "_pending": "_stats_lock"}
+LOCK_ORDER = ("_stats_lock",)
 
 
 def _round_up(n: int, multiple: int) -> int:
@@ -138,7 +148,8 @@ class BatchingEngine:
         self._merge = jax.jit(self._merge_impl)
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._pending: Optional[_Request] = None  # held head of next round
-        self._stats_lock = threading.Lock()
+        self._stats_lock = graftsched.lock(
+            "batcher.BatchingEngine._stats_lock")
         self.batches_run = 0
         self.rows_served = 0
         self._worker = threading.Thread(target=self._loop, daemon=True)
@@ -209,8 +220,10 @@ class BatchingEngine:
         request ends the round and is HELD as the next round's first
         request — re-queueing it at the tail would let sustained traffic
         of another policy starve it forever."""
-        first = self._pending or self._queue.get()
-        self._pending = None
+        with self._stats_lock:
+            first, self._pending = self._pending, None
+        if first is None:
+            first = self._queue.get()
         batch = [first]
         if (first.sampling.mode != "greedy" and self.prefix is not None
                 and getattr(self.prefix, "_spec", None) is not None):
@@ -235,7 +248,8 @@ class BatchingEngine:
             if nxt.sampling == first.sampling:
                 batch.append(nxt)
             else:
-                self._pending = nxt
+                with self._stats_lock:
+                    self._pending = nxt
                 break
         return batch
 

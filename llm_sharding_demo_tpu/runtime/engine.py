@@ -655,8 +655,11 @@ class DecodeEngine:
         (and by the iteration scheduler after its segment dispatches)."""
         for w in self._compile_watches:
             w.check()
+        # w.seen() (locked read): CompileWatch._seen is declared guarded
+        # state, and solo engines are driven straight from concurrent
+        # server handler threads
         REGISTRY.gauge("jit_program_cache_size",
-                       sum(w._seen for w in self._compile_watches),
+                       sum(w.seen() for w in self._compile_watches),
                        component="engine")
 
     # -- compiled programs ---------------------------------------------------

@@ -114,7 +114,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.attention import KVCache
-from ..utils import tracing
+from ..utils import graftsched, tracing
 from ..utils.metrics import REGISTRY, kv_block_gauges
 from .batcher import _round_up
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
@@ -145,6 +145,29 @@ POOL_MOVER_SCOPES = ("IterBatchingEngine._init_tables",
 # the documented per-segment price and are baselined.
 GRAFTCHECK_HOT_LOOPS = ("IterBatchingEngine._advance",
                         "IterBatchingEngine._advance_spec")
+
+# Lock-discipline contract (tools/graftcheck locks pass): the scheduler
+# counters AND the cross-thread scheduling state (``_parked`` parked
+# rows, ``_pending`` held queue head) live under ``_stats_lock`` —
+# serving threads read them through ``admission_load``/``stats`` while
+# the worker mutates them, which is exactly the lost-update/stale-read
+# window the pass exists to flag (the worker routes every touch through
+# the tiny *_locked-discipline helpers below). ``_np`` is the lazily
+# materialized host copy ``_SegOut`` guards with its own ``_lock``.
+GUARDED_STATE = {
+    "batches_run": "_stats_lock", "rows_served": "_stats_lock",
+    "joins": "_stats_lock", "segments_run": "_stats_lock",
+    "spec_segments_run": "_stats_lock", "eos_retires": "_stats_lock",
+    "grows": "_stats_lock", "preemptions": "_stats_lock",
+    "resumes": "_stats_lock", "_parked": "_stats_lock",
+    "_pending": "_stats_lock",
+    "_np": "_lock",
+}
+
+# ``_stats_lock`` holds are leaf-scoped (list/counter ops only) and the
+# _SegOut fetch lock never nests inside them; the declared order keeps
+# it that way.
+LOCK_ORDER = ("_stats_lock", "_lock")
 
 
 def _next_pow2(n: int) -> int:
@@ -194,7 +217,7 @@ class _SegOut:
     def __init__(self, arr):
         self.arr = arr
         self._np = None
-        self._lock = threading.Lock()
+        self._lock = graftsched.lock("iterbatch._SegOut._lock")
         try:
             arr.copy_to_host_async()
         except AttributeError:  # non-jax array (tests)
@@ -378,7 +401,9 @@ class IterBatchingEngine:
         self._pending: Optional[_Req] = None
         self._parked: List[_Parked] = []   # preempted rows, oldest first
         self._order = 0                    # admission-order counter
-        self._stats_lock = threading.Lock()
+        #                                    (worker-thread-only)
+        self._stats_lock = graftsched.lock(
+            "iterbatch.IterBatchingEngine._stats_lock")
         self.batches_run = 0
         self.rows_served = 0
         self.joins = 0                # admissions into a LIVE batch
@@ -475,10 +500,14 @@ class IterBatchingEngine:
         if self.pool is None:
             return True, 0.0
         # admission footprint (the prefill's blocks) — growth past it is
-        # the preemption machinery's business, not the 429 gate's
+        # the preemption machinery's business, not the 429 gate's.
+        # ``can_admit`` here is ADVISORY (load shedding): the worker's
+        # actual grant goes through the atomic ``admit_alloc`` path, so
+        # a stale answer costs one queue beat, never a request failure.
         need = self.pool.allocator.blocks_for(prompt_len)
-        waiting = (self._queue.qsize() + len(self._parked)
-                   + (1 if self._pending is not None else 0))
+        with self._stats_lock:
+            waiting = (self._queue.qsize() + len(self._parked)
+                       + (1 if self._pending is not None else 0))
         if self.pool.allocator.can_admit(need) or waiting < self.queue_limit:
             return True, 0.0
         # crude but honest: each max_batch-wide wave of waiters needs
@@ -487,18 +516,53 @@ class IterBatchingEngine:
 
     # -- worker side ---------------------------------------------------------
 
+    # The worker owns ``_parked``/``_pending`` mutation, but serving
+    # threads read both (``admission_load``, ``stats``) — so EVERY touch
+    # goes through these leaf-locked helpers (the locks-pass
+    # unguarded-state contract; before this discipline, ``stats`` read
+    # ``_parked`` under ``_stats_lock`` while the worker mutated it with
+    # no lock at all — guarded in one place and bare in another).
+
+    def _peek_parked(self) -> Optional[_Parked]:
+        with self._stats_lock:
+            return self._parked[0] if self._parked else None
+
+    def _pop_parked(self) -> Optional[_Parked]:
+        with self._stats_lock:
+            return self._parked.pop(0) if self._parked else None
+
+    def _park(self, parked: _Parked) -> None:
+        # oldest-first resume order (sorted by admission order)
+        with self._stats_lock:
+            self._parked.append(parked)
+            self._parked.sort(key=lambda p: p.order)
+
+    def _take_pending(self) -> Optional[_Req]:
+        with self._stats_lock:
+            req, self._pending = self._pending, None
+            return req
+
+    def _get_pending(self) -> Optional[_Req]:
+        with self._stats_lock:
+            return self._pending
+
+    def _set_pending(self, req: Optional[_Req]) -> None:
+        with self._stats_lock:
+            self._pending = req
+
     def _loop(self):
         while True:
             # parked rows outrank every queued request (they were
             # admitted first — FIFO priority): with any parked, the next
             # batch seeds from the parked head instead of the queue
-            if self._parked:
-                head = self._parked.pop(0)
+            head = self._pop_parked()
+            if head is not None:
                 if head.req.cancelled.is_set():
                     continue
             else:
-                head = self._pending or self._queue.get()
-                self._pending = None
+                head = self._take_pending()
+                if head is None:
+                    head = self._queue.get()
                 if head.cancelled.is_set():
                     continue
             try:
@@ -516,8 +580,8 @@ class IterBatchingEngine:
         at ``[d - plen', d)``), and its remaining generation must fit
         the cache — with ``draft_len`` extra slots of verify-write
         headroom when the batch speculates. Pool room is checked
-        SEPARATELY (``_pool_room_for``): a policy mismatch closes
-        admission, missing pool room only defers it."""
+        SEPARATELY (``_reserve_blocks`` / ``admit_alloc``): a policy
+        mismatch closes admission, missing pool room only defers it."""
         reserve = self.spec.draft_len if state.spec_mode else 0
         return (self._ent_req(ent).sampling == state.sampling
                 and len(self._ent_ids(ent)) <= state.depth
@@ -574,14 +638,16 @@ class IterBatchingEngine:
         its caller forever (ADVICE r4 medium)."""
         seed = [head]
         sampling = self._ent_req(head).sampling
-        while len(seed) < self.max_batch and self._parked:
-            nxt = self._parked[0]
+        while len(seed) < self.max_batch:
+            nxt = self._peek_parked()
+            if nxt is None:
+                break
             if nxt.req.cancelled.is_set():
-                self._parked.pop(0)
+                self._pop_parked()
                 continue
             if (nxt.req.sampling == sampling
                     and self._fits(seed + [nxt])):
-                seed.append(self._parked.pop(0))
+                seed.append(self._pop_parked())
             else:
                 break  # stays parked; reconsidered at admission/next seed
         deadline = time.monotonic() + self.max_wait_s
@@ -601,7 +667,7 @@ class IterBatchingEngine:
                 # incompatible arrival: parked as the FIFO head — _admit
                 # reconsiders it first (it may fit once the batch is
                 # live) and otherwise it seeds the next batch
-                self._pending = nxt
+                self._set_pending(nxt)
                 break
         try:
             return self._seed_batch(seed)
@@ -761,17 +827,32 @@ class IterBatchingEngine:
 
     # -- admission -----------------------------------------------------------
 
-    def _pool_room_for(self, state: _BatchState, ent) -> bool:
-        """Pool watermark check for one would-be row's CURRENT
+    def _reserve_blocks(self, state: _BatchState, ent):
+        """ATOMIC pool admission for one would-be row's CURRENT
         footprint — blocks covering its content at the live depth
         (pad-prefix blocks are free, they point at trash). Growth past
-        this is deliberately oversubscribed: preemption handles it."""
+        this is deliberately oversubscribed: preemption handles it.
+
+        The watermark check and the grant run under ONE allocator lock
+        hold (``BlockAllocator.admit_alloc``): the old two-step
+        ``can_admit`` -> later ``alloc`` left a window where a
+        concurrent pool user (the prefix store's insert, a solo paged
+        runner sharing the pool) could take the checked blocks, turning
+        a deferrable admission into a ``PoolExhausted`` request failure
+        — or, raced the other way, an over-watermark grant (the
+        graftsched check-then-act fixture pins both shapes). Returns
+        ``(p_lo, granted ids)`` or None to defer (blocks free up as
+        rows retire)."""
         if self.pool is None:
-            return True
+            return 0, []
         alloc = self.pool.allocator
         plen_eff = len(self._ent_ids(ent))
         p_lo = (state.depth - plen_eff) // self.pool.block_size
-        return alloc.can_admit(alloc.blocks_for(state.depth) - p_lo)
+        p_hi = -(-state.depth // self.pool.block_size)
+        ids = alloc.admit_alloc(p_hi - p_lo)
+        if ids is None:
+            return None
+        return p_lo, ids
 
     def _admit(self, state: _BatchState):
         """Drain parked rows (oldest first — they outrank the queue),
@@ -787,10 +868,12 @@ class IterBatchingEngine:
         narrower than ``max_batch``, the live batch GROWS to the next
         power of two (ghost rows replicate row 0; per-row exactness
         makes them inert) instead of turning the arrival away."""
-        while self._parked:
-            ent = self._parked[0]
+        while True:
+            ent = self._peek_parked()
+            if ent is None:
+                break
             if ent.req.cancelled.is_set():
-                self._parked.pop(0)
+                self._pop_parked()
                 continue
             if not self._compatible(state, ent):
                 # the parked head must not be overtaken by younger
@@ -800,41 +883,62 @@ class IterBatchingEngine:
                 if ent.req.sampling != state.sampling:
                     state.closed = True
                 return
-            if not self._pool_room_for(state, ent):
+            if not self._slot_possible(state):
+                return  # full batch: retried at the next boundary
+            reserved = self._reserve_blocks(state, ent)
+            if reserved is None:
                 return  # blocks free up as rows retire; stays parked
             slot = self._free_slot(state)
             if slot is None:
+                if self.pool is not None:
+                    self.pool.allocator.free(reserved[1])
                 return
-            ent = self._parked.pop(0)
+            ent = self._pop_parked()
             try:
-                self._admit_one(state, ent.req, slot, resume=ent)
+                self._admit_one(state, ent.req, slot, resume=ent,
+                                reserved=reserved)
             except Exception as e:  # noqa: BLE001
                 ent.req.fail(e)
                 raise
         while True:
-            if self._pending is None:
+            req = self._get_pending()
+            if req is None:
                 try:
-                    self._pending = self._queue.get_nowait()
+                    req = self._queue.get_nowait()
                 except queue.Empty:
                     return
-            req = self._pending
+                self._set_pending(req)
             if req.cancelled.is_set():
-                self._pending = None
+                self._set_pending(None)
                 continue
             if not self._compatible(state, req):
                 state.closed = True  # req stays parked as the FIFO head
                 return
-            if not self._pool_room_for(state, req):
+            if not self._slot_possible(state):
+                return  # full batch: req stays the head
+            reserved = self._reserve_blocks(state, req)
+            if reserved is None:
                 return  # req stays the head; retried as rows retire
             slot = self._free_slot(state)
             if slot is None:
+                if self.pool is not None:
+                    self.pool.allocator.free(reserved[1])
                 return
-            self._pending = None
+            self._set_pending(None)
             try:
-                self._admit_one(state, req, slot)
+                self._admit_one(state, req, slot, reserved=reserved)
             except Exception as e:  # noqa: BLE001 — the popped request is
                 req.fail(e)        # not in state.slots yet; without this
                 raise              # its caller would block forever
+
+    def _slot_possible(self, state: _BatchState) -> bool:
+        """Could an admission find (or grow into) a slot right now?
+        Checked BEFORE reserving pool blocks: ``admit_alloc`` may evict
+        zero-ref prefix entries to satisfy a grant, and reserving for a
+        full, ungrowable batch would thrash the prefix cache for a
+        grant that is immediately handed back."""
+        return (any(s is None for s in state.slots)
+                or len(state.slots) < self.max_batch)
 
     def _free_slot(self, state: _BatchState) -> Optional[int]:
         free = [i for i, s in enumerate(state.slots) if s is None]
@@ -890,7 +994,25 @@ class IterBatchingEngine:
         REGISTRY.inc("iter_grows_total")
 
     def _admit_one(self, state: _BatchState, req: _Req, slot: int,
-                   resume: Optional[_Parked] = None):
+                   resume: Optional[_Parked] = None,
+                   reserved: Optional[Tuple[int, List[int]]] = None):
+        """``reserved`` (pool mode) is the row's atomically pre-granted
+        block reservation from ``_reserve_blocks`` — this function owns
+        it: consumed by ``_place_admitted`` on success, freed on ANY
+        failure in between (a prefill OOM must not leak the grant)."""
+        try:
+            return self._admit_one_inner(state, req, slot, resume,
+                                         reserved)
+        except BaseException:
+            if self.pool is not None and reserved is not None:
+                self.pool.allocator.free(reserved[1])
+                if state.tables is not None:
+                    state.tables[slot, :] = self.pool.trash
+            raise
+
+    def _admit_one_inner(self, state: _BatchState, req: _Req, slot: int,
+                         resume: Optional[_Parked],
+                         reserved: Optional[Tuple[int, List[int]]]):
         eng = self.engine
         stream = self._ent_ids(resume) if resume is not None else req.prompt
         plen_eff = len(stream)            # tokens the prefill forwards
@@ -942,7 +1064,7 @@ class IterBatchingEngine:
             first = jnp.asarray(int(resume.tokens[-1]), jnp.int32)
         if self.pool is not None:
             blk_lo, blk_ids = self._place_admitted(
-                state, slot, plen_eff, solo, state.depth - sp)
+                state, slot, solo, state.depth - sp, reserved)
         else:
             state.cache = _admit_cache(
                 state.cache, solo, jnp.asarray(slot, jnp.int32),
@@ -1024,20 +1146,21 @@ class IterBatchingEngine:
         state.cache = None
 
     def _place_admitted(self, state: _BatchState, slot: int,
-                        plen_eff: int, solo, roll: int):
-        """Admission-time placement of one solo-prefilled row: allocate
-        its content blocks and scatter the rolled row into them
-        (the paged form of ``_admit_cache``'s roll merge)."""
-        bs = self.pool.block_size
-        p_lo = (state.depth - plen_eff) // bs
-        p_hi = -(-state.depth // bs)
-        ids = self.pool.allocator.alloc(p_hi - p_lo)
+                        solo, roll: int,
+                        reserved: Tuple[int, List[int]]):
+        """Admission-time placement of one solo-prefilled row into its
+        PRE-RESERVED content blocks (the atomic ``_reserve_blocks``
+        grant — allocation no longer happens here, so the watermark
+        check and the grant cannot be split by a concurrent pool user)
+        and scatter of the rolled row (the paged form of
+        ``_admit_cache``'s roll merge). ``_admit_one`` owns freeing the
+        reservation on failure; this only resets the table row."""
+        p_lo, ids = reserved
         try:
             state.tables[slot, :] = self.pool.trash
-            state.tables[slot, p_lo:p_hi] = ids
+            state.tables[slot, p_lo:p_lo + len(ids)] = ids
             self.pool.scatter_row(solo, state.tables[slot], roll)
         except BaseException:
-            self.pool.allocator.free(ids)
             state.tables[slot, :] = self.pool.trash
             raise
         return p_lo, ids
@@ -1130,9 +1253,7 @@ class IterBatchingEngine:
                          spec_key=spec_key)
         self._release_blocks(state, victim.row)
         state.slots[victim.row] = None
-        # oldest-first resume order (sorted by admission order)
-        self._parked.append(parked)
-        self._parked.sort(key=lambda p: p.order)
+        self._park(parked)
         if victim.req.trace is not None:
             victim.req.trace.labels["preempted"] = (
                 victim.req.trace.labels.get("preempted", 0) + 1)

@@ -59,7 +59,6 @@ from __future__ import annotations
 import dataclasses
 import os
 import sys
-import threading
 import time
 import weakref
 from collections import OrderedDict
@@ -71,7 +70,7 @@ import numpy as np
 
 from ..ops import paged_attention as PA
 from ..ops.attention import KVCache
-from ..utils import tracing
+from ..utils import graftsched, tracing
 from ..utils.metrics import DEFAULT_KV_BLOCK_SIZE, REGISTRY, CompileWatch
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
                      _eos_capped_segments, _split_keys, _step_keys,
@@ -96,6 +95,31 @@ DONATED_ARGS = {"_scatter": (0,), "_scatter_row": (0,), "_copy": (0,),
 # allocation of this generate (owned/shared row ids) or the trash block.
 POOL_MOVER_SCOPES = ("PagedKVRunner._prefill_tables",
                      "PagedKVRunner._decode")
+
+# Lock-discipline contract (tools/graftcheck locks pass): every shared
+# mutable attribute, by guarding lock. The allocator's accounting
+# (free list, refcounts, prefix registry, sanitizer provenance,
+# counters) lives under its reentrant ``_lock``; the device pool buffer
+# is rebound only under ``_dev_lock``. ``*_locked``-suffix helpers run
+# with the caller's hold by convention.
+GUARDED_STATE = {
+    "_free": "_lock", "_ref": "_lock", "_prefix": "_lock",
+    "_prefix_ref": "_lock", "_san_*": "_lock",
+    "evictions": "_lock", "cow_copies": "_lock",
+    "data": "_dev_lock",
+}
+
+# Permitted acquisition order: device ops validate tables against live
+# allocator state, so ``_dev_lock`` may hold across an ``_lock``
+# acquisition — never the reverse (``_notify_freed`` fires the poison
+# hook OUTSIDE ``_lock`` precisely to keep this order acyclic).
+LOCK_ORDER = ("_dev_lock", "_lock")
+
+# Locks whose documented job is serializing DEVICE work: jit dispatch /
+# device sync under them is the design (the pool buffer is donated
+# through every scatter; the solo runner runs one generation at a
+# time), not a blocking-under-lock finding.
+DEVICE_LOCKS = ("_dev_lock", "_gen_lock")
 
 
 class PoolExhausted(RuntimeError):
@@ -186,7 +210,7 @@ class BlockAllocator:
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.watermark = watermark
-        self._lock = threading.RLock()
+        self._lock = graftsched.rlock("kv_pool.BlockAllocator._lock")
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
         self._ref: Dict[int, int] = {}
         # content-key -> tuple(block ids); insertion order IS the LRU
@@ -310,17 +334,26 @@ class BlockAllocator:
         with self._lock:
             return len(self._free) + self._evictable_blocks_locked()
 
+    def _can_admit_locked(self, n_blocks: int) -> bool:
+        """THE admission predicate (availability + watermark), under the
+        caller's ``_lock`` hold — shared by the advisory ``can_admit``
+        (the serving 429 gate) and the atomic ``admit_alloc`` grant, so
+        the two can never drift."""
+        if n_blocks > len(self._free) + self._evictable_blocks_locked():
+            return False
+        live = len(self._ref) - self._evictable_blocks_locked()
+        return live + n_blocks <= self.watermark * self.num_blocks
+
     def can_admit(self, n_blocks: int) -> bool:
         """Watermark admission: would granting ``n_blocks`` keep
         referenced blocks at or under the watermark (after evicting
-        prefix entries as needed)?"""
+        prefix entries as needed)? ADVISORY — the answer can be stale
+        by the time a caller acts on it; grants go through
+        ``admit_alloc``, which re-evaluates under one hold."""
         with self._lock:
             if self.sanitize:
                 self._san_check_locked("admission")
-            if n_blocks > len(self._free) + self._evictable_blocks_locked():
-                return False
-            live = len(self._ref) - self._evictable_blocks_locked()
-            return live + n_blocks <= self.watermark * self.num_blocks
+            return self._can_admit_locked(n_blocks)
 
     def _notify_freed(self, freed: List[int]) -> None:
         """Fire the sanitizer's poison hook for fully-freed blocks —
@@ -330,34 +363,70 @@ class BlockAllocator:
         if freed and self._on_free is not None:
             self._on_free(freed)
 
+    def _alloc_locked(self, n: int, site: str) -> Tuple[List[int],
+                                                        List[int]]:
+        """Grant ``n`` blocks at ref=1 under the caller's ``_lock``
+        hold, LRU-evicting as needed -> (granted, eviction-freed)."""
+        evict_freed: List[int] = []
+        while len(self._free) < n and self._prefix:
+            evict_freed.extend(self._evict_lru_locked())
+        if len(self._free) < n:
+            raise PoolExhausted(
+                f"need {n} blocks, {len(self._free)} free and no "
+                f"evictable prefix entries ({len(self._ref)} blocks "
+                "referenced)")
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._ref[b] = 1
+        if self.sanitize:
+            for b in out:
+                self._san_grant_locked(b, site)
+            self._san_check_locked("alloc")
+        # eviction-freed blocks this alloc immediately re-took are
+        # live again — only the remainder gets poisoned
+        return out, [b for b in evict_freed if b not in self._ref]
+
     def alloc(self, n: int) -> List[int]:
         """Allocate ``n`` blocks at ref=1, LRU-evicting zero-ref prefix
         entries as needed. All-or-nothing: raises ``PoolExhausted``
         without taking anything when ``n`` cannot be satisfied."""
         if n == 0:
             return []
-        evict_freed: List[int] = []
         with self._lock:
-            while len(self._free) < n and self._prefix:
-                evict_freed.extend(self._evict_lru_locked())
-            if len(self._free) < n:
-                raise PoolExhausted(
-                    f"need {n} blocks, {len(self._free)} free and no "
-                    f"evictable prefix entries ({len(self._ref)} blocks "
-                    "referenced)")
-            out = [self._free.pop() for _ in range(n)]
-            for b in out:
-                self._ref[b] = 1
-            if self.sanitize:
-                site = _call_site()
-                for b in out:
-                    self._san_grant_locked(b, site)
-                self._san_check_locked("alloc")
-            # eviction-freed blocks this alloc immediately re-took are
-            # live again — only the remainder gets poisoned
-            evict_freed = [b for b in evict_freed if b not in self._ref]
+            site = _call_site() if self.sanitize else ""
+            out, evict_freed = self._alloc_locked(n, site)
         self._notify_freed(evict_freed)
         return out
+
+    def admit_alloc(self, n: int) -> Optional[List[int]]:
+        """ATOMIC watermark admission + grant: ``can_admit`` and the
+        allocation run under ONE ``_lock`` hold, so no concurrent
+        allocator user can slip between the check and the grant (the
+        check-then-act window the two-step form leaves open turns a
+        deferrable admission into a ``PoolExhausted`` request failure —
+        or, raced the other way, an over-watermark grant). Returns the
+        granted ids, or None when the watermark (or availability)
+        refuses — the caller defers, exactly like a ``can_admit``
+        False."""
+        if n == 0:
+            return []
+        evict_freed: List[int] = []
+        with self._lock:
+            if self.sanitize:
+                self._san_check_locked("admission")
+            if not self._can_admit_locked(n):
+                return None
+            site = _call_site() if self.sanitize else ""
+            out, evict_freed = self._alloc_locked(n, site)
+        self._notify_freed(evict_freed)
+        return out
+
+    def note_cow(self) -> None:
+        """Count one copy-on-write block copy (under ``_lock``: pools
+        are shared across front ends, and an unguarded ``+= 1`` from
+        two concurrent CoW paths loses updates)."""
+        with self._lock:
+            self.cow_copies += 1
 
     def ref(self, ids) -> None:
         with self._lock:
@@ -553,7 +622,7 @@ class KVBlockPool:
         self.data = jnp.zeros(
             PA.pool_shape(n_layer, num_blocks, n_kv_head, block_size,
                           head_dim), dtype=dtype)
-        self._dev_lock = threading.RLock()
+        self._dev_lock = graftsched.rlock("kv_pool.KVBlockPool._dev_lock")
 
         # per-instance defs (not the module-level ops directly): each
         # pool owns its jitted-program caches, so ``_cache_size()`` is
@@ -738,7 +807,10 @@ class KVBlockPool:
             self.data = self._copy(self.data,
                                    jnp.asarray([src], jnp.int32),
                                    jnp.asarray([dst], jnp.int32))
-        self.allocator.cow_copies += 1
+        # locked counter bump (locks-pass finding: pools are shared
+        # across front ends — the prefix store's insert and a paged
+        # runner can CoW concurrently, and a bare += here loses updates)
+        self.allocator.note_cow()
         REGISTRY.inc("kv_pool_cow_copies_total")
         return dst
 
@@ -801,8 +873,10 @@ class PagedKVRunner:
         self.prefix = prefix
         # one generation at a time: the pool buffer is donated through
         # every scatter, and the allocator's alloc/free pairs must not
-        # interleave between concurrent generates
-        self._lock = threading.Lock()
+        # interleave between concurrent generates. A declared DEVICE
+        # lock (it serializes whole device generations by design).
+        self._gen_lock = graftsched.lock("kv_pool.PagedKVRunner._gen_lock",
+                                         timeout=600.0)
 
     def generate(self, prompt_ids, max_new_tokens: int,
                  sampling: SamplingConfig = SamplingConfig(),
@@ -813,7 +887,7 @@ class PagedKVRunner:
         ids, batch, prompt_len, key, pad = prepare_generate(
             prompt_ids, max_new_tokens, eng.max_seq, sampling, key, pad=pad)
         alloc = self.pool.allocator
-        with self._lock:
+        with self._gen_lock:
             t0 = time.perf_counter()
             prefill_key, decode_key = _split_keys(key)
             run_params = eng._run_params()

@@ -42,7 +42,6 @@ time/HBM rather than lower request latency; on a locally attached chip
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import OrderedDict
 from typing import Optional, Tuple
@@ -51,7 +50,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils import tracing
+from ..utils import graftsched, tracing
 from ..utils.metrics import REGISTRY
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
                      prepare_generate, select_token)
@@ -73,6 +72,24 @@ DONATED_ARGS = {"_extend": (1,)}
 # (the lookup's caller refs / the insert's fresh allocation).
 POOL_MOVER_SCOPES = ("PrefixCachingEngine._gather_entry",
                      "PrefixCachingEngine._insert_pool")
+
+# Lock-discipline contract (tools/graftcheck locks pass): the store and
+# its hit/miss counters live under ``_store_lock`` only — ``stats()``
+# (the /healthz read) must never wait out an in-flight generation's
+# seconds of device time behind the big lock.
+GUARDED_STATE = {"_store": "_store_lock", "hits": "_store_lock",
+                 "misses": "_store_lock"}
+
+# The device lock is always the OUTER of the pair (generate/prefill
+# take ``_lock``, then the walk touches the store under
+# ``_store_lock``); an opposite-order path would deadlock a /healthz
+# reader against an in-flight generation.
+LOCK_ORDER = ("_lock", "_store_lock")
+
+# ``_lock`` serializes the donation-sensitive extend/decode programs —
+# one generation at a time is the module's documented design, so device
+# dispatch under it is not a blocking-under-lock finding.
+DEVICE_LOCKS = ("_lock",)
 
 
 class PrefixCachingEngine:
@@ -143,8 +160,10 @@ class PrefixCachingEngine:
         # while ``_store_lock`` guards only the store and counters — so
         # ``stats()`` (the /healthz read) never waits out an in-flight
         # generation's seconds of device time behind the big lock.
-        self._lock = threading.Lock()
-        self._store_lock = threading.Lock()
+        self._lock = graftsched.lock("prefix_cache.PrefixCachingEngine._lock",
+                                     timeout=600.0)
+        self._store_lock = graftsched.lock(
+            "prefix_cache.PrefixCachingEngine._store_lock")
         self.hits = 0
         self.misses = 0
         # One continuation program per ids width (the chunk width plus the

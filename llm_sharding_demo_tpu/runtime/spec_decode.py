@@ -76,7 +76,7 @@ import numpy as np
 
 from ..models.gpt2 import GPT2Config, Params
 from ..ops.attention import KVCache
-from ..utils import tracing
+from ..utils import graftsched, tracing
 from ..utils.metrics import REGISTRY, CompileWatch
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
                      prepare_generate, sampler_pmf, select_token)
@@ -93,6 +93,15 @@ JIT_ENTRY_POINTS = ("_loop", "_loop_b", "_seg_b")
 # the segment's token buffer and working cache — the iteration
 # scheduler must re-bind both from the call's outputs every segment.
 DONATED_ARGS = {"_loop": (2,), "_loop_b": (2, 3), "_seg_b": (1, 2)}
+
+# Lock-discipline contract (tools/graftcheck locks pass): the
+# acceptance accounting ThreadingHTTPServer callers and the iteration
+# scheduler both bump lives under ``_stats_lock`` — including the
+# cross-module ``spec._requests`` retirement count in
+# runtime/iterbatch.py, which this declaration holds to the same lock.
+GUARDED_STATE = {"_requests": "_stats_lock", "_verifies": "_stats_lock",
+                 "_emitted": "_stats_lock"}
+LOCK_ORDER = ("_stats_lock",)
 
 # Block-handoff contract for pool-backed schedulers (see
 # ``_seg_b_impl``): True means a spec segment may rewrite ANY slot of a
@@ -146,8 +155,8 @@ class SpecDecodeEngine:
                                  decode_kernel="xla")
         self.config = config
         self.max_seq = max_seq
-        import threading
-        self._stats_lock = threading.Lock()  # ThreadingHTTPServer callers
+        self._stats_lock = graftsched.lock(
+            "spec_decode.SpecDecodeEngine._stats_lock")
         self._requests = 0
         self._verifies = 0
         self._emitted = 0
@@ -175,7 +184,7 @@ class SpecDecodeEngine:
         for w in self._compile_watches:
             w.check()
         REGISTRY.gauge("jit_program_cache_size",
-                       sum(w._seen for w in self._compile_watches),
+                       sum(w.seen() for w in self._compile_watches),
                        component="spec")
 
     def _update_stats(self, n_req: int, n_tok: int, steps: int) -> None:

@@ -51,6 +51,14 @@ from .tokenizer import get_tokenizer
 
 log = logging.getLogger(__name__)
 
+# Lock-discipline contract (tools/graftcheck locks pass): this module
+# runs on ThreadingHTTPServer handler threads but owns NO locks — every
+# shared object a handler touches (runner/pool/registry/recorder)
+# guards its own state (see those modules' GUARDED_STATE). Declared
+# empty so a lock added here must declare what it protects.
+GUARDED_STATE = {}
+LOCK_ORDER = ()
+
 
 class UpstreamError(Exception):
     """A shard hop failed (connection, HTTP error, or error body)."""

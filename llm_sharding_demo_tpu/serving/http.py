@@ -39,6 +39,13 @@ import pydantic
 # handler parameters passed by NAME (never body-validated)
 _CONTEXT_PARAMS = ("headers", "query")
 
+# Lock-discipline contract (tools/graftcheck locks pass): the server is
+# intentionally lock-free — the route table is frozen before serve()
+# spawns its threads, and all per-request state is handler-local.
+# Declared empty so a lock added here must declare what it protects.
+GUARDED_STATE = {}
+LOCK_ORDER = ()
+
 
 class JSONApp:
     """Route table: (method, path) -> handler.

@@ -20,8 +20,17 @@ sites against it, so a typo'd name cannot silently fork a time series.
 from __future__ import annotations
 
 import bisect
-import threading
 from typing import Dict, List, Tuple
+
+from . import graftsched
+
+# Lock-discipline contract (tools/graftcheck locks pass): every series
+# map and the compile-watch cursor live under the owning instance's
+# ``_lock``; both classes are called from arbitrary handler/scheduler
+# threads.
+GUARDED_STATE = {"_counters": "_lock", "_gauges": "_lock",
+                 "_histograms": "_lock", "_seen": "_lock"}
+LOCK_ORDER = ("_lock",)
 
 # latency buckets (seconds): 1ms .. 60s, roughly log-spaced
 DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
@@ -127,7 +136,7 @@ def kv_block_gauges(component: str, used_slots: int, total_slots: int,
 
 class MetricsRegistry:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = graftsched.lock("metrics.MetricsRegistry._lock")
         self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._histograms: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
@@ -250,7 +259,14 @@ class CompileWatch:
         # solo-mode engines are called straight from server handler
         # threads — an unsynchronized read-modify-write of _seen would
         # let two concurrent checks double-count the same new program
-        self._lock = threading.Lock()
+        self._lock = graftsched.lock("metrics.CompileWatch._lock")
+
+    def seen(self) -> int:
+        """Programs observed so far (locked read — gauge derivations in
+        engine/spec_decode run on handler threads concurrent with
+        ``check``)."""
+        with self._lock:
+            return self._seen
 
     def check(self, registry: "MetricsRegistry" = None) -> int:
         size_of = getattr(self._fn, "_cache_size", None)

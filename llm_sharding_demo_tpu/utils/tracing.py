@@ -46,6 +46,17 @@ import uuid
 from collections import deque
 from typing import Iterator, List, Optional
 
+from . import graftsched
+
+# Lock-discipline contract (tools/graftcheck locks pass): a trace's
+# committed root spans and the flight recorder's ring are the only
+# cross-thread mutable state here (open-span stacks are thread-local by
+# design); both live under their instance's ``_lock`` — including the
+# fanout commit, which appends to OTHER traces' span lists under each
+# target's own lock.
+GUARDED_STATE = {"spans": "_lock", "_traces": "_lock"}
+LOCK_ORDER = ("_lock",)
+
 
 @contextlib.contextmanager
 def trace(log_dir: str, create_perfetto_link: bool = False) -> Iterator[None]:
@@ -123,7 +134,7 @@ class _TraceSink:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = graftsched.lock("tracing._TraceSink._lock")
         self._tls = threading.local()
         self.spans: List[Span] = []
 
@@ -306,7 +317,7 @@ class FlightRecorder:
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         self.capacity = capacity
-        self._lock = threading.Lock()
+        self._lock = graftsched.lock("tracing.FlightRecorder._lock")
         self._traces: "deque[RequestTrace]" = deque(maxlen=capacity)
 
     def record(self, trace_obj: RequestTrace) -> None:

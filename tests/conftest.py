@@ -74,6 +74,7 @@ _QUICK_MODULES = {
     "test_graftcheck",      # static contract verifier + lint (whole-repo)
     "test_graftplan",       # cost model goldens + planner rankings
     "test_graftsan",        # donation-aliasing pass + pool sanitizer
+    "test_graftlock",       # lock-discipline pass + GRAFTSCHED harness
 }
 
 
@@ -108,6 +109,40 @@ def _metrics_isolation():
     with tracing.RECORDER._lock:
         tracing.RECORDER._traces.clear()
         tracing.RECORDER._traces.extend(saved)
+
+
+@pytest.fixture(autouse=True)
+def _graftlock_thread_and_lock_hygiene():
+    """Concurrency hygiene after every test (the graftlock satellite):
+    no instrumented lock may still be held (a scheduler that unwound
+    without releasing would deadlock the NEXT test, not this one — fail
+    here, with the lock name), and no new non-daemon thread may outlive
+    the test (scheduler workers are daemons by design; a non-daemon
+    leak hangs interpreter shutdown). Lingering non-daemon threads get
+    a short grace poll before being declared leaked."""
+    import threading
+    import time as _time
+    before = {t for t in threading.enumerate() if not t.daemon}
+    yield
+    from llm_sharding_demo_tpu.utils import graftsched
+    # grace poll: a scheduler worker's trailing beat (gauge refresh
+    # after the last delivery) may hold a lock for a moment
+    deadline = _time.monotonic() + 2.0
+    while graftsched.held_locks() and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    held = graftsched.held_locks()
+    assert not held, (
+        f"instrumented locks still held after the test: {held} — a "
+        "code path released its thread without releasing its lock")
+    while True:
+        leaked = [t for t in threading.enumerate()
+                  if not t.daemon and t.is_alive() and t not in before]
+        if not leaked or _time.monotonic() > deadline:
+            break
+        _time.sleep(0.05)
+    assert not leaked, (
+        f"non-daemon threads leaked by the test: {leaked} — join them "
+        "or mark them daemon")
 
 
 @pytest.fixture(autouse=True)

@@ -69,6 +69,28 @@ def test_repo_passes_graftcheck():
         "graftsan sanitize pass went vacuous — a new undeclared "
         "donation or aliasing finding anywhere in the tree fails this "
         "strict run (see tests/test_graftsan.py for the rule fixtures)")
+    assert payload["locks_checks"] >= 100, (
+        "graftlock locks pass went vacuous — a new unguarded-state / "
+        "lock-order / atomic-check-act / blocking-under-lock finding "
+        "anywhere in the tree fails this strict run (rule fixtures in "
+        "tests/test_graftlock.py)")
+    assert payload["locks_vacuous"] == [], (
+        "lock-constructing modules with ZERO guarded regions — the "
+        "concurrency contract stopped seeing their locking: "
+        f"{payload['locks_vacuous']}")
+    # every threaded module the locks pass tracks declares and USES its
+    # contract (>= 1 with-region on a declared lock per module)
+    regions = payload["locks_guarded_regions"]
+    for rel in ("llm_sharding_demo_tpu/runtime/kv_pool.py",
+                "llm_sharding_demo_tpu/runtime/iterbatch.py",
+                "llm_sharding_demo_tpu/runtime/batcher.py",
+                "llm_sharding_demo_tpu/runtime/prefix_cache.py",
+                "llm_sharding_demo_tpu/runtime/spec_decode.py",
+                "llm_sharding_demo_tpu/utils/metrics.py",
+                "llm_sharding_demo_tpu/utils/tracing.py"):
+        assert regions.get(rel, 0) >= 1, (
+            f"{rel}: no guarded region — its GUARDED_STATE declaration "
+            "no longer matches any `with <lock>` hold")
     assert payload["suppressed"] >= 1, (
         "the documented sync points should be baselined findings — an "
         "empty suppression set means the host-sync rule stopped seeing "

@@ -490,9 +490,14 @@ def test_verifier_json_schema_shape():
     payload = cli.run(lint_only=True)
     assert set(payload) == {"ok", "strict", "findings", "suppressed",
                             "stale_baseline", "semantic_checks",
-                            "sanitize_checks", "recompile_bounds"}
+                            "sanitize_checks", "locks_checks",
+                            "locks_guarded_regions", "locks_vacuous",
+                            "recompile_bounds"}
     assert isinstance(payload["ok"], bool)
     assert isinstance(payload["sanitize_checks"], int)
+    assert isinstance(payload["locks_checks"], int)
+    assert isinstance(payload["locks_guarded_regions"], dict)
+    assert isinstance(payload["locks_vacuous"], list)
     assert isinstance(payload["strict"], bool)
     assert isinstance(payload["findings"], list)
     assert isinstance(payload["suppressed"], int)

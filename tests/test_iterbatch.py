@@ -565,7 +565,7 @@ def test_admit_failure_delivers_error_to_popped_request():
 
     orig = IterBatchingEngine._admit_one
 
-    def boom(self, state, req, slot):
+    def boom(self, state, req, slot, resume=None, reserved=None):
         raise RuntimeError("synthetic admit failure")
 
     IterBatchingEngine._admit_one = boom

@@ -25,6 +25,15 @@ driver ``tests/test_graftcheck.py``):
   lease scopes. Its dynamic half (``GRAFTSAN=1`` — poisoning,
   refcount conservation, leak provenance) lives in
   ``runtime.kv_pool``.
+- **Pass 4 — graftlock locks** (``locks``): lock-discipline rules —
+  ``GUARDED_STATE``/``LOCK_ORDER``/``DEVICE_LOCKS`` declaration
+  consistency, guarded state touched without its hold (or escaping a
+  region via return), acquisition orders contradicting the declared
+  order or each other (tracked through same-module calls),
+  check-then-act across separate holds, and blocking work under a
+  lock. Its dynamic half (``GRAFTSCHED=1`` — traced locks, seeded
+  deterministic schedules, deadlock timeout, contention accounting)
+  lives in ``llm_sharding_demo_tpu.utils.graftsched``.
 
 Findings are suppressed per (rule, file, scope) by
 ``tools/graftcheck/baseline.txt`` — one line per intentional keep, with

@@ -51,10 +51,12 @@ def run(root: str = None, lint_only: bool = False,
     if added:
         sys.path.insert(0, root)
     try:
-        from . import lint, sanitize
+        from . import lint, locks, sanitize
         findings = list(lint.run_lint(root))
         san, sanitize_checks = sanitize.run_sanitize(root)
         findings.extend(san)
+        lk, locks_summary = locks.run_locks(root)
+        findings.extend(lk)
         semantic_checks = 0
         bounds = {}
         if not lint_only:
@@ -86,7 +88,11 @@ def run(root: str = None, lint_only: bool = False,
     baseline = load_baseline(baseline_path)
     active, suppressed, stale = split_findings(findings, baseline)
     return {
-        "ok": not active and not (strict and stale),
+        # strict additionally fails on a VACUOUS locks pass (a lock-
+        # constructing module with zero guarded regions means the
+        # concurrency contract stopped seeing that module's locking)
+        "ok": (not active and not (strict and stale)
+               and not (strict and locks_summary["vacuous"])),
         "strict": strict,
         "findings": [f.to_dict() for f in active],
         "suppressed": len(suppressed),
@@ -94,6 +100,9 @@ def run(root: str = None, lint_only: bool = False,
                                  for k in stale),
         "semantic_checks": semantic_checks,
         "sanitize_checks": sanitize_checks,
+        "locks_checks": locks_summary["locks_checks"],
+        "locks_guarded_regions": locks_summary["guarded_regions"],
+        "locks_vacuous": locks_summary["vacuous"],
         "recompile_bounds": bounds,
     }
 

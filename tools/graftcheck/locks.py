@@ -1,0 +1,814 @@
+"""graftcheck locks pass: lock-discipline static analysis (compile-free).
+
+The serving/runtime layer is genuinely concurrent — ``ThreadingHTTPServer``
+request handlers feed background scheduler threads (``runtime/batcher.py``,
+``runtime/iterbatch.py``) over shared ``BlockAllocator``/prefix-store/
+metrics/tracing state guarded by a dozen ad-hoc locks — yet until this
+pass the graftcheck spine proved nothing about locking. Mirroring
+graftsan's static+dynamic split, this module is the STATIC half: locking
+becomes a DECLARED contract, and an AST dataflow pass (the same
+scope/qualname machinery as ``sanitize.py``) enforces it over the
+production tree. The dynamic half — the ``GRAFTSCHED=1`` cooperative-
+schedule race harness — lives in ``llm_sharding_demo_tpu/utils/
+graftsched.py`` (which, like any sanitizer runtime, is excluded from its
+own instrumentation's scan).
+
+In-file declarations (the registration annotations, same idiom as
+``JIT_ENTRY_POINTS`` / ``DONATED_ARGS``):
+
+- ``GUARDED_STATE``: dict literal ``{attr_or_prefix: lock_name}`` — the
+  shared mutable attributes this module's locks exist to protect. A key
+  ending in ``*`` is a prefix (``"_san_*": "_lock"`` covers every
+  sanitizer-bookkeeping attr). Underscore-prefixed attrs are enforced
+  ACROSS modules (``iterbatch`` touching ``spec._requests`` is held to
+  ``spec_decode``'s declaration); public attrs bind only within the
+  declaring module (common names like ``data`` must not contaminate
+  unrelated modules).
+- ``LOCK_ORDER``: tuple of lock names in permitted acquisition order —
+  a lock may only be acquired while holding locks that appear EARLIER.
+- ``DEVICE_LOCKS``: tuple of lock names whose documented job is
+  serializing device work (the prefix store's donation lock, the pool's
+  ``_dev_lock``): jit dispatch and device sync under them is the
+  design, not a finding. Host blocking (``requests.*``, ``sleep``,
+  ``.result()``, ``.wait()``) is still flagged under every lock.
+
+Every declared lock is CONSTRUCTED through ``utils.graftsched.lock`` /
+``.rlock`` (plain ``threading`` objects when GRAFTSCHED is off), which
+is what lets the dynamic harness instrument exactly the declared set.
+
+Rules (ids in brackets; suppressions ride the shared baseline):
+
+- [unguarded-state]      read/write of a declared guarded attribute
+                         outside a ``with <lock>`` region whose lock
+                         name AND receiver match the declaration
+                         (``with self._lock`` guards ``self._free``,
+                         not ``other._free``); also guarded state
+                         ESCAPING a lock region via a bare ``return``,
+                         and declaration-consistency findings (a lock
+                         constructed but guarding nothing declared, a
+                         stale declaration, a threaded module declaring
+                         nothing). ``__init__`` bodies (object not yet
+                         shared) and ``*_locked``-suffix functions (the
+                         repo's caller-holds-the-lock convention) are
+                         exempt.
+- [lock-order]           an acquisition order contradicting the
+                         module's ``LOCK_ORDER``, two call paths
+                         acquiring the same two locks in opposite
+                         orders (reported once with both sites), or a
+                         non-reentrant lock re-acquired on a path that
+                         already holds it. Nesting is tracked through
+                         same-module calls (one-level resolution +
+                         transitive closure), so ``gather`` holding
+                         ``_dev_lock`` and reaching ``refcount``'s
+                         ``_lock`` is one observed pair.
+- [atomic-check-act]     a guarded predicate evaluated under one lock
+                         hold and acted on under a LATER hold of the
+                         same lock in the same function — the decision
+                         can be stale by the time it acts (the
+                         watermark-check -> grant admission shape
+                         ``BlockAllocator.admit_alloc`` closes).
+- [blocking-under-lock]  device sync (``block_until_ready``/``.item()``),
+                         jit dispatch (a call to a declared
+                         ``JIT_ENTRY_POINTS`` name), ``requests.*``,
+                         ``time.sleep``, ``.result()``, or ``.wait()``
+                         while holding a declared lock — a scheduler
+                         serialized on a blocked lock is exactly the
+                         stall the TokenWeave-style overlap work cannot
+                         absorb. Device classes are permitted under
+                         declared ``DEVICE_LOCKS`` only.
+
+The analysis is deliberately name-and-receiver based and statement-
+ordered (the sanitize pass's philosophy): precise enough to pin the
+shapes that bite, conservative enough to hold the production tree to
+zero unsuppressed findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding
+from . import lint as L
+
+LOCKS_RULE_IDS = ("unguarded-state", "lock-order", "atomic-check-act",
+                  "blocking-under-lock")
+
+# the harness runtime is the measurement apparatus: it is not scanned by
+# its own pass (the same way the graftsan runtime hooks in kv_pool are
+# exercised by the dynamic tier, not the static aliasing rules)
+_EXEMPT_RELPATHS = {"llm_sharding_demo_tpu/utils/graftsched.py"}
+
+_THREAD_FACTORIES = {"Thread", "ThreadingHTTPServer", "Timer"}
+
+
+# -- declarations -------------------------------------------------------------
+
+
+def _module_assign(mod: L.ModuleInfo, name: str) -> Optional[ast.Assign]:
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    return stmt
+    return None
+
+
+def declared_guarded(mod: L.ModuleInfo,
+                     ) -> Tuple[Optional[Dict[str, str]], int]:
+    """``GUARDED_STATE`` -> ({attr_or_prefix: lock_name}, decl line);
+    (None, 0) when the module declares nothing."""
+    stmt = _module_assign(mod, "GUARDED_STATE")
+    if stmt is None:
+        return None, 0
+    if not isinstance(stmt.value, ast.Dict):
+        return {}, stmt.lineno
+    out: Dict[str, str] = {}
+    for k, v in zip(stmt.value.keys, stmt.value.values):
+        if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                and isinstance(v, ast.Constant)
+                and isinstance(v.value, str)):
+            out[k.value] = v.value
+    return out, stmt.lineno
+
+
+def declared_order(mod: L.ModuleInfo,
+                   ) -> Tuple[Optional[List[str]], int]:
+    """``LOCK_ORDER`` -> (ordered lock names, decl line)."""
+    stmt = _module_assign(mod, "LOCK_ORDER")
+    if stmt is None:
+        return None, 0
+    node = stmt.value
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+            else:
+                return [], stmt.lineno
+        return out, stmt.lineno
+    return [], stmt.lineno
+
+
+def declared_device(mod: L.ModuleInfo) -> Tuple[Optional[Set[str]], int]:
+    """``DEVICE_LOCKS`` -> (names, decl line)."""
+    stmt = _module_assign(mod, "DEVICE_LOCKS")
+    if stmt is None:
+        return None, 0
+    vals = L._string_tuple(stmt.value)
+    return (vals if vals is not None else set()), stmt.lineno
+
+
+@dataclasses.dataclass
+class LockSite:
+    line: int
+    name: str            # holding attribute name
+    reentrant: bool
+    scope: str
+    foreign: bool = False  # re-wrap of ANOTHER object's lock attr
+    #                        (e.g. bench instrumenting REGISTRY._lock):
+    #                        the guarded-state contract lives with the
+    #                        lock's OWNING module, not the wrapper
+
+
+def _lock_factory(node: ast.AST) -> Optional[bool]:
+    """If ``node`` constructs a lock, its reentrancy; else None.
+    Recognizes ``threading.Lock/RLock/Condition()`` and the instrumented
+    ``graftsched.lock/rlock(...)`` constructors (+ ``IfExp`` choosing
+    between two factories)."""
+    if isinstance(node, ast.IfExp):
+        a, b = _lock_factory(node.body), _lock_factory(node.orelse)
+        if a is None and b is None:
+            return None
+        return bool(a) or bool(b)
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        if f.value.id in ("threading", "_threading"):
+            if f.attr in ("Lock", "Condition"):
+                return False
+            if f.attr == "RLock":
+                return True
+        if f.value.id == "graftsched":
+            if f.attr == "lock":
+                return False
+            if f.attr == "rlock":
+                return True
+    return None
+
+
+def lock_constructions(mod: L.ModuleInfo) -> List[LockSite]:
+    parents = None
+    out: List[LockSite] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        reentrant = _lock_factory(node.value)
+        if reentrant is None:
+            continue
+        tgt = node.targets[0]
+        foreign = False
+        if isinstance(tgt, ast.Attribute):
+            name = tgt.attr
+            base = _dotted(tgt.value)
+            foreign = base not in ("self", "cls", None)
+        elif isinstance(tgt, ast.Name):
+            name = tgt.id
+        else:
+            continue
+        if parents is None:
+            parents = _parents(mod.tree)
+        out.append(LockSite(line=node.lineno, name=name,
+                            reentrant=reentrant,
+                            scope=_scope_of(node, parents, mod),
+                            foreign=foreign))
+    return out
+
+
+def constructs_threads(mod: L.ModuleInfo) -> bool:
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, (ast.Attribute, ast.Name))):
+            name = (node.func.attr if isinstance(node.func, ast.Attribute)
+                    else node.func.id)
+            if name in _THREAD_FACTORIES:
+                return True
+    return False
+
+
+def _parents(tree: ast.Module) -> Dict[int, ast.AST]:
+    out: Dict[int, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            out[id(child)] = node
+    return out
+
+
+def _scope_of(node: ast.AST, parents: Dict[int, ast.AST],
+              mod: L.ModuleInfo) -> str:
+    cur = parents.get(id(node))
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return mod.qualname_of.get(cur, cur.name)
+        cur = parents.get(id(cur))
+    return "<module>"
+
+
+# -- shared context -----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Ctx:
+    """Everything a per-module scan needs resolved: the module's own
+    guard map (+ prefix keys), the cross-module underscore guard map,
+    and the global lock-name inventory."""
+
+    own_exact: Dict[str, str]
+    own_prefix: List[Tuple[str, str]]
+    foreign: Dict[str, Set[str]]
+    known_locks: Set[str]
+    device: Set[str]
+    entry_points: Set[str]
+    reentrant_here: Set[str]       # reentrant constructions in THIS module
+    nonreentrant_here: Set[str]
+
+    def locks_for(self, attr: str) -> Set[str]:
+        out: Set[str] = set()
+        got = self.own_exact.get(attr)
+        if got is not None:
+            out.add(got)
+        for prefix, lock_name in self.own_prefix:
+            if attr.startswith(prefix):
+                out.add(lock_name)
+        if not out and attr.startswith("_"):
+            out |= self.foreign.get(attr, set())
+        return out
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Dotted receiver key, peeling subscripts: ``self.spec`` /
+    ``alloc`` / ``state.slots`` -> stable string, else None."""
+    parts: List[str] = []
+    while True:
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+            continue
+        break
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _walk_expr(node: ast.AST):
+    """ast.walk that does not descend into nested function bodies (a
+    lambda body runs later, under whatever locks its CALLER holds)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.Lambda, ast.FunctionDef,
+                          ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+# -- per-function scan --------------------------------------------------------
+
+
+class _Region:
+    __slots__ = ("base", "name", "line", "reads", "writes")
+
+    def __init__(self, base: str, name: str, line: int):
+        self.base = base
+        self.name = name
+        self.line = line
+        self.reads: Dict[str, int] = {}
+        self.writes: Dict[str, int] = {}
+
+
+_BLOCKING_ATTRS = {"result": ".result() blocks on a future",
+                   "wait": ".wait() blocks on an event/condition"}
+_DEVICE_ATTRS = {"block_until_ready", "item"}
+
+
+class _Scan:
+    """One function's lock-discipline events: guarded accesses with the
+    held-lock set at each, with-region sequence, observed acquisition
+    pairs, blocking calls, escapes, and the call list for
+    interprocedural nesting."""
+
+    def __init__(self, mod: L.ModuleInfo, qual: str, fn: ast.AST,
+                 ctx: _Ctx):
+        self.mod = mod
+        self.qual = qual
+        self.ctx = ctx
+        self.accesses: List[Tuple[int, str, str, bool]] = []
+        #                  (line, base, attr, guarded)
+        self.regions: List[_Region] = []
+        self.pairs: List[Tuple[str, str, int, bool]] = []
+        #               (outer, inner, line, same_base)
+        self.blocking: List[Tuple[int, str, bool, Tuple[str, ...]]] = []
+        #                 (line, what, device_class, held names)
+        self.escapes: List[Tuple[int, str, str, str]] = []
+        #                (line, base, attr, lock)
+        # (line, trailing name, receiver base or None,
+        #  held (base, name) pairs)
+        self.calls: List[Tuple[int, str, Optional[str],
+                               Tuple[Tuple[str, str], ...]]] = []
+        self.direct_acquires: Set[str] = set()
+        self._held: List[Tuple[str, str, _Region]] = []
+        body = [fn.body] if isinstance(fn, ast.Lambda) else fn.body
+        self._stmts(body)
+
+    # -- statement walk --
+
+    def _stmts(self, stmts: Sequence[ast.AST]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._with(stmt)
+            elif isinstance(stmt, ast.Return):
+                if stmt.value is not None:
+                    self._escape_check(stmt.value, stmt.lineno)
+                    self._expr(stmt.value)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                self._expr(stmt.test)
+                self._stmts(stmt.body)
+                self._stmts(stmt.orelse)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._expr(stmt.iter)
+                self._expr(stmt.target)
+                self._stmts(stmt.body)
+                self._stmts(stmt.orelse)
+            elif isinstance(stmt, ast.Try):
+                self._stmts(stmt.body)
+                for h in stmt.handlers:
+                    self._stmts(h.body)
+                self._stmts(stmt.orelse)
+                self._stmts(stmt.finalbody)
+            else:
+                self._expr(stmt)
+
+    def _with(self, stmt) -> None:
+        taken: List[Tuple[str, str, _Region]] = []
+        for item in stmt.items:
+            ce = item.context_expr
+            base = None
+            if (isinstance(ce, ast.Attribute)
+                    and ce.attr in self.ctx.known_locks):
+                base = _dotted(ce.value)
+            if base is not None:
+                region = _Region(base, ce.attr, stmt.lineno)
+                self.regions.append(region)
+                self.direct_acquires.add(ce.attr)
+                for ob, on, _ in self._held:
+                    self.pairs.append((on, ce.attr, stmt.lineno,
+                                       ob == base))
+                entry = (base, ce.attr, region)
+                self._held.append(entry)
+                taken.append(entry)
+            else:
+                self._expr(ce)
+            if item.optional_vars is not None:
+                self._expr(item.optional_vars)
+        self._stmts(stmt.body)
+        for entry in taken:
+            self._held.remove(entry)
+
+    def _escape_check(self, value: ast.AST, line: int) -> None:
+        if not isinstance(value, ast.Attribute):
+            return
+        locks = self.ctx.locks_for(value.attr)
+        if not locks:
+            return
+        base = _dotted(value.value)
+        if base is None:
+            return
+        for b, n, _ in self._held:
+            if b == base and n in locks:
+                self.escapes.append((line, base, value.attr, n))
+                return
+
+    # -- expression walk --
+
+    def _held_names(self) -> Tuple[str, ...]:
+        return tuple(n for _, n, _ in self._held)
+
+    def _expr(self, node: ast.AST) -> None:
+        for n in _walk_expr(node):
+            if isinstance(n, ast.Attribute) and isinstance(
+                    getattr(n, "ctx", None),
+                    (ast.Load, ast.Store, ast.Del)):
+                self._access(n)
+            elif isinstance(n, ast.Call):
+                self._call(n)
+
+    def _access(self, node: ast.Attribute) -> None:
+        locks = self.ctx.locks_for(node.attr)
+        if not locks:
+            return
+        base = _dotted(node.value)
+        if base is None:
+            return
+        guarded = False
+        for b, name, region in self._held:
+            if b == base and name in locks:
+                guarded = True
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    region.writes.setdefault(node.attr, node.lineno)
+                else:
+                    region.reads.setdefault(node.attr, node.lineno)
+        self.accesses.append((node.lineno, base, node.attr, guarded))
+
+    def _call(self, node: ast.Call) -> None:
+        f = node.func
+        name = recv = None
+        if isinstance(f, ast.Attribute):
+            name = f.attr
+            recv = _dotted(f.value)
+        elif isinstance(f, ast.Name):
+            name = f.id
+        if name is None:
+            return
+        held_pairs = tuple((b, n) for b, n, _ in self._held)
+        self.calls.append((node.lineno, name, recv, held_pairs))
+        if held_pairs:
+            what, device_class = self._blocking_kind(node, name)
+            if what is not None:
+                self.blocking.append((node.lineno, what, device_class,
+                                      self._held_names()))
+
+    def _blocking_kind(self, node: ast.Call,
+                       name: str) -> Tuple[Optional[str], bool]:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            recv = f.value
+            if name == "sleep" and isinstance(recv, ast.Name) \
+                    and recv.id in ("time", "_time"):
+                return "time.sleep()", False
+            if isinstance(recv, ast.Name) and recv.id == "requests":
+                return f"requests.{name}() network round trip", False
+            if name == "block_until_ready":
+                return "block_until_ready() device sync", True
+            if name == "item" and not node.args \
+                    and not isinstance(recv, ast.Constant):
+                return ".item() device sync", True
+            if name in _BLOCKING_ATTRS \
+                    and not isinstance(recv, ast.Constant):
+                return _BLOCKING_ATTRS[name], False
+        if name in self.ctx.entry_points:
+            return (f"jit dispatch through declared entry point "
+                    f"{name!r}"), True
+        return None, False
+
+
+# -- driver -------------------------------------------------------------------
+
+
+def _exempt_fn(qual: str) -> bool:
+    leaf = qual.rpartition(".")[2]
+    return leaf == "__init__" or leaf.endswith("_locked")
+
+
+def _build_context(mods: Sequence[L.ModuleInfo]):
+    """Global lock inventory + the cross-module underscore guard map."""
+    foreign: Dict[str, Dict[str, Set[str]]] = {}
+    constructed: Dict[str, Set[str]] = {}       # name -> {relpath}
+    reentrant_any: Set[str] = set()
+    per_mod: Dict[str, dict] = {}
+    known: Set[str] = set()
+    for mod in mods:
+        guarded, gline = declared_guarded(mod)
+        order, oline = declared_order(mod)
+        device, dline = declared_device(mod)
+        sites = lock_constructions(mod)
+        per_mod[mod.relpath] = {
+            "guarded": guarded, "gline": gline,
+            "order": order, "oline": oline,
+            "device": device, "dline": dline,
+            "sites": sites,
+        }
+        for s in sites:
+            constructed.setdefault(s.name, set()).add(mod.relpath)
+            known.add(s.name)
+            if s.reentrant:
+                reentrant_any.add(s.name)
+        for key, lock_name in (guarded or {}).items():
+            known.add(lock_name)
+            attr = key.rstrip("*")
+            if attr.startswith("_") and not key.endswith("*"):
+                foreign.setdefault(attr, {}).setdefault(
+                    mod.relpath, set()).add(lock_name)
+        known.update(order or ())
+        known.update(device or ())
+    return per_mod, constructed, reentrant_any, foreign, known
+
+
+def run_locks(root: str, paths: Optional[List[str]] = None,
+              ) -> Tuple[List[Finding], dict]:
+    """The whole static pass over the production surface. ->
+    (findings, summary) where summary carries ``locks_checks`` (real
+    analysis units: guarded accesses resolved, regions walked, pairs
+    checked, blocking calls classified — a vacuity guard on the count
+    proves the rules saw the tree), ``guarded_regions`` (per-module
+    count of ``with``-regions on declared locks) and ``vacuous`` (lock-
+    constructing modules with ZERO guarded regions — the strict driver
+    fails on these)."""
+    mods: List[L.ModuleInfo] = []
+    for path in (paths if paths is not None else L.iter_sources(root)):
+        mod = L.index_module(path, root)
+        if mod is not None and mod.relpath not in _EXEMPT_RELPATHS:
+            mods.append(mod)
+    per_mod, constructed, reentrant_any, foreign_map, known = \
+        _build_context(mods)
+
+    findings: List[Finding] = []
+    checks = 0
+    guarded_regions: Dict[str, int] = {}
+    vacuous: List[str] = []
+    # global observed order pairs: (outer, inner) -> "path:line (scope)"
+    observed: Dict[Tuple[str, str], str] = {}
+    inversion_reported: Set[frozenset] = set()
+
+    for mod in mods:
+        info = per_mod[mod.relpath]
+        guarded, order, device = (info["guarded"], info["order"],
+                                  info["device"])
+        sites: List[LockSite] = info["sites"]
+        declared_lock_names = set((guarded or {}).values()) | set(
+            device or ())
+
+        # -- declaration consistency (rides unguarded-state) --
+        # foreign sites (re-wraps of another object's lock attr, e.g.
+        # bench instrumenting REGISTRY._lock) answer to the lock's
+        # OWNING module's declarations, not this module's
+        own_sites = [s for s in sites if not s.foreign]
+        if own_sites and guarded is None:
+            findings.append(Finding(
+                "unguarded-state", mod.relpath, own_sites[0].line,
+                own_sites[0].scope,
+                f"threaded module constructs lock "
+                f"{own_sites[0].name!r} but declares no GUARDED_STATE "
+                "— the locks pass cannot hold it to any contract "
+                "(declare the state each lock guards, or DEVICE_LOCKS "
+                "for pure serialization locks)"))
+        for s in own_sites:
+            if guarded is not None and s.name not in declared_lock_names:
+                findings.append(Finding(
+                    "unguarded-state", mod.relpath, s.line, s.scope,
+                    f"lock {s.name!r} is constructed but guards no "
+                    "declared state (add its attrs to GUARDED_STATE, or "
+                    "the name to DEVICE_LOCKS if its job is serializing "
+                    "device work)"))
+        for name in sorted(set((guarded or {}).values())):
+            if name not in constructed:
+                findings.append(Finding(
+                    "unguarded-state", mod.relpath, info["gline"] or 1,
+                    "<module>",
+                    f"GUARDED_STATE names lock {name!r} but no scanned "
+                    "module constructs it (stale declaration)"))
+        for name in sorted(set(order or ())):
+            if name not in constructed:
+                findings.append(Finding(
+                    "lock-order", mod.relpath, info["oline"] or 1,
+                    "<module>",
+                    f"LOCK_ORDER names lock {name!r} but no scanned "
+                    "module constructs it (stale declaration)"))
+        for name in sorted(set(device or ())):
+            if name not in constructed:
+                findings.append(Finding(
+                    "blocking-under-lock", mod.relpath,
+                    info["dline"] or 1, "<module>",
+                    f"DEVICE_LOCKS names lock {name!r} but no scanned "
+                    "module constructs it (stale declaration)"))
+
+        ctx = _Ctx(
+            own_exact={k: v for k, v in (guarded or {}).items()
+                       if not k.endswith("*")},
+            own_prefix=[(k[:-1], v) for k, v in (guarded or {}).items()
+                        if k.endswith("*")],
+            foreign={attr: set().union(*(lk for rel, lk in by.items()
+                                         if rel != mod.relpath))
+                     for attr, by in foreign_map.items()
+                     if any(rel != mod.relpath for rel in by)},
+            known_locks=known,
+            device=set(device or ()),
+            entry_points=set(mod.declared_entry_points),
+            reentrant_here={s.name for s in sites if s.reentrant},
+            nonreentrant_here={s.name for s in sites if not s.reentrant},
+        )
+
+        scans: Dict[str, _Scan] = {}
+        region_count = 0
+        for qual, fn in sorted(mod.functions.items()):
+            scan = _Scan(mod, qual, fn, ctx)
+            scans[qual] = scan
+            checks += (1 + len(scan.accesses) + len(scan.regions)
+                       + len(scan.pairs) + len(scan.blocking))
+            region_count += sum(1 for r in scan.regions
+                                if r.name in declared_lock_names)
+
+            exempt = _exempt_fn(qual)
+            # unguarded-state: accesses outside a matching hold
+            if not exempt:
+                reported: Set[Tuple[int, str]] = set()
+                for line, base, attr, ok in scan.accesses:
+                    if ok or (line, attr) in reported:
+                        continue
+                    reported.add((line, attr))
+                    locks = sorted(ctx.locks_for(attr))
+                    findings.append(Finding(
+                        "unguarded-state", mod.relpath, line, qual,
+                        f"{base}.{attr} is declared guarded by "
+                        f"{locks[0]!r} but is touched with no matching "
+                        f"`with {base}.{locks[0]}` hold — a concurrent "
+                        "writer can interleave (take the lock, or route "
+                        "through a *_locked helper whose caller holds "
+                        "it)"))
+                for line, base, attr, lock_name in scan.escapes:
+                    findings.append(Finding(
+                        "unguarded-state", mod.relpath, line, qual,
+                        f"guarded state {base}.{attr} escapes its "
+                        f"{lock_name!r} region via return — the caller "
+                        "reads/mutates it after the lock is released "
+                        "(return a copy/snapshot instead)"))
+
+            # atomic-check-act: read-only hold, then a later acting hold
+            by_lock: Dict[Tuple[str, str], List[_Region]] = {}
+            for r in scan.regions:
+                by_lock.setdefault((r.base, r.name), []).append(r)
+            for (base, name), regions in by_lock.items():
+                for i, ri in enumerate(regions):
+                    if not ri.reads or ri.writes:
+                        continue
+                    for rj in regions[i + 1:]:
+                        acted = sorted(set(rj.writes) & set(ri.reads))
+                        if acted:
+                            findings.append(Finding(
+                                "atomic-check-act", mod.relpath,
+                                rj.line, qual,
+                                f"guarded {acted[0]!r} is tested under "
+                                f"the {name!r} hold at line {ri.line} "
+                                "but acted on under this separate "
+                                "later hold — the predicate can be "
+                                "stale by the time it acts (merge the "
+                                "holds or re-validate before acting)"))
+                            break
+
+            # blocking-under-lock
+            for line, what, device_class, held in scan.blocking:
+                offending = [h for h in held
+                             if not (device_class and h in ctx.device)]
+                if not offending:
+                    continue
+                findings.append(Finding(
+                    "blocking-under-lock", mod.relpath, line, qual,
+                    f"{what} while holding {offending[0]!r} — every "
+                    "thread contending this lock stalls behind the "
+                    "blocked holder (move the blocking work outside "
+                    "the hold"
+                    + ("" if device_class else
+                       "; DEVICE_LOCKS does not exempt host blocking")
+                    + ")"))
+
+        # -- interprocedural lock nesting --
+        suffix = L._suffix_index(mod)
+        direct: Dict[str, Set[str]] = {
+            q: set(s.direct_acquires) for q, s in scans.items()}
+        callees: Dict[str, Set[str]] = {}
+        for q, s in scans.items():
+            outs = set()
+            for _, name, _, _ in s.calls:
+                hit = suffix.get(name)
+                if hit is not None:
+                    outs.add(hit[0])
+            callees[q] = outs
+        trans = {q: set(d) for q, d in direct.items()}
+        for _ in range(len(trans)):
+            changed = False
+            for q in trans:
+                for c in callees.get(q, ()):
+                    add = trans.get(c, set()) - trans[q]
+                    if add:
+                        trans[q] |= add
+                        changed = True
+            if not changed:
+                break
+
+        pair_sites: Dict[Tuple[str, str], Tuple[int, str, bool]] = {}
+        for q, s in scans.items():
+            for outer, inner, line, same_base in s.pairs:
+                pair_sites.setdefault((outer, inner),
+                                      (line, q, same_base))
+            for line, name, recv, held in s.calls:
+                if not held:
+                    continue
+                hit = suffix.get(name)
+                if hit is None:
+                    continue
+                for inner in trans.get(hit[0], ()):
+                    for outer_base, outer in held:
+                        # a call on the SAME receiver the outer lock is
+                        # held on re-enters that instance's locks (the
+                        # self-call reentrancy shape)
+                        same = recv is not None and recv == outer_base
+                        pair_sites.setdefault((outer, inner),
+                                              (line, q, same))
+        checks += len(pair_sites)
+
+        order_idx = {name: i for i, name in enumerate(order or ())}
+        for (outer, inner), (line, q, same_base) in sorted(
+                pair_sites.items()):
+            site = f"{mod.relpath}:{line} ({q})"
+            if outer == inner:
+                if (same_base and outer in ctx.nonreentrant_here
+                        and outer not in ctx.reentrant_here):
+                    findings.append(Finding(
+                        "lock-order", mod.relpath, line, q,
+                        f"non-reentrant lock {outer!r} re-acquired on a "
+                        "path that already holds it — self-deadlock "
+                        "(make it an RLock or split the inner scope "
+                        "out)"))
+                continue
+            if outer in order_idx and inner in order_idx \
+                    and order_idx[outer] > order_idx[inner]:
+                findings.append(Finding(
+                    "lock-order", mod.relpath, line, q,
+                    f"{inner!r} acquired while holding {outer!r}, but "
+                    f"this module's LOCK_ORDER is {tuple(order)} — an "
+                    "opposite-order path deadlocks under contention"))
+            prev = observed.get((outer, inner))
+            if prev is None:
+                observed[(outer, inner)] = site
+            rev = observed.get((inner, outer))
+            key = frozenset((outer, inner))
+            if rev is not None and key not in inversion_reported:
+                inversion_reported.add(key)
+                findings.append(Finding(
+                    "lock-order", mod.relpath, line, q,
+                    f"inconsistent acquisition order: {inner!r} taken "
+                    f"while holding {outer!r} here, but the opposite "
+                    f"order is taken at {rev} — two contending threads "
+                    "deadlock"))
+
+        if own_sites or (guarded is not None and guarded):
+            guarded_regions[mod.relpath] = region_count
+            if own_sites and region_count == 0:
+                vacuous.append(mod.relpath)
+
+    summary = {
+        "locks_checks": checks,
+        "guarded_regions": guarded_regions,
+        "vacuous": sorted(vacuous),
+    }
+    return (sorted(findings, key=lambda f: (f.path, f.line, f.rule)),
+            summary)
