@@ -1473,8 +1473,39 @@ def main() -> None:
             row["measured_over_modeled"] = round(measured / modeled, 3)
         return row
 
+    def cfg_graftscope_attribution():
+        """Measured-vs-modeled attribution row (ISSUE 9): replay the
+        canonical workloads on tiny real engines with device-true
+        dispatch timing (graftscope sync mode) and join the observed
+        program rings against the recompile certifier's key sets —
+        exact rows must join 1:1 — plus the implied byte rate against
+        the cost model's per-token prediction. Compile-cheap, CPU-safe,
+        no tunnel dependency; the drift trajectory rides the journal."""
+        import sys as _sys
+        here = os.path.dirname(os.path.abspath(__file__))
+        added = here not in _sys.path
+        if added:
+            _sys.path.insert(0, here)
+        try:
+            from tools.graftcheck import scope as _scope
+            payload = _scope.run_attribution()
+        finally:
+            if added:
+                try:
+                    _sys.path.remove(here)
+                except ValueError:
+                    pass
+        return {
+            "ok": payload["ok"],
+            "workloads": [
+                {k: v for k, v in row.items() if k != "entry_points"}
+                for row in payload["workloads"]],
+            "note": payload["note"],
+        }
+
     safe("graftcheck_static_analysis", cfg_graftcheck)
     safe("graftcheck_chosen_plan", cfg_graftplan)
+    safe("graftscope_attribution", cfg_graftscope_attribution)
     safe("ici_byte_weight_calibration", cfg_ici_calibration)
     safe("cfg1_tiny_gpt2_2shard_20tok", cfg1)
 
@@ -1769,6 +1800,48 @@ def main() -> None:
     # an external timeout cuts the run short, the classic matrix rows
     # above are already journaled
     safe("cfg12_megakernel_batch_crossover", cfg12)
+
+    def cfg_bench_diff():
+        """Perf-regression verdict (ISSUE 9, tools/bench_diff.py): THIS
+        run's rows so far compared against the committed BENCH_r*.json
+        trajectory with per-metric thresholds — a step-function
+        regression lands in the journal as its own row instead of aging
+        silently in the trajectory. Runs after every measurement row so
+        the verdict covers the whole matrix."""
+        import glob as _glob
+        import sys as _sys
+        here = os.path.dirname(os.path.abspath(__file__))
+        tools = os.path.join(here, "tools")
+        added = tools not in _sys.path
+        if added:
+            _sys.path.insert(0, tools)
+        try:
+            import bench_diff as _bd
+        finally:
+            if added:
+                try:
+                    _sys.path.remove(tools)
+                except ValueError:
+                    pass
+        current = _bd.extract_metrics({"configs": configs})
+        history = _bd.load_history(
+            _glob.glob(os.path.join(here, "BENCH_r*.json")))
+        verdict = _bd.compare(
+            current, history,
+            current_errors=_bd.error_configs({"configs": configs}))
+        return {
+            "ok": verdict["ok"],
+            "compared": verdict["compared"],
+            "regressions": verdict["regressions"],
+            "history_runs": verdict["history_runs"],
+            # full per-metric rows only when something regressed — the
+            # OK case stays one compact journal line
+            **({"rows": [r for r in verdict["rows"]
+                         if r["status"] == "regression"]}
+               if verdict["regressions"] else {}),
+        }
+
+    safe("bench_diff", cfg_bench_diff)
 
     by_name = {c["name"]: c for c in configs}
     head = by_name.get("cfg2_gpt2_124m_2shard_single_prompt", {})

@@ -50,7 +50,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils import graftsched, tracing
+from ..utils import graftsched, graftscope, tracing
 from ..utils.metrics import REGISTRY, kv_block_gauges
 from .engine import DecodeEngine, GenerateResult, SamplingConfig
 
@@ -60,6 +60,18 @@ from .engine import DecodeEngine, GenerateResult, SamplingConfig
 # finding (a compiled-program population the recompile budget would
 # silently miss).
 JIT_ENTRY_POINTS = ("_merge",)
+
+# Observability contract (tools/graftcheck scope pass + utils/graftscope):
+# the prefix-round cache-merge program's dispatches are timed into the
+# graftscope ring (graftscope.instrument at the jit site).
+PROFILED_SCOPES = ("_merge",)
+
+
+def _merge_scope_key(solos, pads, length):
+    """Program key: (row count, solo cache width) — the merge compiles
+    per (batch width, cache shape) pair."""
+    first = solos[0][0] if isinstance(solos[0], list) else solos[0]
+    return (len(solos), int(first.k.shape[-2]))
 
 # Lock-discipline contract (tools/graftcheck locks pass): the round
 # counters and the held queue head live under ``_stats_lock``.
@@ -145,7 +157,9 @@ class BatchingEngine:
         self.max_wait_s = max_wait_ms / 1e3
         self.prompt_bucket = prompt_bucket
         self.steps_bucket = steps_bucket
-        self._merge = jax.jit(self._merge_impl)
+        self._merge = graftscope.instrument(
+            jax.jit(self._merge_impl), "batcher._merge",
+            key_fn=_merge_scope_key)
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._pending: Optional[_Request] = None  # held head of next round
         self._stats_lock = graftsched.lock(
@@ -478,16 +492,21 @@ class BatchingEngine:
         REGISTRY.inc("decode_batches_total")
         REGISTRY.inc("batched_requests_total", value=len(batch))
         REGISTRY.inc("batched_rows_padded_total", value=padded_rows)
-        REGISTRY.gauge("batch_occupancy",
-                       round(len(batch) / (len(batch) + padded_rows), 4),
-                       scheduler="admission")
+        occupancy = round(len(batch) / (len(batch) + padded_rows), 4)
+        depth = self._queue.qsize()
+        REGISTRY.gauge("batch_occupancy", occupancy, scheduler="admission")
+        # graftscope occupancy time series (the /debug/profile trajectory
+        # behind the instantaneous gauges) — one qsize read shared with
+        # the gauge below, so the two views cannot disagree
+        graftscope.sample("batch_occupancy", occupancy,
+                          scheduler="admission")
+        graftscope.sample("queue_depth", depth, scheduler="admission")
         # round done: its arena is released (an idle batcher must not
         # keep reporting the last round's blocks — same invariant as
         # the engine component's end-of-generate reset)
         width = len(batch) + padded_rows
         kv_block_gauges("batcher", 0, width * self.engine._cache_seq)
-        REGISTRY.gauge("queue_depth", self._queue.qsize(),
-                       scheduler="admission")
+        REGISTRY.gauge("queue_depth", depth, scheduler="admission")
         for i, req in enumerate(batch):
             # row_tokens strips the engine-reported pad — OUR bucket pad
             # plus any chunk-alignment pad the engine added on top

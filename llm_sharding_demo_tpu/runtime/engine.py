@@ -40,7 +40,7 @@ import numpy as np
 
 from ..models.gpt2 import GPT2Config, Params
 from ..ops.attention import KVCache
-from ..utils import tracing
+from ..utils import graftscope, tracing
 from ..utils.metrics import REGISTRY, CompileWatch, kv_block_gauges
 
 # Reference sampler constants (server.py:188, 191).
@@ -58,6 +58,15 @@ EOS_SEGMENT = 32
 # enumerates these, and an undeclared jit site is a lint finding (a
 # compiled-program population the budget would silently miss).
 JIT_ENTRY_POINTS = ("_prefill", "_prefill_chunked", "_decode_seg")
+
+# Observability contract (tools/graftcheck scope pass + utils/graftscope):
+# every declared jit entry point whose dispatch is timed into the
+# graftscope ring — wrapped in ``graftscope.instrument`` at the jit
+# site, with a key_fn deriving the SAME program key the recompile
+# certifier models, so measured rings join certified populations 1:1.
+# An entry point neither listed here nor baselined with a justification
+# is an ``unprofiled-entry-point`` finding.
+PROFILED_SCOPES = ("_prefill", "_prefill_chunked", "_decode_seg")
 
 # Donation contract (tools/graftcheck sanitize pass): the positional
 # arguments each jitted entry point CONSUMES (donate_argnums). Callers
@@ -99,6 +108,27 @@ def _eos_capped_segments(segs: list) -> list:
             n -= take
             cap = min(cap * 2, _EOS_CAP_MAX)
     return out
+
+
+# graftscope program-key derivations — one per profiled entry point,
+# reading the ACTUAL call operands in the exact model
+# tools/graftcheck/recompile.py certifies (engine_call_keys), so the
+# measured dispatch rings and the certified program populations join
+# key-for-key (pinned by tests/test_graftscope.py).
+
+def _prefill_scope_key(params, ids, pad):
+    return (int(ids.shape[0]), int(ids.shape[1]), pad is not None)
+
+
+def _prefill_chunked_scope_key(params, chunks, pad):
+    return (int(chunks.shape[1]), int(chunks.shape[0]))
+
+
+def _decode_seg_scope_key(params, token, cache, pad, step_keys, *,
+                          sampling, window):
+    return (int(token.shape[0]), int(step_keys.shape[0]), window, sampling,
+            "per-row" if getattr(step_keys, "ndim", 2) == 3 else "one",
+            pad is not None)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -633,13 +663,22 @@ class DecodeEngine:
         # decode donates the prefill-produced cache so the two
         # [L, B, H, max_seq, hd] buffers update in place instead of
         # doubling.
-        self._prefill = jax.jit(self._prefill_impl)
-        self._prefill_chunked = jax.jit(self._prefill_chunked_impl)
+        # each jit site rides a graftscope dispatch timer (PROFILED_SCOPES
+        # contract): per-call wall clock into the bounded attribution
+        # ring, keyed by the certifier's program-key model
+        self._prefill = graftscope.instrument(
+            jax.jit(self._prefill_impl), "engine._prefill",
+            key_fn=_prefill_scope_key)
+        self._prefill_chunked = graftscope.instrument(
+            jax.jit(self._prefill_chunked_impl), "engine._prefill_chunked",
+            key_fn=_prefill_chunked_scope_key)
         # static args: the sampling policy and the attention window (both
         # change the traced program; the step count rides the step_keys
         # shape).
-        self._decode_seg = jax.jit(self._decode_seg_impl, donate_argnums=(2,),
-                                   static_argnames=("sampling", "window"))
+        self._decode_seg = graftscope.instrument(
+            jax.jit(self._decode_seg_impl, donate_argnums=(2,),
+                    static_argnames=("sampling", "window")),
+            "engine._decode_seg", key_fn=_decode_seg_scope_key)
         # compile-event accounting (utils.metrics.CompileWatch): every NEW
         # program entering these caches increments compile_events_total
         # with a phase label — checked after invocations, off the hot
@@ -1023,8 +1062,17 @@ class DecodeEngine:
         del cache  # last segment's output aliases the donated prefill cache
         new = np.asarray(jax.block_until_ready(jnp.concatenate(parts, axis=1)))
         t2 = time.perf_counter()
+        steps_run = new.shape[1] - 1
         tracing.record("decode", t1, t2, batch=new.shape[0],
-                       steps=new.shape[1], segments=len(segs))
+                       steps=new.shape[1], segments=len(segs),
+                       step_ms=round((t2 - t1) / max(steps_run, 1) * 1e3, 3))
+        if steps_run > 0:
+            # per-decode-step time, DEVICE-inclusive: this window closes
+            # after the block_until_ready fetch above, so it covers real
+            # execution — unlike the scheduler-side dispatch windows
+            # (see utils.metrics METRIC_CATALOG's truth note)
+            REGISTRY.observe("decode_step_seconds", (t2 - t1) / steps_run,
+                             component="engine")
         self._note_compiles()
         # generation done: its cache reservation is released (an idle
         # server must not keep reporting the last request's blocks)

@@ -103,7 +103,6 @@ not ``max_seq`` slots. The pool is also the ADMISSION authority:
 from __future__ import annotations
 
 import dataclasses
-import functools
 import queue
 import threading
 import time
@@ -114,7 +113,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.attention import KVCache
-from ..utils import graftsched, tracing
+from ..utils import graftsched, graftscope, tracing
 from ..utils.metrics import REGISTRY, kv_block_gauges
 from .batcher import _round_up
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
@@ -125,6 +124,11 @@ from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
 # this module, by holding name — enumerated by the recompile-budget
 # certifier; an undeclared site is a lint finding.
 JIT_ENTRY_POINTS = ("_admit_cache",)
+
+# Observability contract (tools/graftcheck scope pass + utils/graftscope):
+# the admission-merge program's dispatches are timed into the graftscope
+# ring (graftscope.instrument at the jit site below).
+PROFILED_SCOPES = ("_admit_cache",)
 
 # Donation contract (tools/graftcheck sanitize pass): ``_admit_cache``
 # consumes the live batch cache (arg 0) — callers re-bind
@@ -269,8 +273,7 @@ class _Slot:
     done_t: float = 0.0
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _admit_cache(cache, solo, slot, roll):
+def _admit_cache_impl(cache, solo, slot, roll):
     """Merge a solo-prefilled row into batch slot ``slot``: the row's
     K/V content rolls from solo slots ``[sp - plen, sp)`` to the batch's
     ``[d - plen, d)`` (``roll = d - sp``; wrap garbage lands in the
@@ -291,6 +294,19 @@ def _admit_cache(cache, solo, slot, roll):
     if isinstance(cache, list):
         return [one(c, s) for c, s in zip(cache, solo)]
     return one(cache, solo)
+
+
+def _admit_cache_scope_key(cache, solo, slot, roll):
+    """Program key: (batch width, cache width, solo width) — slot/roll
+    are traced and never key programs."""
+    c = cache[0] if isinstance(cache, list) else cache
+    s = solo[0] if isinstance(solo, list) else solo
+    return (int(c.k.shape[1]), int(c.k.shape[-2]), int(s.k.shape[-2]))
+
+
+_admit_cache = graftscope.instrument(
+    jax.jit(_admit_cache_impl, donate_argnums=(0,)),
+    "iterbatch._admit_cache", key_fn=_admit_cache_scope_key)
 
 
 @dataclasses.dataclass
@@ -1269,17 +1285,22 @@ class IterBatchingEngine:
         point (seed, segment boundary): what the batch looks like NOW."""
         live = sum(1 for s in state.slots if s is not None)
         width = len(state.slots)
+        occupancy = round(live / max(width, 1), 4)
+        depth = self._queue.qsize()
         REGISTRY.gauge("iter_live_rows", live)
-        REGISTRY.gauge("batch_occupancy", round(live / max(width, 1), 4),
-                       scheduler="iter")
+        REGISTRY.gauge("batch_occupancy", occupancy, scheduler="iter")
         if self.pool is not None:
             # exact allocator numbers (live rows + prefix entries)
             self.pool.note_gauges(component="iter")
         else:
             kv_block_gauges("iter", state.depth * live,
                             width * self.engine._cache_seq)
-        REGISTRY.gauge("queue_depth", self._queue.qsize(),
-                       scheduler="iter")
+        REGISTRY.gauge("queue_depth", depth, scheduler="iter")
+        # graftscope occupancy time series: the trajectory behind the
+        # instantaneous gauges above, served at /debug/profile
+        graftscope.sample("iter_live_rows", live)
+        graftscope.sample("batch_occupancy", occupancy, scheduler="iter")
+        graftscope.sample("queue_depth", depth, scheduler="iter")
 
     def _advance(self, state: _BatchState):
         if state.spec_mode:
@@ -1315,6 +1336,12 @@ class IterBatchingEngine:
         seg = _SegOut(out)
         t1 = time.perf_counter()
         eng._note_compiles()
+        # per-decode-step time, serving-thread DISPATCH view: segments
+        # queue asynchronously on the device, so this is enqueue cost,
+        # not device truth (the engine-component series is; see
+        # utils.metrics METRIC_CATALOG)
+        REGISTRY.observe("decode_step_seconds", (t1 - t0) / n,
+                         component="iter")
         with self._stats_lock:
             self.segments_run += 1
         REGISTRY.inc("iter_segments_total")
@@ -1328,6 +1355,7 @@ class IterBatchingEngine:
                     s.req.trace.add_span(
                         "decode", t0, t1, seg=True, steps=n,
                         width=len(state.slots), depth=state.depth,
+                        step_ms=round((t1 - t0) / n * 1e3, 3),
                         **({"blocks": len(s.blk_ids)} if pooled else {}))
         self._retire_finished(state)
         self._set_gauges(state)
@@ -1429,6 +1457,12 @@ class IterBatchingEngine:
         REGISTRY.inc("iter_spec_segments_total")
         self.spec._note_compiles()
         t1 = time.perf_counter()
+        # per-VERIFY-step time (a spec segment's scheduling quantum);
+        # this window includes the segment's one documented host sync,
+        # so it is closer to device truth than the plain-segment view
+        REGISTRY.observe("decode_step_seconds",
+                         (t1 - t0) / max(steps_i, 1),
+                         component="iter_spec")
         for s in state.slots:
             if s is not None and s.req.trace is not None:
                 s.req.trace.add_span(

@@ -70,7 +70,7 @@ import numpy as np
 
 from ..ops import paged_attention as PA
 from ..ops.attention import KVCache
-from ..utils import graftsched, tracing
+from ..utils import graftsched, graftscope, tracing
 from ..utils.metrics import DEFAULT_KV_BLOCK_SIZE, REGISTRY, CompileWatch
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
                      _eos_capped_segments, _split_keys, _step_keys,
@@ -82,6 +82,34 @@ from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
 # sanitizer's free-block poisoner (GRAFTSAN=1 only — see GraftsanError).
 JIT_ENTRY_POINTS = ("_gather", "_scatter", "_scatter_row", "_copy",
                     "_poison")
+
+# Observability contract (tools/graftcheck scope pass + utils/graftscope):
+# every serving-path mover's dispatch is timed into the graftscope ring,
+# keyed (batch, table width) — the certifier's paged_runner_keys model.
+# ``_poison`` is deliberately NOT profiled: it is the GRAFTSAN-only
+# free-block poisoner, a sanitizer hook off every serving path —
+# baselined in tools/graftcheck/baseline.txt with that justification.
+PROFILED_SCOPES = ("_gather", "_scatter", "_scatter_row", "_copy")
+
+
+# graftscope program-key derivations (the certifier's model: gather/
+# scatter key by (batch, table width) — block ids and placement are
+# traced operands and never key programs)
+
+def _gather_scope_key(pool, tables):
+    return (int(tables.shape[0]), int(tables.shape[1]))
+
+
+def _scatter_scope_key(pool, k, v, tables):
+    return (int(tables.shape[0]), int(tables.shape[1]))
+
+
+def _scatter_row_scope_key(pool, k, v, table_row, roll):
+    return (int(k.shape[-2]), int(table_row.shape[0]))
+
+
+def _copy_scope_key(pool, src, dst):
+    return (int(src.shape[0]),)
 
 # Donation contract (tools/graftcheck sanitize pass): the pool movers
 # all consume the pool buffer itself (arg 0) — ``self.data`` is re-bound
@@ -647,10 +675,18 @@ class KVBlockPool:
         def _copy_impl(pool, src, dst):
             return PA.copy_blocks(pool, src, dst)
 
-        self._gather = jax.jit(_gather_impl)
-        self._scatter = jax.jit(_scatter_impl, donate_argnums=(0,))
-        self._scatter_row = jax.jit(_scatter_one_rolled, donate_argnums=(0,))
-        self._copy = jax.jit(_copy_impl, donate_argnums=(0,))
+        self._gather = graftscope.instrument(
+            jax.jit(_gather_impl), "kv_pool._gather",
+            key_fn=_gather_scope_key)
+        self._scatter = graftscope.instrument(
+            jax.jit(_scatter_impl, donate_argnums=(0,)),
+            "kv_pool._scatter", key_fn=_scatter_scope_key)
+        self._scatter_row = graftscope.instrument(
+            jax.jit(_scatter_one_rolled, donate_argnums=(0,)),
+            "kv_pool._scatter_row", key_fn=_scatter_row_scope_key)
+        self._copy = graftscope.instrument(
+            jax.jit(_copy_impl, donate_argnums=(0,)),
+            "kv_pool._copy", key_fn=_copy_scope_key)
         watches = [
             CompileWatch("kv_pool", self._gather),
             CompileWatch("kv_pool", self._scatter),
@@ -822,11 +858,15 @@ class KVBlockPool:
 
     def note_gauges(self, component: str = "pool") -> None:
         st = self.allocator.stats()
-        REGISTRY.gauge("kv_cache_blocks_in_use",
-                       st.blocks_in_use - st.blocks_evictable,
+        in_use = st.blocks_in_use - st.blocks_evictable
+        REGISTRY.gauge("kv_cache_blocks_in_use", in_use,
                        component=component)
         REGISTRY.gauge("kv_cache_blocks_total", st.blocks_total,
                        component=component)
+        # graftscope occupancy time series: blocks-in-use over time at
+        # the pool's own accounting points, served at /debug/profile
+        graftscope.sample("kv_cache_blocks_in_use", in_use,
+                          component=component)
 
     def stats(self) -> dict:
         return {**self.allocator.stats().as_dict(),

@@ -50,7 +50,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils import graftsched, tracing
+from ..utils import graftsched, graftscope, tracing
 from ..utils.metrics import REGISTRY
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
                      prepare_generate, select_token)
@@ -60,6 +60,16 @@ from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
 # finding (a compiled-program population the recompile budget would
 # silently miss).
 JIT_ENTRY_POINTS = ("_extend", "_extend_keep")
+
+# Observability contract (tools/graftcheck scope pass + utils/graftscope):
+# both continuation programs' dispatches are timed into the graftscope
+# ring (graftscope.instrument at the jit sites), keyed by operand shape
+# — the ids width IS the program key (one program per chunk/tail width).
+PROFILED_SCOPES = ("_extend", "_extend_keep")
+
+
+def _extend_scope_key(params, cache, ids):
+    return (int(ids.shape[0]), int(ids.shape[1]))
 
 # Donation contract (tools/graftcheck sanitize pass): ``_extend``
 # consumes its cache input (arg 1 — fresh caches and intermediate walk
@@ -179,8 +189,12 @@ class PrefixCachingEngine:
         def _run(params, cache, ids):
             return engine._forward_cached(params, ids, cache, None)
 
-        self._extend = jax.jit(_run, donate_argnums=(1,))
-        self._extend_keep = jax.jit(_run)
+        self._extend = graftscope.instrument(
+            jax.jit(_run, donate_argnums=(1,)), "prefix_cache._extend",
+            key_fn=_extend_scope_key)
+        self._extend_keep = graftscope.instrument(
+            jax.jit(_run), "prefix_cache._extend_keep",
+            key_fn=_extend_scope_key)
 
     @property
     def plain(self) -> DecodeEngine:

@@ -76,7 +76,7 @@ import numpy as np
 
 from ..models.gpt2 import GPT2Config, Params
 from ..ops.attention import KVCache
-from ..utils import graftsched, tracing
+from ..utils import graftsched, graftscope, tracing
 from ..utils.metrics import REGISTRY, CompileWatch
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
                      prepare_generate, sampler_pmf, select_token)
@@ -86,6 +86,32 @@ from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
 # this module, by holding attribute — enumerated by the recompile-budget
 # certifier; an undeclared site is a lint finding.
 JIT_ENTRY_POINTS = ("_loop", "_loop_b", "_seg_b")
+
+# Observability contract (tools/graftcheck scope pass + utils/graftscope):
+# every declared jit entry point's dispatch is timed into the graftscope
+# ring (graftscope.instrument at the jit site), keyed in the certifier's
+# program-key model (recompile.spec_call_keys / iter_spec_segment_keys).
+PROFILED_SCOPES = ("_loop", "_loop_b", "_seg_b")
+
+
+# graftscope program-key derivations (the certifier's model: _loop ->
+# (max_new, sampling, pad present); _loop_b -> (b, max_new, sampling);
+# _seg_b -> (width, max_verify, sampling) — acceptance counts and
+# budgets are traced and never key programs)
+
+def _loop_scope_key(params, first_token, cache, buf, total, key, pad, *,
+                    max_new, sampling):
+    return (max_new, sampling, pad is not None)
+
+
+def _loop_b_scope_key(params, first, cache, buf, total, keys, pad, *,
+                      max_new, sampling):
+    return (int(first.shape[0]), max_new, sampling)
+
+
+def _seg_b_scope_key(params, buf, cache, total, pad, keys, budgets, *,
+                     max_verify, sampling):
+    return (int(buf.shape[0]), max_verify, sampling)
 
 # Donation contract (tools/graftcheck sanitize pass): consumed
 # positional arguments per entry point. ``_loop``/``_loop_b`` donate
@@ -160,18 +186,24 @@ class SpecDecodeEngine:
         self._requests = 0
         self._verifies = 0
         self._emitted = 0
-        self._loop = jax.jit(self._loop_impl,
-                             static_argnames=("max_new", "sampling"),
-                             donate_argnums=(2,))
+        self._loop = graftscope.instrument(
+            jax.jit(self._loop_impl,
+                    static_argnames=("max_new", "sampling"),
+                    donate_argnums=(2,)),
+            "spec_decode._loop", key_fn=_loop_scope_key)
         # Batched variants (one program per batch width + policy, never
         # per acceptance pattern): the full-generation loop and the
         # bounded segment program the iteration scheduler drives.
-        self._loop_b = jax.jit(self._loop_b_impl,
-                               static_argnames=("max_new", "sampling"),
-                               donate_argnums=(2, 3))
-        self._seg_b = jax.jit(self._seg_b_impl,
-                              static_argnames=("max_verify", "sampling"),
-                              donate_argnums=(1, 2))
+        self._loop_b = graftscope.instrument(
+            jax.jit(self._loop_b_impl,
+                    static_argnames=("max_new", "sampling"),
+                    donate_argnums=(2, 3)),
+            "spec_decode._loop_b", key_fn=_loop_b_scope_key)
+        self._seg_b = graftscope.instrument(
+            jax.jit(self._seg_b_impl,
+                    static_argnames=("max_verify", "sampling"),
+                    donate_argnums=(1, 2)),
+            "spec_decode._seg_b", key_fn=_seg_b_scope_key)
         # compile-event accounting (one increment per NEW (width, policy)
         # program — see utils.metrics.CompileWatch); the iteration
         # scheduler checks the segment watch after its dispatches

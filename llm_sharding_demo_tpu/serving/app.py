@@ -628,6 +628,26 @@ def create_app(cfg: Optional[ServingConfig] = None,
             "requests": rec.snapshot(n=n, slowest=slowest),
         }
 
+    @app.get("/debug/profile")
+    def debug_profile(query: dict):
+        """graftscope attribution view (utils/graftscope): bounded
+        per-program dispatch-timing rings for every PROFILED_SCOPES jit
+        entry point plus the occupancy time series (pool blocks in use,
+        batch occupancy, queue depth). ``?n=K`` caps ring samples and
+        series points per entry. Honesty header rides the payload: the
+        dispatch numbers are serving-thread enqueue windows unless sync
+        mode is armed (never in serving) — device-level truth is the
+        profiler trace's job, exactly as utils/tracing documents."""
+        try:
+            n = int(query.get("n", "32"))
+        except ValueError:
+            return 422, {"detail": "n must be an integer"}
+        from ..utils import graftscope
+        return {
+            "serving": _topology(),
+            **graftscope.snapshot(n=n),
+        }
+
     @app.post("/forward")
     def forward_a(req: InputIDs):
         if cfg.shard_role != "a":

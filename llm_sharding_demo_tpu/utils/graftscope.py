@@ -1,0 +1,356 @@
+"""graftscope: always-on, low-overhead device-time attribution.
+
+The serving stack had span trees, histograms, and a flight recorder
+(utils.tracing, utils.metrics) — but nothing ever recorded *which
+compiled program* a unit of wall time went to, so the cost model's
+predictions (tools/graftcheck/costmodel.py) were never confronted with
+measured device time. This module closes that loop with three pieces:
+
+- **per-program dispatch rings**: every declared jit entry point's
+  dispatch site is wrapped by ``instrument`` (declared per module in
+  ``PROFILED_SCOPES`` beside ``JIT_ENTRY_POINTS``; the graftcheck
+  ``unprofiled-entry-point`` rule verifies every entry point is either
+  wrapped or baselined with a justification). Each call records one
+  bounded-ring sample ``(t, program_key, seconds)`` — the key derived
+  by the call site's ``key_fn`` from the ACTUAL call operands, in the
+  same model ``tools/graftcheck/recompile.py`` certifies, so
+  ``python -m tools.graftcheck scope`` can join measured rings against
+  certified program populations 1:1;
+- **occupancy time series**: bounded rings of ``(t, value)`` points for
+  the live-state gauges (pool blocks in use, batch occupancy, queue
+  depth), sampled at the schedulers' existing decision points — the
+  trajectory behind the instantaneous /metrics gauges;
+- **the /debug/profile view**: ``snapshot()`` serves both, bounded, at
+  ``GET /debug/profile`` (serving/app.py).
+
+Truth model (the same honesty contract utils.tracing documents): jax
+dispatch is ASYNC, so by default a dispatch sample measures the
+serving-thread wall clock around ENQUEUE — cheap enough to stay on for
+every production dispatch, but NOT device time. ``set_sync(True)`` (or
+``GRAFTSCOPE_SYNC=1``) makes every instrumented dispatch close its
+window through ``jax.block_until_ready`` (``tracing.timed(sync=...)``):
+device-true attribution at the price of serialized dispatch — what the
+``graftcheck scope`` attribution run uses, never the serving default.
+
+Overhead: one enabled-flag check, two ``perf_counter`` reads, one
+histogram observation, and one deque append per dispatch. The pinned
+bound (tests/test_graftscope.py): a quick-tier decode run with rings
+enabled stays within ``OVERHEAD_FACTOR`` of rings-disabled wall time,
+and every ring is bounded regardless of traffic volume. ``GRAFTSCOPE=0``
+disables recording entirely (the wrapper short-circuits).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from typing import Callable, Dict, Optional, Tuple
+
+from . import graftsched, tracing
+
+# Lock-discipline contract (tools/graftcheck locks pass): the dispatch
+# rings and the time-series points are written by scheduler/handler
+# threads and read by /debug/profile handlers concurrently — both maps
+# live under the state instance's ``_lock``.
+GUARDED_STATE = {"_rings": "_lock", "_points": "_lock"}
+LOCK_ORDER = ("_lock",)
+
+# bounded-ring capacities: per-scope dispatch samples and per-series
+# occupancy points kept (oldest dropped — a ring, not a log)
+RING_CAPACITY = 256
+SERIES_CAPACITY = 512
+# distinct program keys tracked per scope: the compiled-program space is
+# bounded by construction (the recompile budget proves it), so this cap
+# only backstops a key-model bug; overflow aggregates under _OVERFLOW
+KEY_CAPACITY = 512
+_OVERFLOW = ("<key-overflow>",)
+
+# The declared overhead bound tests/test_graftscope.py pins: a decode
+# run with rings enabled must finish within this factor of the same run
+# with rings disabled (generous — CPU wall clocks are noisy; the real
+# per-dispatch cost is a few microseconds).
+OVERHEAD_FACTOR = 2.0
+
+_enabled = [os.environ.get("GRAFTSCOPE", "1") != "0"]
+_sync = [os.environ.get("GRAFTSCOPE_SYNC", "0") not in ("", "0")]
+
+
+def enabled() -> bool:
+    return _enabled[0]
+
+
+def set_enabled(value: bool) -> bool:
+    """Toggle recording (returns the previous value). The overhead test
+    uses this for its rings-disabled baseline; production leaves it on."""
+    prev = _enabled[0]
+    _enabled[0] = bool(value)
+    return prev
+
+
+def sync_enabled() -> bool:
+    return _sync[0]
+
+
+def set_sync(value: bool) -> bool:
+    """Toggle device-true dispatch windows (block_until_ready before
+    each sample closes — see the module docstring's truth model)."""
+    prev = _sync[0]
+    _sync[0] = bool(value)
+    return prev
+
+
+class ScopeState:
+    """The process-wide attribution state: per-scope dispatch rings +
+    per-series occupancy points, all bounded."""
+
+    def __init__(self):
+        self._lock = graftsched.lock("graftscope.ScopeState._lock")
+        # scope -> {"samples": deque[(t, key, secs)],
+        #           "programs": {key: [calls, secs]}}
+        self._rings: Dict[str, dict] = {}
+        # (name, labels-kv-tuple) -> deque[(t, value)]
+        self._points: Dict[Tuple[str, tuple], deque] = {}
+        self.t0 = time.perf_counter()
+
+    # -- recording -----------------------------------------------------------
+
+    def record(self, scope: str, key: tuple, seconds: float) -> None:
+        now = time.perf_counter()
+        with self._lock:
+            ring = self._rings.get(scope)
+            if ring is None:
+                ring = self._rings[scope] = {
+                    "samples": deque(maxlen=RING_CAPACITY), "programs": {}}
+            programs = ring["programs"]
+            if key not in programs and len(programs) >= KEY_CAPACITY:
+                key = _OVERFLOW
+            stat = programs.setdefault(key, [0, 0.0])
+            stat[0] += 1
+            stat[1] += seconds
+            ring["samples"].append((now, key, seconds))
+
+    def sample(self, name: str, value: float, **labels) -> None:
+        now = time.perf_counter()
+        skey = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            pts = self._points.get(skey)
+            if pts is None:
+                pts = self._points[skey] = deque(maxlen=SERIES_CAPACITY)
+            pts.append((now, float(value)))
+
+    # -- reading -------------------------------------------------------------
+
+    def program_keys(self, scope: str) -> Dict[tuple, Tuple[int, float]]:
+        """``{program_key: (calls, seconds_total)}`` for one scope —
+        what ``tools/graftcheck scope`` joins against the certifier."""
+        with self._lock:
+            ring = self._rings.get(scope)
+            if ring is None:
+                return {}
+            return {k: (v[0], v[1]) for k, v in ring["programs"].items()}
+
+    def scope_seconds(self, scope: str) -> float:
+        with self._lock:
+            ring = self._rings.get(scope)
+            if ring is None:
+                return 0.0
+            return sum(v[1] for v in ring["programs"].values())
+
+    def snapshot(self, n: int = 32) -> dict:
+        """Bounded JSON view (the /debug/profile payload body): per-scope
+        totals + the last ``n`` ring samples, per-series last ``n``
+        points. Times are milliseconds relative to process attribution
+        start; program keys are stringified."""
+        n = max(int(n), 0)
+        with self._lock:
+            dispatch = {}
+            for scope in sorted(self._rings):
+                ring = self._rings[scope]
+                programs = ring["programs"]
+                # the per-key table is payload-bounded independently of
+                # KEY_CAPACITY: hottest keys first, and a truncation is
+                # MARKED (a silent cap would read as "all programs
+                # shown" exactly when a key-model bug mints too many)
+                top = sorted(programs.items(),
+                             key=lambda kv: kv[1][1], reverse=True)
+                entry = {
+                    "calls": sum(v[0] for v in programs.values()),
+                    "seconds_total": round(
+                        sum(v[1] for v in programs.values()), 6),
+                    "programs": len(programs),
+                    "keys": {
+                        repr(k): {"calls": v[0],
+                                  "seconds_total": round(v[1], 6)}
+                        for k, v in top[:64]},
+                    "ring": [
+                        {"t_ms": round((t - self.t0) * 1e3, 3),
+                         "key": repr(k), "ms": round(s * 1e3, 4)}
+                        for t, k, s in
+                        (list(ring["samples"])[-n:] if n else [])],
+                }
+                if len(programs) > 64:
+                    entry["keys_truncated"] = True
+                dispatch[scope] = entry
+            series = {}
+            for (name, labels), pts in sorted(self._points.items()):
+                label = name + ("{%s}" % ",".join(
+                    f"{k}={v}" for k, v in labels) if labels else "")
+                series[label] = [
+                    [round((t - self.t0) * 1e3, 3), v]
+                    for t, v in (list(pts)[-n:] if n else [])]
+        return {
+            "enabled": enabled(),
+            "sync": sync_enabled(),
+            "ring_capacity": RING_CAPACITY,
+            "series_capacity": SERIES_CAPACITY,
+            # the honesty header (same contract as utils.tracing): what
+            # these numbers are and are not
+            "truth": ("dispatch samples measure serving-thread wall "
+                      "clock around enqueue (async dispatch); sync mode "
+                      "closes windows via block_until_ready = device "
+                      "truth, used by graftcheck scope attribution runs"),
+            "dispatch": dispatch,
+            "series": series,
+        }
+
+    # -- test isolation (tests/conftest.py) ----------------------------------
+
+    def dump_state(self) -> tuple:
+        with self._lock:
+            rings = {
+                scope: {"samples": list(ring["samples"]),
+                        "programs": {k: list(v)
+                                     for k, v in ring["programs"].items()}}
+                for scope, ring in self._rings.items()}
+            points = {k: list(v) for k, v in self._points.items()}
+        return rings, points, self.t0
+
+    def restore_state(self, state: tuple) -> None:
+        rings, points, t0 = state
+        with self._lock:
+            self._rings = {
+                scope: {"samples": deque(ring["samples"],
+                                         maxlen=RING_CAPACITY),
+                        "programs": {k: list(v)
+                                     for k, v in ring["programs"].items()}}
+                for scope, ring in rings.items()}
+            self._points = {k: deque(v, maxlen=SERIES_CAPACITY)
+                            for k, v in points.items()}
+            self.t0 = t0
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rings = {}
+            self._points = {}
+            self.t0 = time.perf_counter()
+
+
+# process-wide default state (what serving.app and the instrumented
+# entry points use; tests snapshot/restore it via the conftest fixture)
+STATE = ScopeState()
+
+
+def _default_key(args, kwargs) -> tuple:
+    """Shape-derived fallback program key for entry points without a
+    hand-written ``key_fn``: array operand shapes + hashable statics —
+    a superset-faithful stand-in for the jit cache key (same operand
+    shapes/statics -> same key)."""
+    parts = []
+    for a in args:
+        shp = getattr(a, "shape", None)
+        if shp is not None:
+            parts.append(tuple(shp))
+    for k in sorted(kwargs):
+        v = kwargs[k]
+        parts.append((k, v if isinstance(v, (int, float, str, bool,
+                                             type(None))) else repr(v)))
+    return tuple(parts)
+
+
+class ProfiledFn:
+    """Callable wrapper timing every dispatch of one jitted entry point
+    into the scope ring (plus the ``dispatch_seconds`` histogram via
+    ``tracing.timed`` — whose ``sync=`` mode supplies device truth when
+    armed). Transparent otherwise: attributes (``_cache_size``, etc.)
+    forward to the wrapped jit object, so CompileWatch and the
+    recompile-budget tests see the real cache."""
+
+    __slots__ = ("_fn", "_scope", "_key_fn")
+
+    def __init__(self, fn, scope: str,
+                 key_fn: Optional[Callable] = None):
+        self._fn = fn
+        self._scope = scope
+        self._key_fn = key_fn
+
+    def __call__(self, *args, **kwargs):
+        if not _enabled[0]:
+            return self._fn(*args, **kwargs)
+        with tracing.timed("dispatch_seconds", sync=_sync[0],
+                           scope=self._scope) as h:
+            out = h.sync(self._fn(*args, **kwargs))
+        try:
+            key = (self._key_fn(*args, **kwargs)
+                   if self._key_fn is not None
+                   else _default_key(args, kwargs))
+        except Exception:  # noqa: BLE001 — a key-model slip must never
+            key = ("<unkeyed>",)  # cost the dispatch its result
+        STATE.record(self._scope, key, h.seconds)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+def instrument(fn, scope: str,
+               key_fn: Optional[Callable] = None) -> ProfiledFn:
+    """Wrap a jitted callable for dispatch-ring attribution. THE form
+    the graftcheck ``unprofiled-entry-point`` rule recognizes at jit
+    sites: ``self._x = graftscope.instrument(jax.jit(...), "mod._x",
+    key_fn=...)``. ``key_fn(*call args)`` must return the program key in
+    the model ``tools/graftcheck/recompile.py`` certifies for this entry
+    point (omit it for entry points outside the certifier's model — the
+    shape-derived default key still distinguishes programs)."""
+    return ProfiledFn(fn, scope, key_fn)
+
+
+# -- module-level conveniences (the call-site API) ---------------------------
+
+
+def record(scope: str, key: tuple, seconds: float) -> None:
+    if _enabled[0]:
+        STATE.record(scope, key, seconds)
+
+
+def sample(name: str, value: float, **labels) -> None:
+    """Append one occupancy point to the bounded time-series ring.
+    ``name`` must be a METRIC_CATALOG gauge (the metric-catalog rule
+    scans these call sites too) — the series is the trajectory behind
+    the same-named /metrics gauge."""
+    if _enabled[0]:
+        STATE.sample(name, value, **labels)
+
+
+def program_keys(scope: str) -> Dict[tuple, Tuple[int, float]]:
+    return STATE.program_keys(scope)
+
+
+def scope_seconds(scope: str) -> float:
+    return STATE.scope_seconds(scope)
+
+
+def snapshot(n: int = 32) -> dict:
+    return STATE.snapshot(n=n)
+
+
+def dump_state() -> tuple:
+    return STATE.dump_state()
+
+
+def restore_state(state: tuple) -> None:
+    STATE.restore_state(state)
+
+
+def clear() -> None:
+    STATE.clear()

@@ -52,6 +52,16 @@ METRIC_CATALOG: Dict[str, str] = {
     # time-to-first-token and per-token (inter-token) time, per mode
     "ttft_seconds": "histogram",
     "tpot_seconds": "histogram",
+    # graftscope device-time attribution (utils/graftscope.py):
+    # per-dispatch wall clock of every PROFILED_SCOPES jit entry point,
+    # labeled scope="module._entry" — serving-thread enqueue windows by
+    # default, device truth under GRAFTSCOPE_SYNC=1 (see graftscope's
+    # truth model); and the per-decode-step time each decode front end
+    # derives from its own timing window, labeled by component
+    # (component="engine": device-inclusive, the final fetch syncs;
+    # component="iter"/"iter_spec": serving-thread dispatch view)
+    "dispatch_seconds": "histogram",
+    "decode_step_seconds": "histogram",
     # admission batcher (runtime/batcher.py)
     "decode_batches_total": "counter",
     "batched_requests_total": "counter",

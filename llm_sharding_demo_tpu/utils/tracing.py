@@ -11,6 +11,10 @@ never uses it (reference server.py:3). Two layers here:
   (``jax.profiler.TraceAnnotation``);
 - ``timed(name)``: lightweight host-side wall-clock span recording into
   ``utils.metrics.REGISTRY`` — per-request numbers /metrics exposes.
+  ``timed(..., sync=True)`` additionally ``block_until_ready``s the
+  value the body hands to ``handle.sync(...)`` before closing the
+  window: DEVICE truth instead of the async-dispatch enqueue window
+  (utils.graftscope's attribution mode uses it; serving never does).
 
 **Request traces** (always-on, no profiler attached): every /generate
 request carries a ``RequestTrace`` — a tree of timed spans (tokenize →
@@ -76,16 +80,56 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
+class _TimedHandle:
+    """What ``timed`` yields: hand ``sync(value)`` the dispatch result
+    to opt that value into the window's close (device truth when the
+    ``sync=`` mode is armed); ``seconds`` carries the measured duration
+    after the block exits (graftscope's ring reads it)."""
+
+    __slots__ = ("seconds", "_sync_value", "_armed")
+
+    def __init__(self, armed: bool):
+        self._armed = armed
+        self._sync_value = None
+        self.seconds = 0.0
+
+    def sync(self, value):
+        self._sync_value = value
+        return value
+
+
 @contextlib.contextmanager
-def timed(name: str, registry=None, **labels) -> Iterator[None]:
-    """Wall-clock span recorded as a histogram observation."""
+def timed(name: str, registry=None, sync: bool = False,
+          **labels) -> Iterator[_TimedHandle]:
+    """Wall-clock span recorded as a histogram observation.
+
+    Truth model: jax dispatch is ASYNC, so by default the window closes
+    when the body returns — i.e. when the device work was ENQUEUED (the
+    honest serving-thread view; the device may still be executing, so
+    device time is silently undercounted). ``sync=True`` closes the
+    window only after ``jax.block_until_ready`` on the value the body
+    registered via ``handle.sync(...)`` — device truth, at the price of
+    a blocking host sync per window (graftscope's attribution runs use
+    it; the serving path never does). Both behaviors are pinned by
+    tests/test_observability.py.
+    """
     from .metrics import REGISTRY
     reg = registry if registry is not None else REGISTRY
+    h = _TimedHandle(bool(sync))
     t0 = time.perf_counter()
+    body_ok = False
     try:
-        yield
+        yield h
+        body_ok = True
     finally:
-        reg.observe(name, time.perf_counter() - t0, **labels)
+        if body_ok and h._armed and h._sync_value is not None:
+            # only after a SUCCESSFUL body: a body exception must
+            # propagate unmasked, not be replaced by whatever a
+            # poisoned in-flight computation raises from the sync
+            import jax
+            jax.block_until_ready(h._sync_value)
+        h.seconds = time.perf_counter() - t0
+        reg.observe(name, h.seconds, **labels)
 
 
 # -- request-scoped span trees -----------------------------------------------

@@ -75,6 +75,7 @@ _QUICK_MODULES = {
     "test_graftplan",       # cost model goldens + planner rankings
     "test_graftsan",        # donation-aliasing pass + pool sanitizer
     "test_graftlock",       # lock-discipline pass + GRAFTSCHED harness
+    "test_graftscope",      # device-time attribution + bench_diff gate
 }
 
 
@@ -94,18 +95,24 @@ def pytest_collection_modifyitems(config, items):
 
 @pytest.fixture(autouse=True)
 def _metrics_isolation():
-    """Snapshot/restore the process-global metrics REGISTRY and flight
-    RECORDER around every test: modules bind ``REGISTRY`` at import, so
-    it cannot be swapped per-test — but its STATE can, which is what
-    metric assertions need (one test's generate calls must not inflate
-    another's counters). ``create_app`` additionally accepts an injected
+    """Snapshot/restore the process-global metrics REGISTRY, flight
+    RECORDER, and graftscope attribution rings around every test:
+    modules bind these at import, so they cannot be swapped per-test —
+    but their STATE can, which is what metric/ring assertions need (one
+    test's generate calls must not inflate another's counters or
+    dispatch rings). ``create_app`` additionally accepts an injected
     registry/recorder for tests that want full isolation."""
-    from llm_sharding_demo_tpu.utils import metrics, tracing
+    from llm_sharding_demo_tpu.utils import graftscope, metrics, tracing
     state = metrics.REGISTRY.dump_state()
+    scope_state = graftscope.dump_state()
+    scope_flags = (graftscope.enabled(), graftscope.sync_enabled())
     with tracing.RECORDER._lock:
         saved = list(tracing.RECORDER._traces)
     yield
     metrics.REGISTRY.restore_state(state)
+    graftscope.restore_state(scope_state)
+    graftscope.set_enabled(scope_flags[0])
+    graftscope.set_sync(scope_flags[1])
     with tracing.RECORDER._lock:
         tracing.RECORDER._traces.clear()
         tracing.RECORDER._traces.extend(saved)

@@ -91,6 +91,27 @@ def test_repo_passes_graftcheck():
         assert regions.get(rel, 0) >= 1, (
             f"{rel}: no guarded region — its GUARDED_STATE declaration "
             "no longer matches any `with <lock>` hold")
+    assert payload["scope_checks"] >= 10, (
+        "graftscope static pass went vacuous — a new unprofiled jit "
+        "entry point anywhere in the tree fails this strict run (rule "
+        "fixtures in tests/test_graftscope.py)")
+    assert payload["scope_vacuous"] == [], (
+        "entry-point-declaring modules with ZERO graftscope-"
+        "instrumented jit sites — device-time attribution went blind "
+        f"there: {payload['scope_vacuous']}")
+    # every runtime module with jit entry points has live profiled
+    # dispatch sites (the PROFILED_SCOPES contract is not just declared)
+    scoped = payload["scope_profiled_regions"]
+    for rel in ("llm_sharding_demo_tpu/runtime/engine.py",
+                "llm_sharding_demo_tpu/runtime/iterbatch.py",
+                "llm_sharding_demo_tpu/runtime/spec_decode.py",
+                "llm_sharding_demo_tpu/runtime/kv_pool.py",
+                "llm_sharding_demo_tpu/runtime/batcher.py",
+                "llm_sharding_demo_tpu/runtime/prefix_cache.py"):
+        assert scoped.get(rel, 0) >= 1, (
+            f"{rel}: no graftscope-instrumented jit site — its "
+            "PROFILED_SCOPES declaration no longer matches any "
+            "graftscope.instrument wrap")
     assert payload["suppressed"] >= 1, (
         "the documented sync points should be baselined findings — an "
         "empty suppression set means the host-sync rule stopped seeing "

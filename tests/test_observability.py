@@ -90,6 +90,45 @@ def test_timed_records():
     assert reg.snapshot()["span_seconds{phase=x}_count"] == 1
 
 
+class _SlowReady:
+    """Stand-in for an in-flight device value: ``block_until_ready``
+    costs visible wall time (what async dispatch hides from a naive
+    wall-clock window)."""
+
+    def __init__(self, seconds=0.05):
+        self.seconds = seconds
+        self.blocked = False
+
+    def block_until_ready(self):
+        import time as _t
+        self.blocked = True
+        _t.sleep(self.seconds)
+        return self
+
+
+def test_timed_sync_mode_includes_device_wait():
+    """The ISSUE 9 satellite pin: ``timed(sync=True)`` closes its
+    window only after block_until_ready on the registered value —
+    device truth — while the default window measures enqueue only (the
+    documented serving-thread view, which silently undercounts device
+    time)."""
+    reg = MetricsRegistry()
+    v = _SlowReady(0.05)
+    with timed("span_seconds", registry=reg, sync=True, phase="dev") as h:
+        assert h.sync(v) is v        # sync() passes the value through
+    assert v.blocked
+    assert h.seconds >= 0.05         # the device wait is inside the span
+    assert reg.snapshot()["span_seconds{phase=dev}_sum"] >= 0.05
+
+    reg2 = MetricsRegistry()
+    v2 = _SlowReady(0.05)
+    with timed("span_seconds", registry=reg2, phase="host") as h2:
+        h2.sync(v2)                  # registered but sync mode is OFF
+    assert not v2.blocked            # default: enqueue window, no sync
+    assert h2.seconds < 0.05
+    assert reg2.snapshot()["span_seconds{phase=host}_sum"] < 0.05
+
+
 def test_registry_dump_restore_roundtrip():
     reg = MetricsRegistry()
     reg.inc("requests_total")

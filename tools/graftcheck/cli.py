@@ -1,6 +1,6 @@
 """graftcheck CLI.
 
-Two modes, one module entry point:
+Three modes, one module entry point:
 
 - ``python -m tools.graftcheck [--json] [--lint-only] [--strict]`` —
   the verifier (exit 0 iff every finding from both passes is baselined;
@@ -13,6 +13,12 @@ Two modes, one module entry point:
   compile-free, print the ranked table and the chosen config's env
   vars. ``--json`` emits the full payload (schema:
   docs/ARCHITECTURE.md "Planning").
+- ``python -m tools.graftcheck scope [--json]`` — measured-vs-modeled
+  attribution (tools/graftcheck/scope.py): replay canonical workloads
+  on tiny real engines with device-true dispatch timing, join the
+  graftscope rings against the recompile certifier's program keys
+  (exact rows must join 1:1 — the exit code) and report the implied
+  byte rate against the cost model's per-token prediction.
 
 ``--json`` payloads are journaled by bench.py alongside the perf matrix
 (rows ``graftcheck_static_analysis`` and ``graftcheck_chosen_plan``),
@@ -51,12 +57,14 @@ def run(root: str = None, lint_only: bool = False,
     if added:
         sys.path.insert(0, root)
     try:
-        from . import lint, locks, sanitize
+        from . import lint, locks, sanitize, scope
         findings = list(lint.run_lint(root))
         san, sanitize_checks = sanitize.run_sanitize(root)
         findings.extend(san)
         lk, locks_summary = locks.run_locks(root)
         findings.extend(lk)
+        sc, scope_summary = scope.run_scope_static(root)
+        findings.extend(sc)
         semantic_checks = 0
         bounds = {}
         if not lint_only:
@@ -91,8 +99,12 @@ def run(root: str = None, lint_only: bool = False,
         # strict additionally fails on a VACUOUS locks pass (a lock-
         # constructing module with zero guarded regions means the
         # concurrency contract stopped seeing that module's locking)
+        # and on a VACUOUS profiling contract (a runtime module with
+        # jit entry points but zero graftscope-instrumented dispatch
+        # sites — device-time attribution went blind there)
         "ok": (not active and not (strict and stale)
-               and not (strict and locks_summary["vacuous"])),
+               and not (strict and locks_summary["vacuous"])
+               and not (strict and scope_summary["vacuous"])),
         "strict": strict,
         "findings": [f.to_dict() for f in active],
         "suppressed": len(suppressed),
@@ -103,6 +115,9 @@ def run(root: str = None, lint_only: bool = False,
         "locks_checks": locks_summary["locks_checks"],
         "locks_guarded_regions": locks_summary["guarded_regions"],
         "locks_vacuous": locks_summary["vacuous"],
+        "scope_checks": scope_summary["scope_checks"],
+        "scope_profiled_regions": scope_summary["profiled_regions"],
+        "scope_vacuous": scope_summary["vacuous"],
         "recompile_bounds": bounds,
     }
 
@@ -189,8 +204,36 @@ def run_plan(args) -> int:
     return 0
 
 
+def run_scope_cmd(args) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    root = args.root or _repo_root()
+    added = root not in sys.path
+    if added:
+        sys.path.insert(0, root)
+    try:
+        from . import scope
+        return scope.main_scope(args)
+    finally:
+        if added:
+            try:
+                sys.path.remove(root)
+            except ValueError:
+                pass
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "scope":
+        ap = argparse.ArgumentParser(
+            prog="python -m tools.graftcheck scope",
+            description="measured-vs-modeled attribution: replay the "
+                        "canonical workloads on tiny real engines with "
+                        "device-true dispatch timing and join the "
+                        "graftscope rings against the certifier's "
+                        "program keys and the cost model's predictions")
+        ap.add_argument("--root", default=None)
+        ap.add_argument("--json", action="store_true")
+        return run_scope_cmd(ap.parse_args(argv[1:]))
     if argv and argv[0] == "plan":
         ap = argparse.ArgumentParser(
             prog="python -m tools.graftcheck plan",
@@ -256,7 +299,8 @@ def main(argv=None) -> int:
         print(f"graftcheck: {n} active finding(s), "
               f"{payload['suppressed']} baselined, "
               f"{payload['semantic_checks']} semantic checks, "
-              f"{payload['sanitize_checks']} sanitize checks"
+              f"{payload['sanitize_checks']} sanitize checks, "
+              f"{payload['scope_checks']} scope checks"
               + ("" if args.lint_only else
                  f", recompile bounds for {len(payload['recompile_bounds'])}"
                  " workload(s)"))

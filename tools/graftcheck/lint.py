@@ -77,6 +77,8 @@ class JitSite:
     enclosing: str               # qualname of the enclosing function or
                                  # "<module>"
     depth: int                   # 0 = module level
+    profiled: bool = False       # wrapped in graftscope.instrument(...)
+                                 # (the scope pass's dispatch timer)
 
 
 @dataclasses.dataclass
@@ -91,7 +93,9 @@ class ModuleInfo:
     jit_sites: List[JitSite]
     declared_entry_points: Set[str]
     declared_hot_loops: Set[str]
+    declared_profiled: Set[str]            # PROFILED_SCOPES declaration
     entry_decl_line: int
+    profiled_decl_line: int
     jit_target_quals: Set[str]             # qualnames of jitted defs
 
 
@@ -114,6 +118,24 @@ def _jit_call(node: ast.AST) -> Optional[ast.Call]:
             and node.args and _is_jax_jit(node.args[0])):
         return node
     return None
+
+
+def _instrument_call(node: ast.AST) -> Optional[ast.Call]:
+    """The inner ``jax.jit`` call when ``node`` is a graftscope dispatch
+    wrapper — ``graftscope.instrument(jax.jit(...), "scope", ...)`` (or
+    bare ``instrument(...)``). The wrapper is transparent to the jit-site
+    rules (the holding name still resolves through the Assign target)
+    and marks the site ``profiled`` for the scope pass."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    named = ((isinstance(f, ast.Attribute) and f.attr == "instrument"
+              and isinstance(f.value, ast.Name)
+              and f.value.id == "graftscope")
+             or (isinstance(f, ast.Name) and f.id == "instrument"))
+    if not named or not node.args:
+        return None
+    return _jit_call(node.args[0])
 
 
 def _string_tuple(node: ast.AST) -> Optional[Set[str]]:
@@ -222,11 +244,23 @@ class _Indexer(ast.NodeVisitor):
                         vals = _string_tuple(node.value)
                         if vals is not None:
                             self.mod.declared_hot_loops |= vals
+                    elif tgt.id == "PROFILED_SCOPES":
+                        vals = _string_tuple(node.value)
+                        if vals is not None:
+                            self.mod.declared_profiled |= vals
+                            self.mod.profiled_decl_line = node.lineno
                 if not self.stack:
                     self.mod.module_names.add(tgt.id)
         # jit assignment forms: ``self.X = jax.jit(f, ...)`` and
-        # ``X = jax.jit(f, ...)``
+        # ``X = jax.jit(f, ...)``, optionally wrapped in the graftscope
+        # dispatch timer: ``self.X = graftscope.instrument(jax.jit(...),
+        # "mod.X", ...)`` — the wrapper is name-transparent and marks
+        # the site profiled (scope pass).
+        profiled = False
         call = _jit_call(node.value)
+        if call is None:
+            call = _instrument_call(node.value)
+            profiled = call is not None
         if call is not None:
             call._gc_seen = True
             name = None
@@ -238,7 +272,8 @@ class _Indexer(ast.NodeVisitor):
             self.mod.jit_sites.append(JitSite(
                 line=node.lineno, name=name,
                 target=self._resolve_target(call),
-                enclosing=self._enclosing_fn(), depth=self._fn_depth()))
+                enclosing=self._enclosing_fn(), depth=self._fn_depth(),
+                profiled=profiled))
         self.generic_visit(node)
 
     def visit_Import(self, node):
@@ -255,6 +290,16 @@ class _Indexer(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call):
+        # an instrument wrapper outside an Assign: still one jit site
+        # (unnamed — the undeclared-jit rule flags it), never two
+        inner = _instrument_call(node)
+        if inner is not None and not getattr(inner, "_gc_seen", False):
+            inner._gc_seen = True
+            self.mod.jit_sites.append(JitSite(
+                line=node.lineno, name=None,
+                target=self._resolve_target(inner),
+                enclosing=self._enclosing_fn(), depth=self._fn_depth(),
+                profiled=True))
         # bare jit calls not captured by Assign/decorator (e.g.
         # ``return jax.jit(...)`` or a jit inside an expression)
         call = _jit_call(node)
@@ -299,7 +344,8 @@ def index_module(path: str, root: str) -> Optional[ModuleInfo]:
                      source=source, tree=tree, qualname_of={}, functions={},
                      module_names=set(), jit_sites=[],
                      declared_entry_points=set(), declared_hot_loops=set(),
-                     entry_decl_line=0, jit_target_quals=set())
+                     declared_profiled=set(), entry_decl_line=0,
+                     profiled_decl_line=0, jit_target_quals=set())
     _Indexer(mod).visit(tree)
     mod.jit_sites = _dedupe_sites(mod.jit_sites)
     return mod

@@ -33,6 +33,12 @@ _CALL_RE = re.compile(
     r"\b(?:REGISTRY|reg|registry)\s*\)?\s*\.\s*(inc|observe|gauge)\s*\(\s*"
     r"[\"']([A-Za-z_:][A-Za-z0-9_:]*)[\"']")
 _TIMED_RE = re.compile(r"\btimed\s*\(\s*[\"']([A-Za-z_:][A-Za-z0-9_:]*)[\"']")
+# graftscope occupancy time-series points (utils/graftscope.py sample):
+# each series is the trajectory behind a same-named /metrics gauge, so
+# the name must be a catalog GAUGE — a typo here would fork a series no
+# dashboard (and no /debug/profile reader) is watching
+_SAMPLE_RE = re.compile(
+    r"\bgraftscope\s*\.\s*sample\s*\(\s*[\"']([A-Za-z_:][A-Za-z0-9_:]*)[\"']")
 
 
 def _iter_sources(root: str) -> List[str]:
@@ -100,6 +106,19 @@ def find_violations(paths: List[str], catalog=None,
                 bad.append((path, lineno(m.start()), name,
                             f"catalog says {want}, timed() "
                             "records a histogram"))
+        for m in _SAMPLE_RE.finditer(text):
+            name = m.group(1)
+            want = catalog.get(name)
+            if name in retired:
+                bad.append((path, lineno(m.start()), name,
+                            f"retired metric; use {retired[name]}"))
+            elif want is None:
+                bad.append((path, lineno(m.start()), name,
+                            "not in METRIC_CATALOG"))
+            elif want != "gauge":
+                bad.append((path, lineno(m.start()), name,
+                            f"catalog says {want}, graftscope.sample() "
+                            "records a gauge time series"))
     return sorted(bad)
 
 

@@ -1,0 +1,332 @@
+"""graftscope's static + dynamic graftcheck halves.
+
+**Static pass** (``run_scope_static``, rides ``python -m
+tools.graftcheck`` and the strict in-suite driver): profiling is a
+DECLARED contract — every runtime module that declares
+``JIT_ENTRY_POINTS`` also declares ``PROFILED_SCOPES`` (the entry
+points whose dispatch sites are wrapped in
+``graftscope.instrument(jax.jit(...), "mod._entry", key_fn=...)``), and
+the ``unprofiled-entry-point`` rule (the mirror of ``undeclared-jit``)
+verifies the declaration three ways:
+
+- an entry point neither profiled nor baselined is a finding (a
+  compiled-program population whose device time the attribution layer
+  silently misses);
+- a PROFILED_SCOPES name whose jit site is NOT actually wrapped in the
+  instrument timer is a finding (a declared-but-dead contract);
+- a PROFILED_SCOPES name that is not a JIT_ENTRY_POINT is a stale
+  declaration.
+
+Intentional cold-path exemptions (e.g. the GRAFTSAN-only ``_poison``
+mover) are baselined in tools/graftcheck/baseline.txt with a
+justification, keyed ``unprofiled-entry-point path::<entry name>``.
+``--strict`` additionally fails a VACUOUS contract: a module with entry
+points but zero instrument-wrapped sites means the attribution layer
+stopped seeing that module entirely.
+
+**Attribution mode** (``run_attribution``, ``python -m tools.graftcheck
+scope``): the measured-vs-modeled join. Tiny real engines replay the
+canonical workloads on this host with graftscope sync mode armed
+(device-true dispatch windows), and each workload's observed dispatch
+rings are joined against
+
+- the recompile certifier's program-key sets (``recompile.engine_call_
+  keys`` / ``paged_runner_keys``) — exact-marked workloads must join
+  1:1: every certified key observed, nothing extra (a drifted key model
+  means the budget certifies programs the runtime never mints, or
+  misses ones it does);
+- the cost model's per-token byte prediction (``costmodel.
+  score_candidate``) — reported as measured seconds/token against
+  modeled bytes/token, i.e. the implied HBM bandwidth this host
+  sustained. The ratio is attribution, not a gate (hosts differ);
+  regression GATING is tools/bench_diff.py's job, over the bench
+  trajectory.
+
+bench.py journals the attribution payload as the
+``graftscope_attribution`` row beside ``graftcheck_static_analysis``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from . import lint as L
+from .core import Finding
+
+# entry-point name -> graftscope scope string (the instrument() label
+# convention: "<module leaf>.<entry>") for the modules the certifier
+# models — the join key between rings and certified populations
+SCOPE_OF: Dict[str, str] = {
+    "_prefill": "engine._prefill",
+    "_prefill_chunked": "engine._prefill_chunked",
+    "_decode_seg": "engine._decode_seg",
+    "_loop": "spec_decode._loop",
+    "_loop_b": "spec_decode._loop_b",
+    "_seg_b": "spec_decode._seg_b",
+    "_gather": "kv_pool._gather",
+    "_scatter": "kv_pool._scatter",
+    "_scatter_row": "kv_pool._scatter_row",
+    "_copy": "kv_pool._copy",
+}
+
+
+# -- static pass --------------------------------------------------------------
+
+
+def run_scope_static(root: str,
+                     paths: Optional[List[str]] = None,
+                     ) -> Tuple[List[Finding], dict]:
+    """The unprofiled-entry-point rule over the production surface ->
+    (findings, summary). Summary carries ``scope_checks`` (entry-point
+    checks performed — the vacuity guard on the pass itself),
+    ``profiled_regions`` (instrument-wrapped jit sites per module), and
+    ``vacuous`` (modules with entry points but ZERO wrapped sites — the
+    --strict failure class)."""
+    findings: List[Finding] = []
+    checks = 0
+    profiled_regions: Dict[str, int] = {}
+    vacuous: List[str] = []
+    for path in (paths if paths is not None else L.iter_sources(root)):
+        mod = L.index_module(path, root)
+        if mod is None:
+            continue
+        in_runtime = "/runtime/" in "/" + mod.relpath
+        if not (mod.declared_entry_points or mod.declared_profiled):
+            continue
+        wrapped = {s.name for s in mod.jit_sites
+                   if s.profiled and s.name is not None}
+        decl_line = mod.profiled_decl_line or mod.entry_decl_line or 1
+        for name in sorted(mod.declared_entry_points):
+            checks += 1
+            if name not in mod.declared_profiled:
+                findings.append(Finding(
+                    "unprofiled-entry-point", mod.relpath,
+                    mod.entry_decl_line or 1, name,
+                    f"jit entry point {name!r} is not in this module's "
+                    "PROFILED_SCOPES — its dispatches are a compiled-"
+                    "program population graftscope's device-time "
+                    "attribution silently misses; wrap the jit site in "
+                    "graftscope.instrument and declare it, or baseline "
+                    "the exemption with a justification"))
+            elif name not in wrapped:
+                findings.append(Finding(
+                    "unprofiled-entry-point", mod.relpath, decl_line,
+                    name,
+                    f"PROFILED_SCOPES declares {name!r} but its jit "
+                    "site is not wrapped in a graftscope.instrument "
+                    "dispatch timer — a declared-but-dead profiling "
+                    "contract"))
+        for name in sorted(mod.declared_profiled
+                           - mod.declared_entry_points):
+            checks += 1
+            findings.append(Finding(
+                "unprofiled-entry-point", mod.relpath, decl_line,
+                name,
+                f"PROFILED_SCOPES declares {name!r} but it is not a "
+                "declared JIT_ENTRY_POINT (stale declaration)"))
+        if mod.declared_entry_points:
+            live = len(wrapped & mod.declared_entry_points)
+            profiled_regions[mod.relpath] = live
+            # the --strict vacuity class is RUNTIME modules (serving
+            # dispatch surfaces) gone entirely unprofiled; a non-runtime
+            # module whose only entry points are baselined test oracles
+            # (ops/paged_attention) is the per-entry baseline's business
+            if live == 0 and in_runtime:
+                vacuous.append(mod.relpath)
+    return findings, {"scope_checks": checks,
+                      "profiled_regions": profiled_regions,
+                      "vacuous": sorted(vacuous)}
+
+
+# -- attribution mode ---------------------------------------------------------
+
+
+def attribution_workloads():
+    """(label, engine kwargs, paged kwargs or None, GenerateCalls) —
+    the canonical shapes the join replays on real tiny engines. All
+    rows are exact-marked (admission-mode / solo-paged), so the 1:1
+    join is the acceptance bar for every one of them."""
+    from . import recompile as R
+    greedy = R.greedy_sampling()
+    return [
+        ("solo-greedy", dict(max_seq=64), None,
+         [R.GenerateCall(prompt_lens=(8,), max_new=12, sampling=greedy)]),
+        ("batch2-greedy", dict(max_seq=64), None,
+         [R.GenerateCall(prompt_lens=(8, 8), max_new=12,
+                         sampling=greedy)]),
+        ("paged-solo", dict(max_seq=64),
+         dict(num_blocks=16, block_size=8),
+         [R.GenerateCall(prompt_lens=(8,), max_new=12, sampling=greedy)]),
+    ]
+
+
+def run_attribution() -> dict:
+    """Replay the canonical workloads on real tiny engines with
+    graftscope sync armed, join rings against certified program keys,
+    and report measured-vs-modeled drift. CPU-safe (the bench chip is
+    not required); see the module docstring for what gates and what
+    merely reports."""
+    import jax
+    import numpy as np
+
+    from llm_sharding_demo_tpu.models import gpt2
+    from llm_sharding_demo_tpu.runtime.engine import DecodeEngine
+    from llm_sharding_demo_tpu.runtime.kv_pool import (KVBlockPool,
+                                                       PagedKVRunner)
+    from llm_sharding_demo_tpu.utils import graftscope
+
+    from . import costmodel as C, recompile as R
+
+    cfg = gpt2.GPT2Config(vocab_size=96, n_positions=64, n_embd=16,
+                          n_layer=2, n_head=2)
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+
+    saved = graftscope.dump_state()
+    was_enabled = graftscope.set_enabled(True)
+    was_sync = graftscope.set_sync(True)
+    rows: List[dict] = []
+    try:
+        for label, eng_kw, paged_kw, calls in attribution_workloads():
+            graftscope.clear()
+            engine = DecodeEngine(params, cfg, **eng_kw)
+            desc = R.EngineDesc(**eng_kw)
+            runner = engine
+            if paged_kw is not None:
+                pool = KVBlockPool.for_engine(engine, **paged_kw)
+                runner = PagedKVRunner(engine, pool)
+
+            certified: Dict[str, set] = {}
+            for call in calls:
+                if paged_kw is not None:
+                    paged = R.PagedDesc(max_seq=eng_kw["max_seq"],
+                                        block_size=paged_kw["block_size"])
+                    keysets = R.paged_runner_keys(desc, paged, call)
+                else:
+                    keysets = R.engine_call_keys(desc, call)
+                for name, ks in keysets.items():
+                    certified.setdefault(name, set()).update(ks)
+
+            decode_steps = 0
+            for call in calls:
+                b = len(call.prompt_lens)
+                s = max(call.prompt_lens)
+                ids = np.full((b, s), 3, dtype=np.int32)
+                # replay with the CALL's own sampling — the certified
+                # keysets derive from it, and a divergent harness
+                # default would report join drift that is nobody's bug
+                sampling = (call.sampling if call.sampling is not None
+                            else R.greedy_sampling())
+                runner.generate(ids, call.max_new, sampling=sampling)
+                decode_steps += b * (call.max_new - 1)
+
+            join: Dict[str, dict] = {}
+            joined = True
+            for name in sorted(certified):
+                cert = certified[name]
+                observed = graftscope.program_keys(SCOPE_OF[name])
+                missing = sorted(repr(k) for k in cert - set(observed))
+                extra = sorted(repr(k) for k in set(observed) - cert)
+                if missing or extra:
+                    joined = False
+                join[name] = {
+                    "scope": SCOPE_OF[name],
+                    "certified_programs": len(cert),
+                    "observed_programs": len(observed),
+                    "matched": len(cert & set(observed)),
+                    "missing": missing,
+                    "extra": extra,
+                    "calls": sum(c for c, _ in observed.values()),
+                    "seconds_total": round(
+                        sum(s for _, s in observed.values()), 6),
+                }
+
+            # measured decode seconds per token (device-true — sync
+            # mode closes every dispatch window via block_until_ready)
+            decode_secs = graftscope.scope_seconds("engine._decode_seg")
+            if paged_kw is not None:
+                # the paged runner's per-segment pool round-trip is part
+                # of its decode cost — attribute it honestly
+                decode_secs += (graftscope.scope_seconds("kv_pool._gather")
+                                + graftscope.scope_seconds(
+                                    "kv_pool._scatter"))
+            measured_per_token = (decode_secs / decode_steps
+                                  if decode_steps else None)
+
+            # modeled cost (bytes/token) for the matching candidate row
+            b = max(len(c.prompt_lens) for c in calls)
+            cand = C.Candidate(
+                topology="single",
+                batch_mode="admission", max_batch=b,
+                kv_pool_blocks=(paged_kw or {}).get("num_blocks", 0),
+                kv_block_size=(paged_kw or {}).get("block_size", 16))
+            traffic = tuple(
+                C.TrafficRow(max(c.prompt_lens), c.max_new,
+                             len(c.prompt_lens)) for c in calls)
+            scored = C.score_candidate(gpt2, cfg, cand, {},
+                                       eng_kw["max_seq"], traffic, None)
+            row = {
+                "workload": label,
+                "programs_exact": True,
+                "joined_1to1": joined,
+                "entry_points": join,
+                "decode_steps": decode_steps,
+                "measured_decode_seconds_per_token":
+                    None if measured_per_token is None
+                    else round(measured_per_token, 8),
+                "modeled_cost_bytes_per_token":
+                    round(scored.cost_per_token, 1),
+                "modeled_hbm_bytes_per_device":
+                    scored.hbm_bytes_per_device,
+                "modeled_comm_bytes_per_token":
+                    scored.comm_bytes_per_token,
+            }
+            if measured_per_token:
+                # the drift number: what byte rate this host would have
+                # to sustain for the model's cost to equal the measured
+                # time — compare ACROSS runs/trajectory, not to a spec
+                # sheet (that is bench_diff's job)
+                row["implied_bytes_per_second"] = round(
+                    scored.cost_per_token / measured_per_token, 1)
+            rows.append(row)
+    finally:
+        graftscope.set_enabled(was_enabled)
+        graftscope.set_sync(was_sync)
+        graftscope.restore_state(saved)
+
+    return {
+        "ok": all(r["joined_1to1"] for r in rows),
+        "sync": True,
+        "note": ("measured windows are device-true (GRAFTSCOPE sync); "
+                 "join is gated (exact rows must match 1:1), bandwidth "
+                 "drift is reported for the bench trajectory"),
+        "workloads": rows,
+    }
+
+
+def main_scope(args) -> int:
+    """``python -m tools.graftcheck scope`` body (cli.py dispatches)."""
+    import json
+    payload = run_attribution()
+    if args.json:
+        print(json.dumps(payload, indent=2, default=str))
+        return 0 if payload["ok"] else 1
+    for row in payload["workloads"]:
+        mark = "ok " if row["joined_1to1"] else "DRIFT"
+        mpt = row["measured_decode_seconds_per_token"]
+        print(f" {mark} {row['workload']:<16} "
+              f"programs {sum(e['observed_programs'] for e in row['entry_points'].values())} "
+              f"measured {mpt if mpt is not None else '-'} s/tok "
+              f"modeled {row['modeled_cost_bytes_per_token']} B/tok "
+              f"implied {row.get('implied_bytes_per_second', '-')} B/s")
+        for name, e in sorted(row["entry_points"].items()):
+            if e["missing"] or e["extra"]:
+                print(f"      {name}: certified {e['certified_programs']}"
+                      f" observed {e['observed_programs']}"
+                      f" missing {e['missing']} extra {e['extra']}")
+    print("graftcheck scope: "
+          + ("measured rings join certified program keys 1:1"
+             if payload["ok"] else
+             "JOIN DRIFT — the certifier's key model and the runtime "
+             "disagree (see rows above)"))
+    return 0 if payload["ok"] else 1
