@@ -83,6 +83,16 @@ GUARDED_STATE = {"batches_run": "_stats_lock",
                  "_pending": "_stats_lock"}
 LOCK_ORDER = ("_stats_lock",)
 
+# Fault contract (tools/graftcheck faults pass): the admission batcher's
+# blocking boundaries. The caller's ``done.wait`` carries the caller's
+# own timeout; the worker's bare ``_queue.get`` is the idle park between
+# rounds.
+FAULT_POLICY = {
+    "done.wait": ("request", "none", "TimeoutError to the caller"),
+    "_queue.get": ("unbounded", "none",
+                   "idle worker parks on its queue between rounds"),
+}
+
 
 def _round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple

@@ -113,7 +113,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.attention import KVCache
-from ..utils import graftsched, graftscope, tracing
+from ..utils import graftfault, graftsched, graftscope, tracing
 from ..utils.metrics import REGISTRY, kv_block_gauges
 from .batcher import _round_up
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
@@ -150,6 +150,26 @@ POOL_MOVER_SCOPES = ("IterBatchingEngine._init_tables",
 GRAFTCHECK_HOT_LOOPS = ("IterBatchingEngine._advance",
                         "IterBatchingEngine._advance_spec")
 
+# Fault contract (tools/graftcheck faults pass): the scheduler's two
+# blocking boundaries. The caller's ``done.wait`` derives its budget
+# from the request deadline (and cancellation frees the row's blocks at
+# the next segment boundary); the worker's bare ``_queue.get`` is the
+# idle park — deadlines are checked at every dequeue, so a stale
+# request is failed typed instead of decoded for nobody.
+FAULT_POLICY = {
+    "done.wait": ("request", "none",
+                  "cancel + free blocks at the next segment boundary"),
+    "_queue.get": ("unbounded", "none",
+                   "idle worker; deadline checked at dequeue"),
+}
+
+# Transient decode faults (graftfault.TransientFault — injected engine
+# exceptions, and the class real transient device failures map to) park
+# the live rows through the PR 5 recompute-resume path; a row that
+# keeps faulting past this many parks fails typed instead of cycling
+# forever.
+FAULT_PARK_BUDGET = 3
+
 # Lock-discipline contract (tools/graftcheck locks pass): the scheduler
 # counters AND the cross-thread scheduling state (``_parked`` parked
 # rows, ``_pending`` held queue head) live under ``_stats_lock`` —
@@ -163,7 +183,8 @@ GUARDED_STATE = {
     "joins": "_stats_lock", "segments_run": "_stats_lock",
     "spec_segments_run": "_stats_lock", "eos_retires": "_stats_lock",
     "grows": "_stats_lock", "preemptions": "_stats_lock",
-    "resumes": "_stats_lock", "_parked": "_stats_lock",
+    "resumes": "_stats_lock", "fault_parks": "_stats_lock",
+    "_parked": "_stats_lock",
     "_pending": "_stats_lock",
     "_np": "_lock",
 }
@@ -197,6 +218,10 @@ class _Req:
     # retirement pass instead of decoding dead tokens for nobody.
     cancelled: threading.Event = dataclasses.field(
         default_factory=threading.Event)
+    # per-request deadline budget (graftfault.Deadline): checked at
+    # every dequeue and segment boundary — a past-deadline request/row
+    # is failed typed and its blocks freed, never decoded for nobody
+    deadline: Optional[graftfault.Deadline] = None
     # request-trace propagation (caller's ambient RequestTrace): the
     # scheduler stamps queue wait, the admission prefill, and every
     # decode segment the row rode into it
@@ -269,6 +294,9 @@ class _Slot:
     # the stream straight out of it, no per-segment part list needed.
     spec_buf: Optional["_SegOut"] = None
     spec_pad: int = 0
+    # transient-fault parks this row has already absorbed (graftfault):
+    # past FAULT_PARK_BUDGET the row fails typed instead of re-parking
+    fault_budget_used: int = 0
     t0: float = 0.0
     done_t: float = 0.0
 
@@ -322,6 +350,7 @@ class _Parked:
     t0: float                     # original admission wall-clock
     preempt_t: float = 0.0
     spec_key: Optional[np.ndarray] = None  # verify key chain (spec rows)
+    fault_budget_used: int = 0    # transient-fault parks absorbed so far
 
 
 class _BatchState:
@@ -429,6 +458,7 @@ class IterBatchingEngine:
         self.grows = 0                # width upgrades of a live batch
         self.preemptions = 0          # rows parked under pool pressure
         self.resumes = 0              # parked rows recomputed back in
+        self.fault_parks = 0          # transient-fault park events
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
 
@@ -438,7 +468,9 @@ class IterBatchingEngine:
                  sampling: SamplingConfig = SamplingConfig(),
                  key: Optional[jax.Array] = None,
                  eos_id: Optional[int] = None,
-                 timeout: Optional[float] = None) -> GenerateResult:
+                 timeout: Optional[float] = None,
+                 deadline: Optional[graftfault.Deadline] = None,
+                 ) -> GenerateResult:
         prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("prompt must be non-empty")
@@ -460,19 +492,33 @@ class IterBatchingEngine:
                     "speculative engine attached (pass spec= at "
                     "construction)")
             self.spec.check_request(len(prompt), max_new_tokens)
+        if deadline is not None:
+            deadline.raise_if_expired("iter-batched generate")
         req = _Req(prompt=prompt, max_new_tokens=max_new_tokens,
                    sampling=sampling, key=key, eos_id=eos_id,
+                   deadline=deadline,
                    trace=tracing.current_trace(),
                    t_submit=time.perf_counter())
         self._queue.put(req)
         REGISTRY.gauge("queue_depth", self._queue.qsize(),
                        scheduler="iter")
-        if not req.done.wait(timeout):
+        # the caller's wait derives from the remaining deadline budget:
+        # HTTP wait is the first leg the budget bounds end-to-end
+        wait = timeout
+        if deadline is not None:
+            rem = deadline.remaining()
+            wait = rem if wait is None else min(wait, rem)
+        if not req.done.wait(wait):
             # Cancel, don't just abandon: the scheduler skips cancelled
             # requests at dequeue and retires a cancelled live row at the
             # next segment boundary, so repeated timeouts cannot
             # accumulate dead decode work (ADVICE r4).
             req.cancelled.set()
+            if deadline is not None and deadline.expired():
+                raise graftfault.DeadlineExceeded(
+                    "iter-batched generate: deadline budget exhausted; "
+                    "in-flight work is cancelled at the next segment "
+                    "boundary and its blocks freed")
             raise TimeoutError("iter-batched generate timed out")
         if req.error is not None:
             raise req.error
@@ -504,6 +550,7 @@ class IterBatchingEngine:
                    "eos_retires": self.eos_retires, "grows": self.grows,
                    "preemptions": self.preemptions,
                    "resumes": self.resumes,
+                   "fault_parks": self.fault_parks,
                    "parked": len(self._parked)}
         return out
 
@@ -524,7 +571,14 @@ class IterBatchingEngine:
         with self._stats_lock:
             waiting = (self._queue.qsize() + len(self._parked)
                        + (1 if self._pending is not None else 0))
-        if self.pool.allocator.can_admit(need) or waiting < self.queue_limit:
+        # seeded pool-exhaustion spike (graftfault): the 429 gate sheds
+        # exactly as it would under a real capacity storm, so the shed
+        # path (Retry-After plausibility, rejection counter, allocator
+        # conservation) is testable deterministically
+        spike = graftfault.inject("iterbatch.admission_load",
+                                  "pool_spike")
+        if spike is None and (self.pool.allocator.can_admit(need)
+                              or waiting < self.queue_limit):
             return True, 0.0
         # crude but honest: each max_batch-wide wave of waiters needs
         # roughly one batch lifetime to drain
@@ -566,6 +620,21 @@ class IterBatchingEngine:
         with self._stats_lock:
             self._pending = req
 
+    def _req_dead(self, req: _Req) -> bool:
+        """Cancelled OR past its deadline — either way nobody wants the
+        work. A past-deadline request is failed typed here (idempotent:
+        the caller usually raised at its own wait expiry already) and
+        marked cancelled so every later checkpoint skips it."""
+        if req.cancelled.is_set():
+            return True
+        if req.deadline is not None and req.deadline.expired():
+            req.fail(graftfault.DeadlineExceeded(
+                "deadline budget exhausted before the scheduler could "
+                "run this request"))
+            req.cancelled.set()
+            return True
+        return False
+
     def _loop(self):
         while True:
             # parked rows outrank every queued request (they were
@@ -573,13 +642,13 @@ class IterBatchingEngine:
             # batch seeds from the parked head instead of the queue
             head = self._pop_parked()
             if head is not None:
-                if head.req.cancelled.is_set():
+                if self._req_dead(head.req):
                     continue
             else:
                 head = self._take_pending()
                 if head is None:
                     head = self._queue.get()
-                if head.cancelled.is_set():
+                if self._req_dead(head):
                     continue
             try:
                 self._run_batch(head)
@@ -610,7 +679,15 @@ class IterBatchingEngine:
             while state.active():
                 if not state.closed:
                     self._admit(state)
-                self._advance(state)
+                try:
+                    self._advance(state)
+                except graftfault.TransientFault as e:
+                    # degraded mode: a transient decode fault parks
+                    # every live row through the PR 5 recompute-resume
+                    # path — resumed streams are byte-identical; a row
+                    # past its park budget fails typed (503) instead of
+                    # cycling forever
+                    self._fault_park_all(state, e)
         except Exception as e:  # noqa: BLE001
             for i, s in enumerate(state.slots):
                 if s is not None:
@@ -658,7 +735,7 @@ class IterBatchingEngine:
             nxt = self._peek_parked()
             if nxt is None:
                 break
-            if nxt.req.cancelled.is_set():
+            if self._req_dead(nxt.req):
                 self._pop_parked()
                 continue
             if (nxt.req.sampling == sampling
@@ -675,7 +752,7 @@ class IterBatchingEngine:
                 nxt = self._queue.get(timeout=remaining)
             except queue.Empty:
                 break
-            if nxt.cancelled.is_set():
+            if self._req_dead(nxt):
                 continue
             if nxt.sampling == sampling and self._fits(seed + [nxt]):
                 seed.append(nxt)
@@ -775,7 +852,8 @@ class IterBatchingEngine:
                     req=r, plen=e.plen, row=i, first_ref=None,
                     first_idx=0, dk=None if dks is None else dks[i],
                     emitted=e.emitted, resumed_prefix=e.tokens,
-                    order=e.order, t0=e.t0)
+                    order=e.order, t0=e.t0,
+                    fault_budget_used=e.fault_budget_used)
             else:
                 self._order += 1
                 state.slots[i] = _Slot(req=r, plen=len(r.prompt), row=i,
@@ -888,7 +966,7 @@ class IterBatchingEngine:
             ent = self._peek_parked()
             if ent is None:
                 break
-            if ent.req.cancelled.is_set():
+            if self._req_dead(ent.req):
                 self._pop_parked()
                 continue
             if not self._compatible(state, ent):
@@ -924,7 +1002,7 @@ class IterBatchingEngine:
                 except queue.Empty:
                     return
                 self._set_pending(req)
-            if req.cancelled.is_set():
+            if self._req_dead(req):
                 self._set_pending(None)
                 continue
             if not self._compatible(state, req):
@@ -1114,7 +1192,9 @@ class IterBatchingEngine:
             first_idx=0, dk=dk, t0=t0,
             emitted=resume.emitted if resume is not None else 1,
             resumed_prefix=resume.tokens if resume is not None else None,
-            order=resume.order if resume is not None else self._order)
+            order=resume.order if resume is not None else self._order,
+            fault_budget_used=(resume.fault_budget_used
+                               if resume is not None else 0))
         if self.pool is not None:
             state.slots[slot].blk_lo = blk_lo
             state.slots[slot].blk_ids = blk_ids
@@ -1245,31 +1325,38 @@ class IterBatchingEngine:
                 s.blk_ids = ids + s.blk_ids
                 s.blk_lo = new_lo
 
+    def _park_slot(self, state: _BatchState, s: _Slot,
+                   fault_budget_used: int = 0) -> None:
+        """Park one live row for recompute-resume: fetch its emitted
+        tokens (host sync — parking is the slow path by design), free
+        its blocks, queue it oldest-first. Shared by pool-pressure
+        preemption and transient-fault recovery — both replay the row
+        byte-identically through the same resume machinery."""
+        tokens = np.asarray(self._row_tokens(s), dtype=np.int32)
+        spec_key = None
+        if state.spec_mode and state.sampling.mode != "greedy":
+            spec_key = np.asarray(state.keys[s.row])
+        parked = _Parked(req=s.req, plen=s.plen,
+                         emitted=min(s.emitted, s.req.max_new_tokens),
+                         tokens=tokens, order=s.order, t0=s.t0,
+                         preempt_t=time.perf_counter(),
+                         spec_key=spec_key,
+                         fault_budget_used=fault_budget_used)
+        self._release_blocks(state, s.row)
+        state.slots[s.row] = None
+        self._park(parked)
+
     def _preempt_lowest(self, state: _BatchState) -> bool:
-        """Park the lowest-priority live row (latest admission order):
-        fetch its emitted tokens (host sync — the preemption path is
-        the slow path by design), free its blocks, and queue it for
-        recompute-resume. The victim set is EVERY live row, including
-        the one whose growth triggered the call — priority alone
-        decides (the growth loops detect their own row parking and
-        stop)."""
+        """Park the lowest-priority live row (latest admission order).
+        The victim set is EVERY live row, including the one whose
+        growth triggered the call — priority alone decides (the growth
+        loops detect their own row parking and stop)."""
         live = [s for s in state.slots if s is not None]
         if not live:
             return False
         victim = max(live, key=lambda s: s.order)
-        tokens = np.asarray(self._row_tokens(victim), dtype=np.int32)
-        spec_key = None
-        if state.spec_mode and state.sampling.mode != "greedy":
-            spec_key = np.asarray(state.keys[victim.row])
-        parked = _Parked(req=victim.req, plen=victim.plen,
-                         emitted=min(victim.emitted,
-                                     victim.req.max_new_tokens),
-                         tokens=tokens, order=victim.order, t0=victim.t0,
-                         preempt_t=time.perf_counter(),
-                         spec_key=spec_key)
-        self._release_blocks(state, victim.row)
-        state.slots[victim.row] = None
-        self._park(parked)
+        self._park_slot(state, victim,
+                        fault_budget_used=victim.fault_budget_used)
         if victim.req.trace is not None:
             victim.req.trace.labels["preempted"] = (
                 victim.req.trace.labels.get("preempted", 0) + 1)
@@ -1277,6 +1364,38 @@ class IterBatchingEngine:
             self.preemptions += 1
         REGISTRY.inc("kv_pool_preemptions_total")
         return True
+
+    def _fault_park_all(self, state: _BatchState,
+                        fault: Exception) -> None:
+        """Transient-fault recovery (graftfault): park EVERY live row —
+        the failed segment never appended its output, so each row's
+        park snapshot is exactly its pre-segment state and the
+        recompute-resume replay is byte-identical. A row past its
+        FAULT_PARK_BUDGET fails typed (503 Retry-After upstream)
+        instead of cycling park/resume forever."""
+        for i, s in enumerate(state.slots):
+            if s is None:
+                continue
+            if s.fault_budget_used + 1 > FAULT_PARK_BUDGET:
+                if s.req.trace is not None:
+                    t = time.perf_counter()
+                    s.req.trace.add_span("fault_budget_exhausted", t, t,
+                                         scheduler="iter",
+                                         parks=s.fault_budget_used)
+                s.req.fail(graftfault.FaultBudgetError(
+                    f"row exhausted its transient-fault park budget "
+                    f"({FAULT_PARK_BUDGET}); last fault: {fault}"))
+                self._release_blocks(state, i)
+                state.slots[i] = None
+                continue
+            if s.req.trace is not None:
+                s.req.trace.labels["fault_parks"] = (
+                    s.req.trace.labels.get("fault_parks", 0) + 1)
+            self._park_slot(state, s,
+                            fault_budget_used=s.fault_budget_used + 1)
+        with self._stats_lock:
+            self.fault_parks += 1
+        REGISTRY.inc("iter_fault_parks_total")
 
     # -- the segment step ----------------------------------------------------
 
@@ -1303,6 +1422,25 @@ class IterBatchingEngine:
         graftscope.sample("queue_depth", depth, scheduler="iter")
 
     def _advance(self, state: _BatchState):
+        # Seeded mid-decode engine faults (graftfault), fired BEFORE any
+        # state mutation so a transient park snapshots exactly the
+        # pre-segment state: transient -> park/resume (byte-identical),
+        # permanent -> the batch fails typed with partial traces
+        # flight-recorded, slow -> a deterministic stall (what drives
+        # the deadline-exceeded fixtures).
+        kind = graftfault.inject("iterbatch.decode_seg",
+                                 "decode_transient", "decode_permanent",
+                                 "decode_slow")
+        if kind == "decode_slow":
+            time.sleep(0.05)
+        elif kind == "decode_transient":
+            raise graftfault.TransientFault(
+                "iterbatch.decode_seg", kind,
+                "graftfault: injected transient decode fault")
+        elif kind == "decode_permanent":
+            raise graftfault.PermanentFault(
+                "iterbatch.decode_seg", kind,
+                "graftfault: injected permanent engine fault")
         if state.spec_mode:
             return self._advance_spec(state)
         eng = self.engine
@@ -1499,10 +1637,36 @@ class IterBatchingEngine:
         for i, s in enumerate(state.slots):
             if s is None:
                 continue
+            if (s.req.deadline is not None and s.req.deadline.expired()
+                    and not s.req.done.is_set()):
+                # Past-deadline row: cancelled at THIS segment boundary
+                # with its blocks freed (GRAFTSAN conservation holds
+                # through it) and a typed failure delivered — the
+                # deadline budget is honored mid-decode, not only at
+                # admission.
+                if s.req.trace is not None:
+                    t = time.perf_counter()
+                    s.req.trace.add_span("deadline_exceeded", t, t,
+                                         scheduler="iter",
+                                         emitted=s.emitted)
+                s.req.fail(graftfault.DeadlineExceeded(
+                    "deadline budget exhausted mid-decode; row "
+                    "cancelled at the segment boundary"))
+                s.req.cancelled.set()
+                self._release_blocks(state, i)
+                state.slots[i] = None
+                continue
             if s.req.cancelled.is_set():
                 # Caller timed out and left: free the slot instead of
                 # decoding dead tokens for nobody. Nothing is delivered
-                # (the payload has no reader).
+                # (the payload has no reader). The flight recorder gets
+                # an ``abandoned`` span at the moment the blocks come
+                # back, so the reclamation is observable, not implicit.
+                if s.req.trace is not None:
+                    t = time.perf_counter()
+                    s.req.trace.add_span("abandoned", t, t,
+                                         scheduler="iter",
+                                         emitted=s.emitted)
                 self._release_blocks(state, i)
                 state.slots[i] = None
                 continue

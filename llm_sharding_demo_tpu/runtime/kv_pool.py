@@ -70,7 +70,7 @@ import numpy as np
 
 from ..ops import paged_attention as PA
 from ..ops.attention import KVCache
-from ..utils import graftsched, graftscope, tracing
+from ..utils import graftfault, graftsched, graftscope, tracing
 from ..utils.metrics import DEFAULT_KV_BLOCK_SIZE, REGISTRY, CompileWatch
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
                      _eos_capped_segments, _split_keys, _step_keys,
@@ -438,6 +438,12 @@ class BlockAllocator:
         False."""
         if n == 0:
             return []
+        # seeded pool-exhaustion spike (graftfault): the grant refuses
+        # exactly as a genuinely full pool would — the caller's
+        # deferral/preemption machinery absorbs it, deterministically
+        # replayable under a pinned seed
+        if graftfault.inject("kv_pool.admit_alloc", "pool_spike"):
+            return None
         evict_freed: List[int] = []
         with self._lock:
             if self.sanitize:

@@ -41,7 +41,7 @@ from ..models import gpt2
 from ..parallel import partition as P_
 from ..parallel.pipeline import PipelineRunner
 from ..runtime.engine import REF_TEMPERATURE, REF_TOP_K, SamplingConfig
-from ..utils import tracing
+from ..utils import graftfault, tracing
 from ..utils.config import ServingConfig, from_env
 from ..utils.metrics import REGISTRY
 from ..utils.tracing import timed
@@ -58,6 +58,18 @@ log = logging.getLogger(__name__)
 # empty so a lock added here must declare what it protects.
 GUARDED_STATE = {}
 LOCK_ORDER = ()
+
+# Fault contract (tools/graftcheck faults pass): the coordinator's one
+# blocking boundary is the remote-dispatch shard hop. Its per-attempt
+# timeout derives from the request's remaining deadline budget
+# (X-Deadline-Ms) capped by the HopPolicy's per-attempt budget; retries
+# ride the typed policy (capped exponential backoff + jitter, per-shard
+# circuit breaker); failure degrades to a typed 502 (upstream) or 503 +
+# Retry-After (breaker open / deadline exhausted) — never an opaque 500.
+FAULT_POLICY = {
+    "requests.post": ("request", "hop-policy",
+                      "typed 502/503 + Retry-After, per-shard breaker"),
+}
 
 
 class UpstreamError(Exception):
@@ -614,18 +626,23 @@ def create_app(cfg: Optional[ServingConfig] = None,
         /generate requests (bounded ring — see utils.tracing.
         FlightRecorder). ``?n=K`` caps the rows returned, ``?slowest=1``
         orders by duration instead of recency — the view that answers
-        "where did that slow request's time go" without a profiler."""
+        "where did that slow request's time go" without a profiler —
+        and ``?errors=1`` keeps only failed requests (error-labeled
+        traces: timeouts, shed 429s, typed 503s, upstream failures),
+        the fault-triage view graftfault's degraded paths feed."""
         try:
             n = int(query.get("n", "32"))
         except ValueError:
             return 422, {"detail": "n must be an integer"}
         slowest = query.get("slowest", "").lower() in ("1", "true", "yes")
+        errs = query.get("errors", "").lower() in ("1", "true", "yes")
         return {
             "serving": _topology(),
             "capacity": rec.capacity,
             "recorded": len(rec),
             "order": "slowest" if slowest else "newest",
-            "requests": rec.snapshot(n=n, slowest=slowest),
+            "requests": rec.snapshot(n=n, slowest=slowest,
+                                     errors_only=errs),
         }
 
     @app.get("/debug/profile")
@@ -675,7 +692,9 @@ def create_app(cfg: Optional[ServingConfig] = None,
         return {"logits": np.asarray(logits).tolist()}
 
     def _generate_local(req: GenerateReq, prompt_ids: List[int],
-                        eos_id: Optional[int] = None) -> List[int]:
+                        eos_id: Optional[int] = None,
+                        deadline: Optional[graftfault.Deadline] = None,
+                        ) -> List[int]:
         sampling = (SamplingConfig(mode="greedy") if req.mode == "greedy"
                     else SamplingConfig(mode="sample",
                                         temperature=req.temperature,
@@ -717,6 +736,14 @@ def create_app(cfg: Optional[ServingConfig] = None,
             # runners (spec/prefix/admission-batcher/pipeline) keep the
             # host-side truncation below — same wire result.
             kw["eos_id"] = eos_id
+        if deadline is not None:
+            # the deadline budget is honored END-TO-END on the iter
+            # scheduler (queue wait, segment-boundary cancellation with
+            # blocks freed) and per-hop on remote dispatch; other
+            # runners at least refuse work the budget cannot cover
+            deadline.raise_if_expired("generate")
+            if isinstance(eng, _IB):
+                kw["deadline"] = deadline
         result = eng.generate(np.asarray(prompt_ids),
                               max_new_tokens=req.max_new_tokens,
                               sampling=sampling,
@@ -725,43 +752,75 @@ def create_app(cfg: Optional[ServingConfig] = None,
         # prefill alignment); plain runs return the row unchanged
         return [int(t) for t in result.row_tokens(0)]
 
-    def _relay(shard: str, url: str, payload: dict, key: str):
-        """One shard hop with a single retry and typed failure.
+    # One hop discipline for every coordinator->shard POST
+    # (utils/graftfault.HopPolicy): capped exponential backoff + seeded
+    # jitter between attempts, a per-request retry budget, and a
+    # per-shard circuit breaker — a dead shard fails fast with a typed
+    # 503 + Retry-After instead of stacking 30s timeouts. Each retry is
+    # counted into shard_hop_retries_total{stage,reason}. UpstreamError
+    # (an error BODY from a live shard — misroute, missing key) is
+    # fatal: repetition does not fix routing.
+    hop_policy = graftfault.HopPolicy(
+        attempts=3, timeout_s=30.0, base_backoff_s=0.25,
+        max_backoff_s=2.0, breaker_threshold=5, breaker_cooldown_s=5.0,
+        fatal=(UpstreamError,),
+        on_retry=lambda shard, reason: reg.inc(
+            "shard_hop_retries_total", stage=shard, reason=reason))
+
+    def _relay(shard: str, url: str, payload: dict, key: str,
+               deadline: Optional[graftfault.Deadline] = None):
+        """One shard hop through the typed HopPolicy.
 
         Failure modes the reference leaves raw (SURVEY.md §2.3.5: its
-        role-guard 200s make raise_for_status useless and a misroute dies
-        as a KeyError): connection errors/timeouts (retried once after a
-        short backoff — enough for transient socket blips and service-VIP
-        re-resolution; a full k8s pod restart takes longer and still
-        surfaces as a typed error), HTTP errors, and
-        200-with-``{"error"}`` bodies. All surface as UpstreamError -> a
-        typed 502 from /generate, never a raw 500.
+        role-guard 200s make raise_for_status useless and a misroute
+        dies as a KeyError): connection errors/timeouts (retried under
+        the policy's capped backoff, per-attempt timeout derived from
+        the remaining deadline budget), HTTP errors, and
+        200-with-``{"error"}`` bodies. Transport failures surface as
+        UpstreamError -> a typed 502; an open breaker or an exhausted
+        deadline surfaces as graftfault.Unavailable -> a typed 503 +
+        Retry-After. Seeded fault injection (GRAFTFAULT) lands HERE,
+        before the wire call, so the whole retry/breaker path replays
+        deterministically.
         """
-        import time as _time
-
         import requests
 
-        last: Exception = None
-        for attempt in range(2):
-            if attempt:
-                _time.sleep(0.25)
-            try:
-                resp = requests.post(url, json=payload, timeout=30)
-                resp.raise_for_status()
-                body = resp.json()
-                if key not in body:
-                    raise UpstreamError(
-                        shard, url,
-                        str(body.get("error", f"response missing {key!r}")))
-                return body[key]
-            except UpstreamError:
-                raise
-            except requests.exceptions.RequestException as e:
-                last = e
-        raise UpstreamError(shard, url, f"{type(last).__name__}: {last}")
+        def attempt(timeout_s: float):
+            kind = graftfault.inject("serving.shard_hop", "reset",
+                                     "timeout", "http_503", "slow")
+            if kind == "reset":
+                raise requests.exceptions.ConnectionError(
+                    "graftfault: injected connection reset")
+            if kind == "timeout":
+                raise requests.exceptions.Timeout(
+                    "graftfault: injected hop timeout")
+            if kind == "http_503":
+                raise requests.exceptions.HTTPError(
+                    "graftfault: injected shard 503")
+            if kind == "slow":
+                import time as _time
+                _time.sleep(min(0.05, timeout_s))
+            resp = requests.post(url, json=payload, timeout=timeout_s)
+            resp.raise_for_status()
+            body = resp.json()
+            if key not in body:
+                raise UpstreamError(
+                    shard, url,
+                    str(body.get("error", f"response missing {key!r}")))
+            return body[key]
+
+        try:
+            return hop_policy.call(attempt, shard=shard,
+                                   deadline=deadline)
+        except (UpstreamError, graftfault.Unavailable):
+            raise
+        except requests.exceptions.RequestException as e:
+            raise UpstreamError(shard, url, f"{type(e).__name__}: {e}")
 
     def _generate_remote(req: GenerateReq, prompt_ids: List[int],
-                         eos_id: Optional[int] = None) -> List[int]:
+                         eos_id: Optional[int] = None,
+                         deadline: Optional[graftfault.Deadline] = None,
+                         ) -> List[int]:
         """Reference-topology decode: per token, POST the full sequence to
         shard A, relay hidden states to shard B, sample host-side
         (reference server.py:169-206). O(n²) and JSON-lossy by design —
@@ -781,10 +840,12 @@ def create_app(cfg: Optional[ServingConfig] = None,
                                    top_k=req.top_k, top_p=req.top_p))
         for _ in range(req.max_new_tokens):
             hidden = _relay("a", f"{cfg.shard_a_url}/forward",
-                            {"input_ids": ids}, "hidden_states")
+                            {"input_ids": ids}, "hidden_states",
+                            deadline=deadline)
             logits = np.asarray(_relay(
                 "b", f"{cfg.shard_b_url}/forward_b",
-                {"hidden_states": hidden}, "logits"))[0, -1]
+                {"hidden_states": hidden}, "logits",
+                deadline=deadline))[0, -1]
             if req.mode == "greedy":
                 ids.append(int(np.argmax(logits)))
             else:
@@ -819,8 +880,32 @@ def create_app(cfg: Optional[ServingConfig] = None,
             return out({"error": "This instance is not coordinator."})
         if req.max_new_tokens < 1:
             return out({"error": "max_new_tokens must be >= 1"})
+        # Per-request deadline budget (graftfault): ``X-Deadline-Ms``
+        # caps the caller's total wait — HTTP wait, queue wait, shard
+        # hop timeouts, and in-flight decode all derive from the
+        # remaining budget; a row past its deadline is cancelled at the
+        # next segment boundary with its blocks freed, and the caller
+        # gets a typed 503 + Retry-After instead of a hung connection.
+        raw_dl = (headers.get("x-deadline-ms") or "").strip()
+        deadline = None
+        if raw_dl:
+            try:
+                dl_ms = int(raw_dl)
+            except ValueError:
+                dl_ms = 0
+            if not 1 <= dl_ms <= 86_400_000:
+                # a proper 400, not the reference's 200-with-error wire
+                # quirk: this header is an extension, so status-checking
+                # clients get the honest signal (parity only binds the
+                # reference's own fields)
+                return out({"error": "X-Deadline-Ms must be an integer "
+                            "millisecond budget in [1, 86400000]"},
+                           status=400)
+            deadline = graftfault.Deadline.from_ms(dl_ms)
         trace = tracing.RequestTrace(rid, mode=req.mode,
                                      dispatch=cfg.dispatch)
+        if deadline is not None:
+            trace.labels.update(deadline_ms=dl_ms)
         with trace.span("tokenize"):
             prompt_ids = tokenizer.encode(req.prompt)
         if not prompt_ids:
@@ -887,7 +972,8 @@ def create_app(cfg: Optional[ServingConfig] = None,
                     try:
                         with tracing.use_trace(trace):
                             ids = _generate_remote(req, prompt_ids,
-                                                   eos_id=eos_id)
+                                                   eos_id=eos_id,
+                                                   deadline=deadline)
                     except UpstreamError as e:
                         # typed upstream failure (the reference propagates
                         # a raw exception -> opaque 500, server.py:173-180)
@@ -902,7 +988,8 @@ def create_app(cfg: Optional[ServingConfig] = None,
                 else:
                     with tracing.use_trace(trace):
                         ids = _generate_local(req, prompt_ids,
-                                              eos_id=eos_id)
+                                              eos_id=eos_id,
+                                              deadline=deadline)
             # the response-assembly tail (EOS truncation, detokenize,
             # latency derivation) stays INSIDE the try: a decode error
             # surfacing there must still flight-record and echo the id
@@ -955,6 +1042,16 @@ def create_app(cfg: Optional[ServingConfig] = None,
                                 finish_reason=finish_reason,
                                 ttft_ms=round(ttft * 1e3, 3))
             rec.record(trace)
+        except graftfault.Unavailable as e:
+            # typed degraded-mode unavailability (graftfault): deadline
+            # budget exhausted, per-shard breaker open, transient-fault
+            # park budget exhausted, or a permanent engine fault — 503 +
+            # Retry-After with the partial span tree flight-recorded and
+            # the X-Request-ID echoed, never an opaque 500
+            hdrs["Retry-After"] = str(max(1, int(round(e.retry_after))))
+            trace.labels.update(error=e.code)
+            rec.record(trace)
+            return out({"error": e.code, "detail": str(e)}, status=503)
         except Exception as e:  # noqa: BLE001 — a failed (e.g. timed-out)
             # generation is exactly the request the flight recorder must
             # keep, and the caller still needs its X-Request-ID echo;

@@ -46,6 +46,13 @@ _CONTEXT_PARAMS = ("headers", "query")
 GUARDED_STATE = {}
 LOCK_ORDER = ()
 
+# Fault contract (tools/graftcheck faults pass): the dispatch layer owns
+# NO blocking boundaries — socket reads ride the stdlib server and every
+# handler failure is already a typed 4xx/500 (degraded-mode headers like
+# Retry-After flow through the 3-tuple handler return). Declared empty
+# so a blocking call added here must declare its policy.
+FAULT_POLICY = {}
+
 
 class JSONApp:
     """Route table: (method, path) -> handler.

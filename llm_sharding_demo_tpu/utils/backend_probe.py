@@ -18,6 +18,14 @@ from typing import Optional, Tuple
 
 _PROBE_CODE = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
 
+# Fault contract (tools/graftcheck faults pass): the probe child runs
+# under a configured hard timeout with capped linear-backoff retries;
+# persistent failure degrades to skip-with-reason, never a hang.
+FAULT_POLICY = {
+    "subprocess.run": ("config", "capped-linear-backoff",
+                       "skip-with-reason when the probe stays down"),
+}
+
 
 def probe_default_backend(timeout_s: float, attempts: int = 1,
                           backoff_s: float = 0.0,

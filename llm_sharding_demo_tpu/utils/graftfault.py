@@ -1,0 +1,562 @@
+"""graftfault: seeded fault injection + degraded-mode serving primitives.
+
+The dynamic half of the graftcheck faults pass (``tools/graftcheck/
+faults.py`` is the static half — the same static+dynamic split as
+graftsan and graftlock). The serving topology is coordinator-plus-shards
+(and, per ROADMAP item 2, a disaggregated fleet next), where Helix-style
+placement economics make preemption, eviction, and replica failure
+steady-state events — so the failure paths need the same deterministic,
+replayable test harness the race and memory hazards already have.
+
+Three things live here:
+
+**Seeded fault injection** (``GRAFTFAULT=1`` or an installed
+:class:`FaultPlan`): production fault boundaries call
+:func:`inject(site, *kinds)` — a no-op returning ``None`` when no plan
+is armed (zero cost on the serving path). With a plan armed, the k-th
+call at a site deterministically maps to an outcome via
+``hash(seed, site, k)``: the SAME seed replays the SAME per-site
+outcome sequence regardless of wall clock (thread interleaving can
+reorder which request sees outcome k, but the site's outcome sequence
+is pinned — the same determinism contract as GRAFTSCHED schedules).
+Injected kinds mirror the real failure classes: hop connection
+reset/timeout/slow-response, shard 5xx, pool-exhaustion spikes, and
+mid-decode engine exceptions (transient and permanent). Every firing is
+logged with ``file:line (func)`` provenance (``FaultPlan.injections``).
+
+**Deadline budgets** (:class:`Deadline`): one per-request monotonic
+deadline, derived from the ``X-Deadline-Ms`` request header, that every
+blocking hop downstream derives its own timeout from — the static
+``deadline-drop`` rule exists to keep that derivation honest.
+
+**HopPolicy** (typed retry + circuit breaker): the cross-process hop
+discipline replacing ad-hoc ``timeout=30`` + one-retry loops. Capped
+exponential backoff with seeded jitter, a per-request retry budget, and
+a per-shard circuit breaker (CLOSED -> OPEN after ``breaker_threshold``
+consecutive failures -> HALF-OPEN probe after ``breaker_cooldown_s`` ->
+CLOSED on probe success). An open breaker raises
+:class:`CircuitOpenError` (-> a typed 503 + Retry-After from serving)
+instead of queueing more work behind a dead dependency.
+
+Typed unavailability (:class:`Unavailable` and subclasses) is the
+degraded-mode contract: serving maps it to 503 + ``Retry-After`` with
+the X-Request-ID echoed, never an opaque 500.
+
+Env knobs: ``GRAFTFAULT`` ("" / ``0`` off, ``1`` armed),
+``GRAFTFAULT_SEED`` (int, default 0), ``GRAFTFAULT_RATE`` (float,
+default 0.1), ``GRAFTFAULT_SITES`` / ``GRAFTFAULT_KINDS``
+(comma-separated filters; empty = all). Tests prefer an explicit
+``install(FaultPlan(...))`` / ``use(plan)`` so the plan's injection log
+is directly assertable.
+
+Like graftsched, this module is measurement apparatus: it is excluded
+from the static faults pass's own scan.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import graftsched
+
+__all__ = [
+    "CircuitOpenError", "Deadline", "DeadlineExceeded", "FaultBudgetError",
+    "FaultPlan", "HopPolicy", "Injection", "PermanentFault",
+    "TransientFault", "Unavailable", "enabled", "inject", "install",
+    "plan", "reset", "seed", "use",
+]
+
+# Lock-discipline contract (tools/graftcheck locks pass): the plan's
+# per-site counters/log and the policy's breaker table are touched from
+# arbitrary serving/scheduler threads; each lives under its owning
+# instance's ``_lock``. Backoff sleeps and hop attempts run OUTSIDE any
+# hold (the blocking-under-lock rule pins that).
+GUARDED_STATE = {"_inj_counts": "_lock", "_inj_log": "_lock",
+                 "_breakers": "_lock",
+                 "_PLAN": "_PLAN_LOCK", "_ENV_PLAN": "_PLAN_LOCK"}
+LOCK_ORDER = ("_PLAN_LOCK", "_lock")
+
+
+def enabled() -> bool:
+    return os.environ.get("GRAFTFAULT", "") not in ("", "0")
+
+
+def seed() -> int:
+    try:
+        return int(os.environ.get("GRAFTFAULT_SEED", "0"))
+    except ValueError:
+        return 0
+
+
+def _env_rate() -> float:
+    try:
+        return float(os.environ.get("GRAFTFAULT_RATE", "0.1"))
+    except ValueError:
+        return 0.1
+
+
+def _env_set(name: str) -> Optional[frozenset]:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    return frozenset(p.strip() for p in raw.split(",") if p.strip())
+
+
+def _call_site() -> str:
+    """``file.py:line (func)`` of the nearest frame outside this module
+    — the provenance every injection record carries (graftsched's
+    helper, told to skip THIS module's frames)."""
+    return graftsched._call_site(skip_file=__file__)
+
+
+# -- typed faults -------------------------------------------------------------
+
+
+class InjectedFault(RuntimeError):
+    """Base of every deterministically injected failure."""
+
+    def __init__(self, site: str, kind: str, message: str):
+        super().__init__(message)
+        self.site = site
+        self.kind = kind
+
+
+class TransientFault(InjectedFault):
+    """A failure the degraded path must absorb: the iter scheduler parks
+    the affected rows via the recompute-resume machinery and replays
+    them byte-identically."""
+
+
+class Unavailable(RuntimeError):
+    """Typed degraded-mode unavailability: serving answers 503 with
+    ``Retry-After = retry_after`` (rounded up, >= 1s) and the request's
+    X-Request-ID echoed — the caller knows to back off, monitoring sees
+    a typed error, and nothing surfaces as an opaque 500."""
+
+    code = "unavailable"
+
+    def __init__(self, message: str, retry_after: float = 1.0):
+        super().__init__(message)
+        self.retry_after = max(float(retry_after), 0.0)
+
+
+class PermanentFault(InjectedFault, Unavailable):
+    """An injected engine failure the degraded path must NOT retry: the
+    affected rows fail with their partial trace flight-recorded and the
+    caller gets the typed 503."""
+
+    code = "engine_fault"
+
+    def __init__(self, site: str, kind: str, message: str,
+                 retry_after: float = 1.0):
+        InjectedFault.__init__(self, site, kind, message)
+        self.retry_after = max(float(retry_after), 0.0)
+
+
+class CircuitOpenError(Unavailable):
+    """The per-shard breaker is OPEN: the hop was not even attempted."""
+
+    code = "circuit_open"
+
+
+class DeadlineExceeded(Unavailable):
+    """The request's deadline budget ran out (X-Deadline-Ms, or a
+    caller-supplied ``deadline=``); in-flight rows are cancelled at the
+    next segment boundary with their blocks freed."""
+
+    code = "deadline_exceeded"
+
+
+class FaultBudgetError(Unavailable):
+    """A row exhausted its transient-fault park budget — repeated
+    recovery attempts failed; the caller should retry elsewhere/later."""
+
+    code = "fault_budget_exhausted"
+
+
+# -- deadline budget ----------------------------------------------------------
+
+
+class Deadline:
+    """One monotonic per-request deadline, threaded end-to-end: HTTP
+    wait, queue wait, shard-hop timeouts, and segment-boundary
+    cancellation all derive their budgets from ``remaining()``."""
+
+    __slots__ = ("at",)
+
+    def __init__(self, at: float):
+        self.at = float(at)
+
+    @classmethod
+    def from_ms(cls, ms: float) -> "Deadline":
+        return cls(time.monotonic() + float(ms) / 1e3)
+
+    def remaining(self) -> float:
+        return self.at - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def timeout(self, cap: float) -> float:
+        """A per-attempt timeout derived from the remaining budget,
+        never exceeding ``cap`` and never non-positive (a zero timeout
+        would mean "no timeout" to several libraries)."""
+        return max(min(float(cap), self.remaining()), 1e-3)
+
+    def raise_if_expired(self, what: str = "request") -> None:
+        if self.expired():
+            raise DeadlineExceeded(
+                f"{what}: deadline budget exhausted "
+                f"({-self.remaining() * 1e3:.0f}ms past)")
+
+
+# -- the seeded plan ----------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Injection:
+    """One fired fault, with provenance — what the must-find fixtures
+    pin (site, kind, per-site sequence number, ``file:line (func)``)."""
+
+    site: str
+    kind: str
+    seq: int
+    where: str
+
+
+class FaultPlan:
+    """Deterministic, replay-identical fault schedule.
+
+    The k-th ``fire`` at a site hashes ``(seed, site, k)`` into its own
+    RNG: whether it fires and which kind it picks is a pure function of
+    those three values, so a pinned seed replays the same per-site
+    outcome sequence — :meth:`preview` exposes that sequence without
+    consuming it, which is how tests pin replay identity.
+
+    ``sites`` / ``kinds`` filter where faults may land (None = all);
+    ``max_injections`` bounds the total fired (surgical fixtures:
+    "exactly one transient decode fault")."""
+
+    def __init__(self, seed: int = 0, rate: float = 0.1,
+                 sites: Optional[Sequence[str]] = None,
+                 kinds: Optional[Sequence[str]] = None,
+                 max_injections: Optional[int] = None):
+        self.seed = int(seed)
+        self.rate = float(rate)
+        self.sites = None if sites is None else frozenset(sites)
+        self.kinds = None if kinds is None else frozenset(kinds)
+        self.max_injections = max_injections
+        self._lock = graftsched.lock("graftfault.FaultPlan._lock")
+        self._inj_counts: Dict[str, int] = {}
+        self._inj_log: List[Injection] = []
+
+    def _decide(self, site: str, n: int,
+                kinds: Tuple[str, ...]) -> Optional[str]:
+        """The pure (seed, site, n) -> outcome function."""
+        if self.sites is not None and site not in self.sites:
+            return None
+        allowed = [k for k in kinds
+                   if self.kinds is None or k in self.kinds]
+        if not allowed:
+            return None
+        rng = random.Random(f"{self.seed}/{site}/{n}")
+        if rng.random() >= self.rate:
+            return None
+        return allowed[rng.randrange(len(allowed))]
+
+    def preview(self, site: str, kinds: Sequence[str],
+                n: int) -> List[Optional[str]]:
+        """The first ``n`` outcomes the plan would produce at ``site``
+        — pure, counter-free: two plans with the same seed preview
+        identically (the replay pin)."""
+        return [self._decide(site, i, tuple(kinds)) for i in range(n)]
+
+    def fire(self, site: str, kinds: Sequence[str]) -> Optional[str]:
+        with self._lock:
+            n = self._inj_counts.get(site, 0)
+            self._inj_counts[site] = n + 1
+            budget_left = (self.max_injections is None
+                           or len(self._inj_log) < self.max_injections)
+        if not budget_left:
+            return None
+        kind = self._decide(site, n, tuple(kinds))
+        if kind is None:
+            return None
+        inj = Injection(site, kind, n, _call_site())
+        with self._lock:
+            if (self.max_injections is not None
+                    and len(self._inj_log) >= self.max_injections):
+                return None
+            self._inj_log.append(inj)
+        return kind
+
+    @property
+    def injections(self) -> List[Injection]:
+        with self._lock:
+            return list(self._inj_log)
+
+
+# -- ambient plan plumbing ----------------------------------------------------
+
+_PLAN_LOCK = threading.Lock()   # module bootstrap only; never contended
+_PLAN: Optional[FaultPlan] = None
+_ENV_PLAN: Optional[FaultPlan] = None
+
+
+def install(p: Optional[FaultPlan]) -> None:
+    """Arm (or, with None, disarm) an explicit plan; it takes precedence
+    over the env-built one."""
+    global _PLAN
+    with _PLAN_LOCK:
+        _PLAN = p
+
+
+@contextlib.contextmanager
+def use(p: FaultPlan):
+    """Scoped :func:`install` for tests."""
+    install(p)
+    try:
+        yield p
+    finally:
+        install(None)
+
+
+def reset() -> None:
+    """Drop both the installed and the cached env-built plan (tests
+    re-arm the env and want a fresh seed/rate read)."""
+    global _PLAN, _ENV_PLAN
+    with _PLAN_LOCK:
+        _PLAN = None
+        _ENV_PLAN = None
+
+
+def plan() -> Optional[FaultPlan]:
+    """The active plan: the installed one, else one built (once) from
+    the GRAFTFAULT env contract, else None. The unarmed fast path is
+    lock-free (one global ref read + one env lookup) — ``inject`` rides
+    every decode segment and admission check, so the common
+    production case must not serialize workers on a global lock."""
+    p = _PLAN
+    if p is not None:
+        return p
+    if not enabled():
+        return None
+    global _ENV_PLAN
+    with _PLAN_LOCK:
+        if _PLAN is not None:
+            return _PLAN
+        if _ENV_PLAN is None:
+            _ENV_PLAN = FaultPlan(seed=seed(), rate=_env_rate(),
+                                  sites=_env_set("GRAFTFAULT_SITES"),
+                                  kinds=_env_set("GRAFTFAULT_KINDS"))
+        return _ENV_PLAN
+
+
+def inject(site: str, *kinds: str) -> Optional[str]:
+    """The production hook: returns the injected kind, or None (always
+    None with no plan armed — the only cost is one attribute read)."""
+    p = plan()
+    if p is None:
+        return None
+    return p.fire(site, kinds)
+
+
+# -- the hop policy -----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Breaker:
+    """Per-shard breaker record (all fields under HopPolicy._lock)."""
+
+    streak: int = 0            # consecutive failures
+    opened_at: Optional[float] = None
+    probing: bool = False      # HALF-OPEN probe in flight
+
+
+class HopPolicy:
+    """Typed retry/backoff/circuit-breaker discipline for one class of
+    cross-process hops (e.g. coordinator -> stage shards).
+
+    ``call(fn, shard=..., deadline=...)`` drives ``fn(timeout_s)``
+    through up to ``attempts`` tries with capped exponential backoff and
+    seeded jitter between them; every attempt's ``timeout_s`` is derived
+    from the remaining deadline budget (capped at ``timeout_s``).
+    Exceptions listed in ``fatal`` propagate immediately (no retry — a
+    misroute does not get better with repetition). ``on_retry(shard,
+    reason)`` fires before each re-attempt (serving counts it into
+    ``shard_hop_retries_total{stage,reason}``).
+
+    The per-shard breaker opens after ``breaker_threshold`` CONSECUTIVE
+    failures: calls fail fast with :class:`CircuitOpenError` (Retry-After
+    = remaining cooldown) instead of stacking timeouts behind a dead
+    shard. After ``breaker_cooldown_s`` one probe call is let through
+    (HALF-OPEN); success closes the breaker, failure re-opens it.
+    """
+
+    def __init__(self, attempts: int = 3, timeout_s: float = 30.0,
+                 base_backoff_s: float = 0.05, max_backoff_s: float = 1.0,
+                 breaker_threshold: int = 5,
+                 breaker_cooldown_s: float = 5.0,
+                 jitter_seed: int = 0, fatal: Tuple[type, ...] = (),
+                 on_retry=None, sleep=time.sleep):
+        if attempts < 1:
+            raise ValueError("attempts must be >= 1")
+        self.attempts = attempts
+        self.timeout_s = float(timeout_s)
+        self.base_backoff_s = float(base_backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.fatal = tuple(fatal)
+        self.on_retry = on_retry
+        self._sleep = sleep
+        self._lock = graftsched.lock("graftfault.HopPolicy._lock")
+        self._rng = random.Random(jitter_seed)
+        self._breakers: Dict[str, _Breaker] = {}
+
+    # -- breaker transitions (each a single lock hold) --
+
+    def _gate(self, shard: str) -> None:
+        """Admission through the breaker; raises CircuitOpenError or
+        marks the HALF-OPEN probe, atomically."""
+        now = time.monotonic()
+        with self._lock:
+            b = self._breakers.setdefault(shard, _Breaker())
+            if b.opened_at is None:
+                return
+            waited = now - b.opened_at
+            if waited < self.breaker_cooldown_s:
+                raise CircuitOpenError(
+                    f"shard {shard!r} circuit open "
+                    f"({b.streak} consecutive failures)",
+                    retry_after=self.breaker_cooldown_s - waited)
+            if b.probing:
+                raise CircuitOpenError(
+                    f"shard {shard!r} circuit half-open: a probe is "
+                    "already in flight",
+                    retry_after=self.breaker_cooldown_s)
+            b.probing = True   # this call IS the probe
+
+    def _note_failure(self, shard: str) -> bool:
+        """Record a failed attempt; returns True when the breaker is
+        now open (the caller stops retrying)."""
+        now = time.monotonic()
+        with self._lock:
+            b = self._breakers.setdefault(shard, _Breaker())
+            b.streak += 1
+            if b.probing or b.streak >= self.breaker_threshold:
+                b.opened_at = now       # open (or re-open after a probe)
+                b.probing = False
+                return True
+            return False
+
+    def _note_success(self, shard: str) -> None:
+        with self._lock:
+            self._breakers[shard] = _Breaker()   # fully closed
+
+    def _probe_release(self, shard: str) -> None:
+        """Clear a HALF-OPEN probe claim that ended without a verdict
+        (deadline raised before the attempt ran, or a non-Exception
+        unwound it) — otherwise the stuck flag would wedge the breaker
+        open forever. Idempotent: a probe that already resolved through
+        note_failure/note_success left ``probing`` False."""
+        with self._lock:
+            b = self._breakers.get(shard)
+            if b is not None:
+                b.probing = False
+
+    def breaker_state(self, shard: str) -> str:
+        now = time.monotonic()
+        with self._lock:
+            b = self._breakers.get(shard)
+            if b is None or b.opened_at is None:
+                return "closed"
+            if b.probing:
+                return "half-open"
+            if now - b.opened_at >= self.breaker_cooldown_s:
+                return "half-open"
+            return "open"
+
+    def _backoff(self, attempt: int) -> float:
+        """Capped exponential with seeded jitter in [0.5x, 1.5x)."""
+        base = min(self.base_backoff_s * (2 ** (attempt - 1)),
+                   self.max_backoff_s)
+        with self._lock:
+            j = 0.5 + self._rng.random()
+        return base * j
+
+    def call(self, fn, *, shard: str,
+             deadline: Optional[Deadline] = None):
+        """Drive ``fn(timeout_s)`` through the policy. Raises the last
+        attempt's exception when the retry budget is exhausted,
+        :class:`CircuitOpenError` when the breaker is (or goes) open,
+        :class:`DeadlineExceeded` when the budget ran out."""
+        self._gate(shard)
+        try:
+            return self._call_gated(fn, shard=shard, deadline=deadline)
+        except BaseException:
+            # any exit that reached neither note_failure nor
+            # note_success (pre-attempt deadline, KeyboardInterrupt
+            # mid-fn) must not leak a HALF-OPEN probe claim
+            self._probe_release(shard)
+            raise
+
+    def _call_gated(self, fn, *, shard: str,
+                    deadline: Optional[Deadline] = None):
+        last: Optional[BaseException] = None
+        for attempt in range(self.attempts):
+            if attempt:
+                delay = self._backoff(attempt)
+                if deadline is not None \
+                        and deadline.remaining() <= delay:
+                    break   # no budget left to wait out the backoff
+                self._sleep(delay)
+            if deadline is not None:
+                deadline.raise_if_expired(f"hop to shard {shard!r}")
+            t = (self.timeout_s if deadline is None
+                 else deadline.timeout(self.timeout_s))
+            try:
+                out = fn(t)
+            except self.fatal:
+                # a fatal class still counts against the shard's streak
+                # (a misrouted/erroring shard is an unhealthy shard)
+                self._note_failure(shard)
+                raise
+            except Exception as e:  # noqa: BLE001 — retried per policy
+                last = e
+                opened = self._note_failure(shard)
+                if opened:
+                    raise CircuitOpenError(
+                        f"shard {shard!r} circuit opened after repeated "
+                        f"failures (last: {type(e).__name__}: {e})",
+                        retry_after=self.breaker_cooldown_s) from e
+                if (self.on_retry is not None
+                        and attempt + 1 < self.attempts):
+                    self.on_retry(shard, _failure_reason(e))
+                continue
+            self._note_success(shard)
+            return out
+        if deadline is not None and deadline.expired():
+            raise DeadlineExceeded(
+                f"hop to shard {shard!r}: deadline budget exhausted "
+                f"after {self.attempts} attempt(s)") from last
+        assert last is not None
+        raise last
+
+
+def _failure_reason(e: BaseException) -> str:
+    """Stable low-cardinality reason label for retry metrics."""
+    name = type(e).__name__.lower()
+    if "timeout" in name:
+        return "timeout"
+    if "connection" in name:
+        return "connection"
+    if "http" in name:
+        return "http_error"
+    return "error"

@@ -94,6 +94,13 @@ METRIC_CATALOG: Dict[str, str] = {
     # serving admission control: /generate requests turned away with
     # 429 + Retry-After because the KV pool could not host them
     "kv_pool_admission_rejections_total": "counter",
+    # fault tolerance (graftfault): shard-hop retries through the typed
+    # HopPolicy, labeled stage (shard role) x low-cardinality failure
+    # reason (timeout/connection/http_error/error); and transient
+    # decode faults the iter scheduler absorbed by parking the live
+    # rows through the recompute-resume path
+    "shard_hop_retries_total": "counter",
+    "iter_fault_parks_total": "counter",
     # live-state gauges
     "queue_depth": "gauge",                 # waiting requests per scheduler
     "batch_occupancy": "gauge",             # live rows / compiled width

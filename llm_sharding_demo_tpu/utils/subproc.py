@@ -18,6 +18,16 @@ from typing import Optional, Sequence
 
 AOT_SPEW_MARKERS = ("cpu_aot_loader", "machine feature")
 
+# Fault contract (tools/graftcheck faults pass): ``proc.wait()`` is
+# timeout-less ON PURPOSE — the kill timer is the deadline authority (a
+# blocking readline cannot time out by itself), and a watchdog kill
+# surfaces as TimeoutError with the killed flag disambiguating it from
+# the child's own exit.
+FAULT_POLICY = {
+    "proc.wait": ("watchdog", "none",
+                  "kill timer bounds the child; TimeoutError on kill"),
+}
+
 
 def run_filtered(cmd: Sequence[str], *, env: Optional[dict] = None,
                  cwd: Optional[str] = None, timeout_s: float,

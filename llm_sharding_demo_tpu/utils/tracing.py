@@ -373,13 +373,17 @@ class FlightRecorder:
         with self._lock:
             return len(self._traces)
 
-    def snapshot(self, n: Optional[int] = None,
-                 slowest: bool = False) -> List[dict]:
+    def snapshot(self, n: Optional[int] = None, slowest: bool = False,
+                 errors_only: bool = False) -> List[dict]:
         """Most recent (or slowest) ``n`` traces as JSON timelines,
-        newest/slowest first."""
+        newest/slowest first. ``errors_only`` keeps only error-labeled
+        traces (failed/shed/degraded requests) — the fault-triage view
+        ``/debug/requests?errors=1`` serves."""
         with self._lock:
             traces = list(self._traces)
         traces.reverse()                      # newest first
+        if errors_only:
+            traces = [t for t in traces if "error" in t.labels]
         if slowest:
             traces.sort(key=lambda t: t.duration, reverse=True)
         if n is not None:

@@ -75,6 +75,7 @@ _QUICK_MODULES = {
     "test_graftplan",       # cost model goldens + planner rankings
     "test_graftsan",        # donation-aliasing pass + pool sanitizer
     "test_graftlock",       # lock-discipline pass + GRAFTSCHED harness
+    "test_graftfault",      # fault contracts + seeded injection + deadlines
     "test_graftscope",      # device-time attribution + bench_diff gate
 }
 

@@ -91,6 +91,25 @@ def test_repo_passes_graftcheck():
         assert regions.get(rel, 0) >= 1, (
             f"{rel}: no guarded region — its GUARDED_STATE declaration "
             "no longer matches any `with <lock>` hold")
+    assert payload["fault_checks"] >= 20, (
+        "graftfault faults pass went vacuous — a new bare-blocking-call"
+        " / unbounded-retry / deadline-drop / swallowed-fault finding "
+        "anywhere in the tree fails this strict run (rule fixtures in "
+        "tests/test_graftfault.py)")
+    assert payload["fault_vacuous"] == [], (
+        "boundary modules whose FAULT_POLICY covers none of their "
+        f"blocking sites: {payload['fault_vacuous']}")
+    # every boundary module declares a LIVE fault policy (>= 1 declared
+    # entry matching a real blocking site)
+    fpol = payload["fault_policies"]
+    for rel in ("llm_sharding_demo_tpu/serving/app.py",
+                "llm_sharding_demo_tpu/runtime/iterbatch.py",
+                "llm_sharding_demo_tpu/runtime/batcher.py",
+                "llm_sharding_demo_tpu/utils/subproc.py",
+                "llm_sharding_demo_tpu/utils/backend_probe.py"):
+        assert fpol.get(rel, 0) >= 1, (
+            f"{rel}: no matched FAULT_POLICY entry — its fault "
+            "contract no longer matches any blocking site")
     assert payload["scope_checks"] >= 10, (
         "graftscope static pass went vacuous — a new unprofiled jit "
         "entry point anywhere in the tree fails this strict run (rule "

@@ -492,11 +492,16 @@ def test_verifier_json_schema_shape():
                             "stale_baseline", "semantic_checks",
                             "sanitize_checks", "locks_checks",
                             "locks_guarded_regions", "locks_vacuous",
+                            "fault_checks", "fault_policies",
+                            "fault_vacuous",
                             "scope_checks", "scope_profiled_regions",
                             "scope_vacuous", "recompile_bounds"}
     assert isinstance(payload["ok"], bool)
     assert isinstance(payload["sanitize_checks"], int)
     assert isinstance(payload["locks_checks"], int)
+    assert isinstance(payload["fault_checks"], int)
+    assert isinstance(payload["fault_policies"], dict)
+    assert isinstance(payload["fault_vacuous"], list)
     assert isinstance(payload["locks_guarded_regions"], dict)
     assert isinstance(payload["locks_vacuous"], list)
     assert isinstance(payload["scope_checks"], int)

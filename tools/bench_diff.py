@@ -53,7 +53,7 @@ THRESHOLDS: Dict[str, float] = {
 # name-suffix/substring classification: which direction is "worse".
 _HIGHER_BETTER = ("tokens_per_sec", "tokens_per_second", "speedup",
                   "vs_baseline", "mfu", "cache_speedup",
-                  "accepted_tokens_per_verify")
+                  "accepted_tokens_per_verify", "success_rate")
 _LOWER_BETTER = ("_ms", "latency", "step_ms", "prefill_ms")
 # environment properties, not code performance: the tunnel's RTT, the
 # reference CPU's own rate, and the attribution run's host-dependent

@@ -57,12 +57,14 @@ def run(root: str = None, lint_only: bool = False,
     if added:
         sys.path.insert(0, root)
     try:
-        from . import lint, locks, sanitize, scope
+        from . import faults, lint, locks, sanitize, scope
         findings = list(lint.run_lint(root))
         san, sanitize_checks = sanitize.run_sanitize(root)
         findings.extend(san)
         lk, locks_summary = locks.run_locks(root)
         findings.extend(lk)
+        fl, faults_summary = faults.run_faults(root)
+        findings.extend(fl)
         sc, scope_summary = scope.run_scope_static(root)
         findings.extend(sc)
         semantic_checks = 0
@@ -101,10 +103,13 @@ def run(root: str = None, lint_only: bool = False,
         # concurrency contract stopped seeing that module's locking)
         # and on a VACUOUS profiling contract (a runtime module with
         # jit entry points but zero graftscope-instrumented dispatch
-        # sites — device-time attribution went blind there)
+        # sites — device-time attribution went blind there) and on a
+        # VACUOUS fault contract (a module with blocking boundaries
+        # none of which its FAULT_POLICY covers)
         "ok": (not active and not (strict and stale)
                and not (strict and locks_summary["vacuous"])
-               and not (strict and scope_summary["vacuous"])),
+               and not (strict and scope_summary["vacuous"])
+               and not (strict and faults_summary["vacuous"])),
         "strict": strict,
         "findings": [f.to_dict() for f in active],
         "suppressed": len(suppressed),
@@ -115,6 +120,9 @@ def run(root: str = None, lint_only: bool = False,
         "locks_checks": locks_summary["locks_checks"],
         "locks_guarded_regions": locks_summary["guarded_regions"],
         "locks_vacuous": locks_summary["vacuous"],
+        "fault_checks": faults_summary["fault_checks"],
+        "fault_policies": faults_summary["fault_policies"],
+        "fault_vacuous": faults_summary["vacuous"],
         "scope_checks": scope_summary["scope_checks"],
         "scope_profiled_regions": scope_summary["profiled_regions"],
         "scope_vacuous": scope_summary["vacuous"],
@@ -300,6 +308,7 @@ def main(argv=None) -> int:
               f"{payload['suppressed']} baselined, "
               f"{payload['semantic_checks']} semantic checks, "
               f"{payload['sanitize_checks']} sanitize checks, "
+              f"{payload['fault_checks']} fault checks, "
               f"{payload['scope_checks']} scope checks"
               + ("" if args.lint_only else
                  f", recompile bounds for {len(payload['recompile_bounds'])}"

@@ -1,0 +1,490 @@
+"""graftcheck faults pass: fault-contract static analysis (compile-free).
+
+The serving topology is coordinator-plus-shards (generalizing, per
+ROADMAP item 2, to a disaggregated fleet), and at fleet scale failure is
+steady state — yet until this pass the repo's failure story was ad-hoc:
+one hard-coded ``timeout=30`` hop, bare ``Event.wait``/``Queue.get``
+seams, and nothing proving a deadline survives its way downstream.
+Mirroring graftsan/graftlock's static+dynamic split, this module is the
+STATIC half: every cross-process or host-blocking boundary becomes a
+DECLARED contract, enforced by AST rules over the production tree. The
+dynamic half — seeded fault injection, deadline budgets, and the
+``HopPolicy`` breaker — lives in ``llm_sharding_demo_tpu/utils/
+graftfault.py`` (which, like any harness runtime, is excluded from its
+own pass's scan).
+
+In-file declaration (the registration-annotation idiom of
+``JIT_ENTRY_POINTS`` / ``DONATED_ARGS`` / ``GUARDED_STATE``):
+
+- ``FAULT_POLICY``: dict literal ``{site: (deadline_source,
+  retry_class, degradation)}`` — one entry per blocking SITE in the
+  module. The site key is the call's trailing dotted form
+  (``"requests.post"``, ``"done.wait"``, ``"_queue.get"``,
+  ``"proc.wait"``, ``"subprocess.run"``). ``deadline_source`` says what
+  bounds the wait and is drawn from a fixed vocabulary:
+
+  * ``"request"``  — the per-request deadline budget: the call MUST
+                     carry a timeout argument (and, inside a function
+                     that takes a deadline parameter, derive it from
+                     the remaining budget — the deadline-drop rule);
+  * ``"config"``   — a configured constant budget: a timeout argument
+                     is still required at the call;
+  * ``"watchdog"`` — an external kill timer bounds the wait (the
+                     subproc watchdog): a call-site timeout is not
+                     required, the declaration documents the bound;
+  * ``"unbounded"``— indefinite by design (an idle worker parked on
+                     its queue): allowed, but only as a declared,
+                     justified choice.
+
+  ``retry_class`` and ``degradation`` are free-form documentation
+  strings ("hop-policy", "none"; "typed-503 + breaker", "cancel at next
+  boundary") — the pass validates their presence, humans read them.
+
+Blocking classes the pass recognizes (host fault boundaries only —
+``ops/`` is exempt: pallas DMA-semaphore ``.wait()`` is device-side):
+
+- **hop**: ``requests.<verb>(...)`` network round trips;
+- **wait**: ``<recv>.wait(...)`` event/process waits;
+- **queue-get**: ``<recv>.get(...)`` where the receiver names a queue
+  (``self._queue.get``); ``get_nowait`` never blocks and is ignored;
+- **subprocess**: ``subprocess.run/call/check_call/check_output`` and
+  ``.communicate()``.
+
+Rules (ids in brackets; suppressions ride the shared baseline):
+
+- [bare-blocking-call] a blocking site with no FAULT_POLICY entry (or a
+                       module with blocking sites and no declaration at
+                       all, or a stale/malformed entry), or a site
+                       declared ``request``/``config`` whose call
+                       carries no timeout argument.
+- [unbounded-retry]    a retry loop (a loop whose body retries a
+                       blocking call through a non-re-raising except)
+                       with no attempt cap (``while True``) — or a
+                       capped loop with no backoff sleep between
+                       attempts (hammering a failing dependency at
+                       full rate).
+- [deadline-drop]      inside a function that accepts a deadline
+                       parameter (``deadline``/``deadline_s``/
+                       ``deadline_ms``/``budget_s``), a blocking call
+                       whose timeout is absent or not DERIVED from the
+                       remaining budget (simple assignment taint from
+                       the deadline name) — the budget dies at that
+                       hop.
+- [swallowed-fault]    an except handler around a blocking site whose
+                       body only ``pass``es or only logs — the fault
+                       boundary exists and its failures vanish.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import lint as L
+from .core import Finding
+from .locks import _dotted, _module_assign, _parents, _scope_of
+
+FAULTS_RULE_IDS = ("bare-blocking-call", "unbounded-retry",
+                   "deadline-drop", "swallowed-fault")
+
+# the injection/deadline/breaker runtime is the measurement apparatus
+# (same exemption class as graftsched in the locks pass)
+_EXEMPT_RELPATHS = {"llm_sharding_demo_tpu/utils/graftfault.py",
+                    "llm_sharding_demo_tpu/utils/graftsched.py"}
+# pallas DMA-semaphore .wait() in kernels is device-side data movement,
+# not a host fault boundary
+_EXEMPT_PREFIXES = ("llm_sharding_demo_tpu/ops/",)
+
+_DEADLINE_SOURCES = ("request", "config", "watchdog", "unbounded")
+_TIMEOUTLESS_OK = ("watchdog", "unbounded")
+_DEADLINE_PARAMS = {"deadline", "deadline_s", "deadline_ms", "budget_s"}
+_SUBPROCESS_FNS = {"run", "call", "check_call", "check_output"}
+_LOG_RECEIVERS = {"log", "logger", "logging", "warnings"}
+
+
+# -- declarations -------------------------------------------------------------
+
+
+def declared_policy(mod: L.ModuleInfo,
+                    ) -> Tuple[Optional[Dict[str, tuple]], int,
+                               List[str]]:
+    """``FAULT_POLICY`` -> ({site: (source, retry, degradation)}, decl
+    line, malformed-entry messages); (None, 0, []) when undeclared."""
+    stmt = _module_assign(mod, "FAULT_POLICY")
+    if stmt is None:
+        return None, 0, []
+    bad: List[str] = []
+    if not isinstance(stmt.value, ast.Dict):
+        return {}, stmt.lineno, ["FAULT_POLICY must be a dict literal"]
+    out: Dict[str, tuple] = {}
+    for k, v in zip(stmt.value.keys, stmt.value.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            bad.append("FAULT_POLICY keys must be string site names")
+            continue
+        vals: Optional[List[str]] = None
+        if isinstance(v, (ast.Tuple, ast.List)):
+            vals = [e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+            if len(vals) != len(v.elts):
+                vals = None
+        if vals is None or len(vals) != 3:
+            bad.append(f"site {k.value!r}: policy must be a "
+                       "(deadline_source, retry_class, degradation) "
+                       "string triple")
+            continue
+        if vals[0] not in _DEADLINE_SOURCES:
+            bad.append(f"site {k.value!r}: unknown deadline_source "
+                       f"{vals[0]!r} (vocabulary: "
+                       f"{_DEADLINE_SOURCES})")
+            continue
+        out[k.value] = tuple(vals)
+    return out, stmt.lineno, bad
+
+
+# -- blocking-site classification ---------------------------------------------
+
+
+@dataclasses.dataclass
+class BlockingSite:
+    line: int
+    scope: str
+    key: str                 # declaration key ("requests.post", ...)
+    cls: str                 # hop | wait | queue-get | subprocess
+    has_timeout: bool
+    timeout_node: Optional[ast.AST]
+    node: ast.Call
+
+
+def _timeout_arg(call: ast.Call, cls: str,
+                 ) -> Tuple[bool, Optional[ast.AST]]:
+    for kw in call.keywords:
+        if kw.arg in ("timeout", "timeout_s"):
+            return True, kw.value
+    if cls == "wait" and call.args:
+        return True, call.args[0]            # Event.wait(t)
+    if cls == "queue-get" and len(call.args) >= 2:
+        return True, call.args[1]            # Queue.get(block, t)
+    return False, None
+
+
+def classify_call(call: ast.Call) -> Optional[Tuple[str, str]]:
+    """(site key, class) when ``call`` is a recognized blocking form."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    recv = _dotted(f.value)
+    if recv is None:
+        return None
+    leaf = recv.rpartition(".")[2]
+    if recv == "requests" and not f.attr.startswith("exception"):
+        return f"requests.{f.attr}", "hop"
+    if recv == "subprocess" and f.attr in _SUBPROCESS_FNS:
+        return f"subprocess.{f.attr}", "subprocess"
+    if f.attr == "communicate":
+        return f"{leaf}.communicate", "subprocess"
+    if f.attr == "wait":
+        return f"{leaf}.wait", "wait"
+    if f.attr == "get" and "queue" in leaf.lower():
+        return f"{leaf}.get", "queue-get"
+    return None
+
+
+def _sites_in(body: Sequence[ast.stmt]) -> List[ast.Call]:
+    """Blocking calls in a statement list, NOT descending into nested
+    function bodies (a closure's calls belong to its own scope)."""
+    return [n for n in _own_body_walk_stmts(body)
+            if isinstance(n, ast.Call) and classify_call(n) is not None]
+
+
+def module_sites(mod: L.ModuleInfo) -> List[BlockingSite]:
+    parents = _parents(mod.tree)
+    out: List[BlockingSite] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        got = classify_call(node)
+        if got is None:
+            continue
+        key, cls = got
+        has_t, t_node = _timeout_arg(node, cls)
+        out.append(BlockingSite(
+            line=node.lineno, scope=_scope_of(node, parents, mod),
+            key=key, cls=cls, has_timeout=has_t, timeout_node=t_node,
+            node=node))
+    return sorted(out, key=lambda s: s.line)
+
+
+# -- helpers for the flow rules -----------------------------------------------
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _own_body_walk(fn: ast.AST):
+    """ast.walk over a function body, skipping nested function bodies."""
+    body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+    return _own_body_walk_stmts(body)
+
+
+def _deadline_taint(fn: ast.AST, param: str) -> Set[str]:
+    """Names derived (transitively, via simple assignments in the
+    function's own body) from the deadline parameter — what a timeout
+    expression must reference to count as budget-derived."""
+    taint = {param}
+    for _ in range(4):                       # small fixed point
+        grew = False
+        for n in _own_body_walk(fn):
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                value = n.value
+                if value is None or not (_names_in(value) & taint):
+                    continue
+                targets = (n.targets if isinstance(n, ast.Assign)
+                           else [n.target])
+                for t in targets:
+                    for nm in ast.walk(t):
+                        if isinstance(nm, ast.Name) \
+                                and nm.id not in taint:
+                            taint.add(nm.id)
+                            grew = True
+        if not grew:
+            break
+    return taint
+
+
+def _handler_swallows(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body only passes or only logs — no
+    re-raise, no return, no state change a caller could observe."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            f = stmt.value.func
+            if isinstance(f, ast.Attribute):
+                base = _dotted(f.value)
+                if base is not None and (
+                        base.rpartition(".")[2] in _LOG_RECEIVERS
+                        or base in _LOG_RECEIVERS):
+                    continue
+            if isinstance(f, ast.Name) and f.id == "print":
+                continue
+        return False
+    return True
+
+
+def _handler_retries(handler: ast.ExceptHandler) -> bool:
+    """True when the handler stays in the loop for another attempt: it
+    neither re-raises nor exits the loop (break/return) — the shape
+    that makes the enclosing loop a RETRY loop."""
+    for n in ast.walk(handler):
+        if isinstance(n, (ast.Raise, ast.Break, ast.Return)):
+            return False
+    return True
+
+
+def _is_sleepish(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and "sleep" in f.attr:
+        return True
+    return isinstance(f, ast.Name) and "sleep" in f.id
+
+
+def _loop_is_bounded(loop: ast.AST) -> bool:
+    """A for-over-range (or any for) caps attempts; a while loop counts
+    as bounded only when its test is a real condition (not ``True``)."""
+    if isinstance(loop, (ast.For, ast.AsyncFor)):
+        return True
+    test = loop.test
+    return not (isinstance(test, ast.Constant) and bool(test.value))
+
+
+# -- the pass -----------------------------------------------------------------
+
+
+def run_faults(root: str, paths: Optional[List[str]] = None,
+               ) -> Tuple[List[Finding], dict]:
+    """The whole static pass over the production surface ->
+    (findings, summary). ``summary`` carries ``fault_checks`` (real
+    analysis units: sites classified, declarations validated, retry
+    loops walked, deadline taints resolved, handlers examined — a
+    vacuity guard on the count proves the rules saw the tree),
+    ``fault_policies`` (per-module count of declared entries matching a
+    live site) and ``vacuous`` (modules with blocking sites whose
+    declaration matches none of them — the strict driver fails these)."""
+    mods: List[L.ModuleInfo] = []
+    for path in (paths if paths is not None else L.iter_sources(root)):
+        mod = L.index_module(path, root)
+        if mod is None:
+            continue
+        if mod.relpath in _EXEMPT_RELPATHS:
+            continue
+        if any(mod.relpath.startswith(p) for p in _EXEMPT_PREFIXES):
+            continue
+        mods.append(mod)
+
+    findings: List[Finding] = []
+    checks = 0
+    policies: Dict[str, int] = {}
+    vacuous: List[str] = []
+
+    for mod in mods:
+        sites = module_sites(mod)
+        policy, decl_line, malformed = declared_policy(mod)
+        checks += len(sites) + (1 if policy is not None else 0)
+
+        for msg in malformed:
+            findings.append(Finding(
+                "bare-blocking-call", mod.relpath, decl_line or 1,
+                "<module>", f"malformed FAULT_POLICY: {msg}"))
+
+        # -- bare-blocking-call: declaration coverage + timeouts --
+        if sites and policy is None:
+            findings.append(Finding(
+                "bare-blocking-call", mod.relpath, sites[0].line,
+                sites[0].scope,
+                f"boundary module has {len(sites)} blocking site(s) "
+                f"(first: {sites[0].key!r}) but declares no "
+                "FAULT_POLICY — declare {site: (deadline_source, "
+                "retry_class, degradation)} per site so the fault "
+                "contract is reviewable"))
+        matched: Set[str] = set()
+        for s in sites:
+            decl = (policy or {}).get(s.key)
+            if decl is None:
+                if policy is not None:
+                    findings.append(Finding(
+                        "bare-blocking-call", mod.relpath, s.line,
+                        s.scope,
+                        f"blocking site {s.key!r} ({s.cls}) has no "
+                        "FAULT_POLICY entry — what bounds this wait "
+                        "and what degrades when it fails?"))
+                continue
+            matched.add(s.key)
+            if decl[0] not in _TIMEOUTLESS_OK and not s.has_timeout:
+                findings.append(Finding(
+                    "bare-blocking-call", mod.relpath, s.line, s.scope,
+                    f"blocking site {s.key!r} is declared "
+                    f"deadline_source={decl[0]!r} but the call passes "
+                    "no timeout argument — the declared budget never "
+                    "reaches the wait"))
+        for key in sorted(set(policy or {}) - matched):
+            findings.append(Finding(
+                "bare-blocking-call", mod.relpath, decl_line or 1,
+                "<module>",
+                f"FAULT_POLICY declares site {key!r} but no such "
+                "blocking call exists in this module (stale "
+                "declaration)"))
+        if policy is not None or sites:
+            policies[mod.relpath] = len(matched)
+            if sites and not matched:
+                vacuous.append(mod.relpath)
+
+        # -- per-function flow rules --
+        for qual, fn in sorted(mod.functions.items()):
+            if isinstance(fn, ast.Lambda):
+                continue
+            # unbounded-retry: loops retrying a blocking call through a
+            # non-re-raising handler
+            for node in _own_body_walk(fn):
+                if not isinstance(node, (ast.For, ast.AsyncFor,
+                                         ast.While)):
+                    continue
+                retrying = False
+                for t in _own_body_walk_stmts(node.body):
+                    if not isinstance(t, ast.Try):
+                        continue
+                    if not _sites_in(t.body):
+                        continue
+                    if any(_handler_retries(h) for h in t.handlers):
+                        retrying = True
+                        break
+                if not retrying:
+                    continue
+                checks += 1
+                if not _loop_is_bounded(node):
+                    findings.append(Finding(
+                        "unbounded-retry", mod.relpath, node.lineno,
+                        qual,
+                        "retry loop around a blocking call has no "
+                        "attempt cap (while True) — a dead dependency "
+                        "is retried forever (cap attempts and back "
+                        "off, e.g. graftfault.HopPolicy)"))
+                elif not any(isinstance(n, ast.Call) and _is_sleepish(n)
+                             for n in _own_body_walk_stmts(node.body)):
+                    findings.append(Finding(
+                        "unbounded-retry", mod.relpath, node.lineno,
+                        qual,
+                        "retry loop around a blocking call has no "
+                        "backoff sleep — a failing dependency is "
+                        "hammered at full rate between attempts"))
+
+            # deadline-drop
+            args = getattr(fn, "args", None)
+            if args is None:
+                continue
+            all_args = (args.posonlyargs + args.args + args.kwonlyargs)
+            dl = next((a.arg for a in all_args
+                       if a.arg in _DEADLINE_PARAMS), None)
+            if dl is None:
+                continue
+            taint = _deadline_taint(fn, dl)
+            checks += 1
+            for node in _own_body_walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                got = classify_call(node)
+                if got is None:
+                    continue
+                key, cls = got
+                has_t, t_node = _timeout_arg(node, cls)
+                if has_t and t_node is not None \
+                        and (_names_in(t_node) & taint):
+                    continue
+                findings.append(Finding(
+                    "deadline-drop", mod.relpath, node.lineno, qual,
+                    f"{qual} accepts a deadline ({dl!r}) but blocking "
+                    f"site {key!r} does not derive its timeout from "
+                    "the remaining budget — the deadline dies at this "
+                    "hop (derive timeout via e.g. "
+                    "deadline.timeout(cap))"))
+
+        # -- swallowed-fault --
+        parents = _parents(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            if not _sites_in(node.body):
+                continue
+            checks += 1
+            for h in node.handlers:
+                if _handler_swallows(h):
+                    findings.append(Finding(
+                        "swallowed-fault", mod.relpath, h.lineno,
+                        _scope_of(h, parents, mod),
+                        "except handler around a declared fault "
+                        "boundary only passes/logs — the failure "
+                        "vanishes with no retry, no typed error, no "
+                        "degradation (surface it or route it through "
+                        "the hop policy)"))
+
+    summary = {
+        "fault_checks": checks,
+        "fault_policies": policies,
+        "vacuous": sorted(vacuous),
+    }
+    return (sorted(findings, key=lambda f: (f.path, f.line, f.rule)),
+            summary)
+
+
+def _own_body_walk_stmts(body: Sequence[ast.stmt]):
+    """Like :func:`_own_body_walk` but over a raw statement list."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
