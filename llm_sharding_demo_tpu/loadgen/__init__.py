@@ -1,0 +1,38 @@
+"""graftload: seeded open-loop load generation + declared SLO contracts.
+
+The load-level observability layer (ROADMAP item 6), in the spine's
+static+dynamic split:
+
+- **dynamic half** (this package + ``python -m tools.graftload``): a
+  seeded OPEN-LOOP load generator whose schedule is a pure function of
+  ``(seed, profile, k)`` — replay-identical like ``FaultPlan`` and
+  GRAFTSCHED schedules — driving the real in-process serving app
+  through composable workload profiles (``profiles.PROFILES``) while
+  the graftscope occupancy series record queue depth, batch occupancy,
+  pool blocks, and breaker state;
+- **static half** (``tools/graftcheck/slo.py``): SLOs are a DECLARED
+  contract — every profile declares ``SLO_POLICY = {metric: (target,
+  percentile)}`` and the slo pass verifies each target is computable
+  from a ``METRIC_CATALOG`` series the request path actually emits.
+
+Per-run output: throughput-vs-p99 Pareto rows and goodput-under-SLO
+(typed 429/503 sheds counted separately from SLO misses), journaled by
+``bench.py`` as ``graftload_pareto`` / ``slo_attainment`` and gated by
+``tools/bench_diff.py`` like any other row.
+"""
+
+from .driver import (Outcome, occupancy_summary, pareto_row,  # noqa: F401
+                     run_load, slo_row, summarize)
+from .profiles import (PROFILES, SLO_METRICS, SLO_POLICY,  # noqa: F401
+                       SLO_SOURCE_METRICS, WorkloadProfile, profile,
+                       slo_for)
+from .schedule import (Arrival, arrival_fields, schedule,  # noqa: F401
+                       schedule_bytes, shared_prefix)
+
+__all__ = [
+    "Arrival", "Outcome", "PROFILES", "SLO_METRICS", "SLO_POLICY",
+    "SLO_SOURCE_METRICS", "WorkloadProfile", "arrival_fields",
+    "occupancy_summary", "pareto_row", "profile", "run_load",
+    "schedule", "schedule_bytes", "shared_prefix", "slo_for",
+    "slo_row", "summarize",
+]

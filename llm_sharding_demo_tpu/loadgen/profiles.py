@@ -1,0 +1,170 @@
+"""graftload workload profiles + declared SLO contracts.
+
+A *profile* is a composable description of one production traffic
+shape: how requests arrive (open-loop rate process), what they look
+like (prompt length, shared-prefix structure, decode budget), and how
+callers behave (deadline budgets, mid-stream abandonment). The load
+schedule derived from a profile is a pure function of ``(seed,
+profile, k)`` (``loadgen.schedule``) — the same replay-identity
+contract as ``FaultPlan`` and GRAFTSCHED schedules — so a load run is
+a pinnable artifact, not a dice roll.
+
+SLOs are a DECLARED contract (the graftcheck ``slo`` pass is the
+static half, ``tools/graftcheck/slo.py``): every profile in
+``PROFILES`` declares an ``SLO_POLICY`` entry ``{metric: (target,
+percentile)}`` over the fixed vocabulary
+
+- ``ttft``          — time to first token, seconds; attained when the
+                      declared percentile of completed requests lands
+                      at or under ``target``;
+- ``tpot``          — time per output token (inter-token), seconds,
+                      same percentile semantics;
+- ``e2e``           — whole-request wall time, seconds, same
+                      percentile semantics (this is also the budget
+                      "goodput under SLO" counts against);
+- ``deadline_miss`` — fraction of demanded requests that die on their
+                      deadline budget (typed 503 ``deadline_exceeded``);
+                      ``target`` is the maximum tolerated fraction and
+                      the percentile slot is fixed at 100 (a rate cap,
+                      not a distribution point).
+
+``SLO_SOURCE_METRICS`` maps each vocabulary metric to the
+``METRIC_CATALOG`` series the serving request path actually emits —
+the slo pass verifies every declared target is computable from a
+metric that really exists and is really emitted, so an SLO can never
+reference a number nobody measures (``slo-without-source-metric``),
+and every profile carries a policy (``profile-without-slo``).
+
+Typed sheds (429 pool-admission, 503 breaker/park-budget) are NOT SLO
+misses: a shed is the system refusing work honestly, a miss is the
+system accepting work and failing the promise. ``loadgen.driver``
+counts them separately and ``goodput`` only charges the latter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+# The fixed SLO metric vocabulary (the slo pass rejects anything else).
+SLO_METRICS = ("ttft", "tpot", "e2e", "deadline_miss")
+
+# vocabulary metric -> the METRIC_CATALOG series the request path emits
+# it from (tools/graftcheck/slo.py verifies both the catalog entry and
+# a live emission site; see utils/metrics.py METRIC_CATALOG).
+SLO_SOURCE_METRICS = {
+    "ttft": "ttft_seconds",
+    "tpot": "tpot_seconds",
+    "e2e": "generate_request_seconds",
+    "deadline_miss": "deadline_misses_total",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadProfile:
+    """One declared traffic shape. All rates are at scale 1.0 against
+    the tiny bench/test model; drivers scale with ``rate_scale``."""
+
+    name: str
+    description: str
+    # arrival process: "poisson" (memoryless open loop) or "bursty"
+    # (burst-start gaps at rate/burst, near-zero intra-burst gaps —
+    # the arrival clumping that makes closed-loop generators lie)
+    arrival: str = "poisson"
+    rate_rps: float = 4.0
+    burst: int = 1                     # mean burst size (bursty only)
+    prompt_len: Tuple[int, int] = (8, 24)
+    max_new: Tuple[int, int] = (8, 16)
+    # shared-prefix structure: each request's prompt starts with one of
+    # ``prefix_pool`` deterministic shared prefixes of
+    # ``shared_prefix_len`` chars (0 = no shared structure). Deep
+    # shared prefixes exercise the prefix store + CoW machinery.
+    shared_prefix_len: int = 0
+    prefix_pool: int = 1
+    # cache busting: every request gets a UNIQUE leading prefix, so any
+    # content-keyed reuse (prefix store) whiffs by construction
+    cache_busting: bool = False
+    # caller behavior: an optional X-Deadline-Ms budget on every
+    # request, and a fraction of requests that "walk away" mid-stream
+    # by carrying ``abandon_after_ms`` as their budget instead (the
+    # graftfault deadline-cancellation boundary: the row is cancelled
+    # at the next segment boundary with its blocks freed)
+    deadline_ms: Optional[int] = None
+    abandon_rate: float = 0.0
+    abandon_after_ms: int = 40
+    mode: str = "greedy"               # greedy keeps replay byte-exact
+
+
+# The profile registry the slo pass reads (dict literal on purpose:
+# the keys are statically visible to tools/graftcheck/slo.py, exactly
+# like FAULT_POLICY / GUARDED_STATE declarations).
+PROFILES = {
+    "bursty_chat": WorkloadProfile(
+        name="bursty_chat",
+        description="chat bursts over deep shared system prompts "
+                    "(prefix store + CoW exercise; arrival clumping)",
+        arrival="bursty", rate_rps=6.0, burst=4,
+        prompt_len=(24, 48), max_new=(8, 16),
+        shared_prefix_len=20, prefix_pool=3),
+    "long_context": WorkloadProfile(
+        name="long_context",
+        description="long-context summarization: big prompts, short "
+                    "answers (prefill-dominated, pool-block heavy)",
+        arrival="poisson", rate_rps=1.5,
+        prompt_len=(96, 160), max_new=(4, 8),
+        shared_prefix_len=32, prefix_pool=2),
+    "agentic": WorkloadProfile(
+        name="agentic",
+        description="agent loops: many short turns at high rate "
+                    "(queueing + admission churn)",
+        arrival="poisson", rate_rps=10.0,
+        prompt_len=(4, 12), max_new=(4, 8),
+        shared_prefix_len=8, prefix_pool=2),
+    "abandonment": WorkloadProfile(
+        name="abandonment",
+        description="mid-stream abandonment: a slice of callers walk "
+                    "away on a short deadline budget (segment-boundary "
+                    "cancellation + block reclamation under load)",
+        arrival="poisson", rate_rps=5.0,
+        prompt_len=(12, 32), max_new=(12, 24),
+        deadline_ms=60_000, abandon_rate=0.3, abandon_after_ms=40),
+    "cache_buster": WorkloadProfile(
+        name="cache_buster",
+        description="adversarial cache-busting prompts: unique "
+                    "prefixes defeat content-keyed reuse, every "
+                    "request pays a cold prefill",
+        arrival="poisson", rate_rps=4.0,
+        prompt_len=(16, 40), max_new=(8, 16),
+        cache_busting=True),
+}
+
+# Declared SLO contracts, one entry per profile (the slo pass fails a
+# profile without one, a stale entry for a dead profile, and any
+# metric outside SLO_METRICS / outside SLO_SOURCE_METRICS). Targets
+# are seconds (fractions for deadline_miss) against the tiny CPU test
+# model — deliberately loose: the contract these pin is the SHAPE of
+# the promise (which metrics, which percentiles); tightening targets
+# per deployment is a config edit, not a code change.
+SLO_POLICY = {
+    "bursty_chat": {"ttft": (5.0, 95), "tpot": (1.0, 95),
+                    "e2e": (60.0, 99)},
+    "long_context": {"ttft": (20.0, 95), "e2e": (120.0, 99)},
+    "agentic": {"ttft": (2.5, 95), "tpot": (1.0, 95),
+                "e2e": (30.0, 99)},
+    "abandonment": {"e2e": (60.0, 99), "deadline_miss": (0.05, 100)},
+    "cache_buster": {"ttft": (10.0, 95), "e2e": (90.0, 99)},
+}
+
+
+def profile(name: str) -> WorkloadProfile:
+    try:
+        return PROFILES[name]
+    except KeyError:
+        raise KeyError(f"unknown workload profile {name!r}; registered: "
+                       f"{sorted(PROFILES)}") from None
+
+
+def slo_for(name: str) -> dict:
+    """The declared SLO policy for a profile (the slo pass guarantees
+    this lookup cannot miss for a registered profile)."""
+    return SLO_POLICY[name]

@@ -629,20 +629,26 @@ def create_app(cfg: Optional[ServingConfig] = None,
         "where did that slow request's time go" without a profiler —
         and ``?errors=1`` keeps only failed requests (error-labeled
         traces: timeouts, shed 429s, typed 503s, upstream failures),
-        the fault-triage view graftfault's degraded paths feed."""
+        the fault-triage view graftfault's degraded paths feed.
+        ``?profile=<label>`` keeps only requests carrying that
+        X-Workload-Profile label — the view that triages ONE graftload
+        workload profile's slow/failed requests out of a mixed run
+        (composes with ``errors``/``slowest``)."""
         try:
             n = int(query.get("n", "32"))
         except ValueError:
             return 422, {"detail": "n must be an integer"}
         slowest = query.get("slowest", "").lower() in ("1", "true", "yes")
         errs = query.get("errors", "").lower() in ("1", "true", "yes")
+        prof = query.get("profile") or None
         return {
             "serving": _topology(),
             "capacity": rec.capacity,
             "recorded": len(rec),
             "order": "slowest" if slowest else "newest",
+            **({"profile": prof} if prof else {}),
             "requests": rec.snapshot(n=n, slowest=slowest,
-                                     errors_only=errs),
+                                     errors_only=errs, profile=prof),
         }
 
     @app.get("/debug/profile")
@@ -871,6 +877,14 @@ def create_app(cfg: Optional[ServingConfig] = None,
         raw_rid = (headers.get("x-request-id") or "").strip()
         rid = (raw_rid if _re.fullmatch(r"[A-Za-z0-9._:-]{1,128}", raw_rid)
                else tracing.new_request_id())
+        # Workload-profile label (graftload): callers tag requests with
+        # the profile that generated them so the flight recorder can be
+        # filtered per traffic shape (/debug/requests?profile=...).
+        # Same safe-charset discipline as the request id — the label is
+        # echoed into trace labels and query-matched verbatim.
+        raw_prof = (headers.get("x-workload-profile") or "").strip()
+        profile_label = (raw_prof if _re.fullmatch(r"[A-Za-z0-9._:-]{1,64}",
+                                                   raw_prof) else None)
         hdrs = {"X-Request-ID": rid}
 
         def out(body, status=200):
@@ -904,6 +918,8 @@ def create_app(cfg: Optional[ServingConfig] = None,
             deadline = graftfault.Deadline.from_ms(dl_ms)
         trace = tracing.RequestTrace(rid, mode=req.mode,
                                      dispatch=cfg.dispatch)
+        if profile_label is not None:
+            trace.labels.update(profile=profile_label)
         if deadline is not None:
             trace.labels.update(deadline_ms=dl_ms)
         with trace.span("tokenize"):
@@ -1049,6 +1065,12 @@ def create_app(cfg: Optional[ServingConfig] = None,
             # Retry-After with the partial span tree flight-recorded and
             # the X-Request-ID echoed, never an opaque 500
             hdrs["Retry-After"] = str(max(1, int(round(e.retry_after))))
+            if e.code == "deadline_exceeded":
+                # the SLO deadline_miss source series (loadgen
+                # SLO_SOURCE_METRICS; the graftcheck slo pass verifies
+                # this emission exists): accepted work that died on its
+                # budget — distinct from the shed counters above
+                reg.inc("deadline_misses_total")
             trace.labels.update(error=e.code)
             rec.record(trace)
             return out({"error": e.code, "detail": str(e)}, status=503)

@@ -370,6 +370,15 @@ def inject(site: str, *kinds: str) -> Optional[str]:
 # -- the hop policy -----------------------------------------------------------
 
 
+def _sample_breaker(shard: str, value: float) -> None:
+    """One ``hop_breaker_open`` occupancy point (graftscope series) on
+    a breaker state TRANSITION — 1.0 at open, 0.0 when a probe closes
+    it. Lazy import: graftscope is pure measurement apparatus and this
+    module must stay importable without it mid-bootstrap."""
+    from . import graftscope
+    graftscope.sample("hop_breaker_open", value, shard=shard)
+
+
 @dataclasses.dataclass
 class _Breaker:
     """Per-shard breaker record (all fields under HopPolicy._lock)."""
@@ -450,15 +459,27 @@ class HopPolicy:
         with self._lock:
             b = self._breakers.setdefault(shard, _Breaker())
             b.streak += 1
-            if b.probing or b.streak >= self.breaker_threshold:
+            opened = b.probing or b.streak >= self.breaker_threshold
+            if opened:
                 b.opened_at = now       # open (or re-open after a probe)
                 b.probing = False
-                return True
-            return False
+                # breaker state rides the graftscope occupancy series:
+                # a graftload run sees breaker flaps on the same
+                # timeline as queue depth and pool blocks
+                # (/debug/profile "series"). Sampled UNDER the hold so
+                # a concurrent open/close pair can never land its
+                # points in inverted order (a cheap ring append, not a
+                # blocking call — the blocking-under-lock class).
+                _sample_breaker(shard, 1.0)
+        return opened
 
     def _note_success(self, shard: str) -> None:
         with self._lock:
+            was_open = (shard in self._breakers
+                        and self._breakers[shard].opened_at is not None)
             self._breakers[shard] = _Breaker()   # fully closed
+            if was_open:
+                _sample_breaker(shard, 0.0)      # probe closed it
 
     def _probe_release(self, shard: str) -> None:
         """Clear a HALF-OPEN probe claim that ended without a verdict

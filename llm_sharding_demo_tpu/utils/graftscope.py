@@ -332,6 +332,15 @@ def sample(name: str, value: float, **labels) -> None:
         STATE.sample(name, value, **labels)
 
 
+def now_ms() -> float:
+    """The current instant on the snapshot timeline (milliseconds since
+    attribution start — the same clock ``snapshot``'s ``t_ms`` fields
+    use). Lets a caller window series points to one measurement run
+    (e.g. loadgen's per-run occupancy summary) without touching the
+    shared rings."""
+    return (time.perf_counter() - STATE.t0) * 1e3
+
+
 def program_keys(scope: str) -> Dict[tuple, Tuple[int, float]]:
     return STATE.program_keys(scope)
 

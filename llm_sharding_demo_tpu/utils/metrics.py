@@ -101,8 +101,25 @@ METRIC_CATALOG: Dict[str, str] = {
     # rows through the recompute-resume path
     "shard_hop_retries_total": "counter",
     "iter_fault_parks_total": "counter",
+    # SLO deadline misses (graftload / loadgen SLO_SOURCE_METRICS):
+    # accepted requests that exhausted their X-Deadline-Ms budget and
+    # died typed (503 deadline_exceeded) — the source series behind
+    # every declared ``deadline_miss`` SLO target, and deliberately
+    # NOT a shed counter (sheds refuse work; this broke a promise).
+    # Counts EVERY budget death: the server cannot see caller intent,
+    # so deliberate walk-aways (the loadgen abandonment profile's
+    # short budgets) increment it too — the load harness nets those
+    # out CLIENT-side when scoring deadline_miss SLOs
+    # (loadgen.driver.summarize), so alert thresholds on this raw
+    # series must budget for expected abandonment traffic.
+    "deadline_misses_total": "counter",
     # live-state gauges
     "queue_depth": "gauge",                 # waiting requests per scheduler
+    # per-shard circuit-breaker state (graftfault HopPolicy): 1 while a
+    # shard's breaker is OPEN, 0 when a probe closes it — sampled into
+    # the graftscope occupancy series on transitions, so a graftload
+    # run sees breaker flaps on the same timeline as queue depth
+    "hop_breaker_open": "gauge",
     "batch_occupancy": "gauge",             # live rows / compiled width
     "iter_live_rows": "gauge",              # live iterbatch rows
     # KV memory in BLOCK denomination, labeled by the writer component

@@ -374,16 +374,23 @@ class FlightRecorder:
             return len(self._traces)
 
     def snapshot(self, n: Optional[int] = None, slowest: bool = False,
-                 errors_only: bool = False) -> List[dict]:
+                 errors_only: bool = False,
+                 profile: Optional[str] = None) -> List[dict]:
         """Most recent (or slowest) ``n`` traces as JSON timelines,
         newest/slowest first. ``errors_only`` keeps only error-labeled
         traces (failed/shed/degraded requests) — the fault-triage view
-        ``/debug/requests?errors=1`` serves."""
+        ``/debug/requests?errors=1`` serves. ``profile`` keeps only
+        traces whose X-Workload-Profile label matches — the per-
+        workload triage view a graftload run uses to isolate one
+        traffic shape's slow/failed requests."""
         with self._lock:
             traces = list(self._traces)
         traces.reverse()                      # newest first
         if errors_only:
             traces = [t for t in traces if "error" in t.labels]
+        if profile is not None:
+            traces = [t for t in traces
+                      if t.labels.get("profile") == profile]
         if slowest:
             traces.sort(key=lambda t: t.duration, reverse=True)
         if n is not None:

@@ -77,6 +77,7 @@ _QUICK_MODULES = {
     "test_graftlock",       # lock-discipline pass + GRAFTSCHED harness
     "test_graftfault",      # fault contracts + seeded injection + deadlines
     "test_graftscope",      # device-time attribution + bench_diff gate
+    "test_graftload",       # open-loop load harness + declared SLOs
 }
 
 

@@ -131,6 +131,18 @@ def test_repo_passes_graftcheck():
             f"{rel}: no graftscope-instrumented jit site — its "
             "PROFILED_SCOPES declaration no longer matches any "
             "graftscope.instrument wrap")
+    assert payload["slo_checks"] >= 10, (
+        "graftload slo pass went vacuous — a new profile-without-slo / "
+        "slo-without-source-metric finding anywhere in the tree fails "
+        "this strict run (rule fixtures in tests/test_graftload.py)")
+    assert payload["slo_vacuous"] == [], (
+        "SLO_POLICY declarations matching no registered workload "
+        f"profile: {payload['slo_vacuous']}")
+    # the profile registry carries a LIVE policy per profile
+    assert payload["slo_policies"].get(
+        "llm_sharding_demo_tpu/loadgen/profiles.py", 0) >= 5, (
+        "loadgen/profiles.py: the SLO_POLICY contract no longer "
+        "matches the registered PROFILES")
     assert payload["suppressed"] >= 1, (
         "the documented sync points should be baselined findings — an "
         "empty suppression set means the host-sync rule stopped seeing "

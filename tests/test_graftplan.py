@@ -495,7 +495,9 @@ def test_verifier_json_schema_shape():
                             "fault_checks", "fault_policies",
                             "fault_vacuous",
                             "scope_checks", "scope_profiled_regions",
-                            "scope_vacuous", "recompile_bounds"}
+                            "scope_vacuous", "slo_checks",
+                            "slo_policies", "slo_vacuous",
+                            "recompile_bounds"}
     assert isinstance(payload["ok"], bool)
     assert isinstance(payload["sanitize_checks"], int)
     assert isinstance(payload["locks_checks"], int)
@@ -507,6 +509,9 @@ def test_verifier_json_schema_shape():
     assert isinstance(payload["scope_checks"], int)
     assert isinstance(payload["scope_profiled_regions"], dict)
     assert isinstance(payload["scope_vacuous"], list)
+    assert isinstance(payload["slo_checks"], int)
+    assert isinstance(payload["slo_policies"], dict)
+    assert isinstance(payload["slo_vacuous"], list)
     assert isinstance(payload["strict"], bool)
     assert isinstance(payload["findings"], list)
     assert isinstance(payload["suppressed"], int)
@@ -519,8 +524,10 @@ def test_plan_json_schema_shape():
     """The plan payload schema (docs/ARCHITECTURE.md "Planning"):
     top-level keys, per-row keys, and the chosen row's env mapping."""
     payload = CM.plan(gpt2, GPT2_CFG, {}, max_seq=64)
-    assert set(payload) == {"model", "mesh", "max_seq", "traffic",
-                            "plan", "chosen", "rejected"}
+    assert set(payload) == {"model", "mesh", "ici_byte_weight",
+                            "max_seq", "traffic", "plan", "chosen",
+                            "rejected"}
+    assert payload["ici_byte_weight"] == CM.ICI_BYTE_WEIGHT
     row_keys = {"config", "label", "ok", "cost_per_token",
                 "comm_bytes_per_token", "param_bytes_per_device",
                 "kv_bytes_per_device", "peak_activation_bytes",

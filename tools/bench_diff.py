@@ -53,7 +53,11 @@ THRESHOLDS: Dict[str, float] = {
 # name-suffix/substring classification: which direction is "worse".
 _HIGHER_BETTER = ("tokens_per_sec", "tokens_per_second", "speedup",
                   "vs_baseline", "mfu", "cache_speedup",
-                  "accepted_tokens_per_verify", "success_rate")
+                  "accepted_tokens_per_verify", "success_rate",
+                  # graftload rows: goodput-under-SLO and declared-SLO
+                  # attainment regress DOWNWARD (fewer requests inside
+                  # their declared budgets)
+                  "goodput", "slo_attainment")
 _LOWER_BETTER = ("_ms", "latency", "step_ms", "prefill_ms")
 # environment properties, not code performance: the tunnel's RTT, the
 # reference CPU's own rate, and the attribution run's host-dependent
@@ -121,6 +125,22 @@ def extract_metrics(payload: dict) -> Dict[str, float]:
     return out
 
 
+def skipped_configs(payload: dict) -> Dict[str, str]:
+    """Config names whose row was SKIPPED with a reason (e.g. the TPU
+    tunnel was down). These rows contribute no gated metrics — which
+    used to be silent: a trajectory where every on-chip row skips
+    still exited 0 and read as "gated". ``compare`` now reports them
+    as ``ungated_rows`` with their reasons, and ``--no-skips`` turns
+    any of them into a nonzero exit so CI can notice the tunnel is
+    down instead of green-lighting an ungated run."""
+    out: Dict[str, str] = {}
+    for cfg in (payload or {}).get("configs") or ():
+        if isinstance(cfg, dict) and cfg.get("name") \
+                and cfg.get("skipped"):
+            out[cfg["name"]] = str(cfg["skipped"])
+    return out
+
+
 def error_configs(payload: dict) -> set:
     """Config names whose row ERRORED — what ``compare`` uses to turn a
     config that stopped producing numbers into a finding instead of a
@@ -162,7 +182,8 @@ def load_history(paths: List[str]) -> List[Tuple[str, Dict[str, float]]]:
 def compare(current: Dict[str, float],
             history: List[Tuple[str, Dict[str, float]]],
             threshold: float = DEFAULT_THRESHOLD,
-            current_errors: Optional[set] = None) -> dict:
+            current_errors: Optional[set] = None,
+            current_skips: Optional[Dict[str, str]] = None) -> dict:
     """Join current metrics against the latest prior value per metric.
     Returns the JSON-able verdict payload; ``ok`` is False iff any
     gated metric regressed past its threshold — or a config that
@@ -213,6 +234,12 @@ def compare(current: Dict[str, float],
         "compared": sum(1 for r in rows if r["status"] in
                         ("ok", "regression")),
         "regressions": regressions,
+        # skip-with-reason rows: environment-honest but UNGATED — they
+        # never fail the default run, but they must not vanish either
+        # (--no-skips promotes their presence to a nonzero exit)
+        "ungated_rows": [{"config": name, "reason": reason}
+                         for name, reason in
+                         sorted((current_skips or {}).items())],
         "history_runs": [label for label, _ in history],
         "rows": rows,
     }
@@ -233,6 +260,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="glob of prior trajectory rows")
     ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
                     help="relative regression threshold (default 0.25)")
+    ap.add_argument("--no-skips", action="store_true",
+                    help="exit nonzero when any config row was skipped "
+                    "with a reason (ungated_rows) — CI mode: an ungated "
+                    "run must not read as a gated one")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -248,7 +279,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     current = extract_metrics(payload or {})
     history = load_history(glob.glob(args.history))
     verdict = compare(current, history, threshold=args.threshold,
-                      current_errors=error_configs(payload or {}))
+                      current_errors=error_configs(payload or {}),
+                      current_skips=skipped_configs(payload or {}))
 
     if args.json:
         print(json.dumps(verdict, indent=2))
@@ -263,9 +295,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                       f"({r['prior_run']}) -> {r['current']} "
                       f"({r['delta_pct']}% past the "
                       f"{r['threshold_pct']}% gate)")
+        for row in verdict["ungated_rows"]:
+            print(f"UNGATED {row['config']}: skipped — {row['reason']}")
         print(f"bench_diff: {verdict['compared']} metric(s) compared "
               f"against {len(verdict['history_runs'])} prior run(s), "
-              f"{len(verdict['regressions'])} regression(s)")
+              f"{len(verdict['regressions'])} regression(s), "
+              f"{len(verdict['ungated_rows'])} ungated skip row(s)")
+    if args.no_skips and verdict["ungated_rows"]:
+        return 1
     return 0 if verdict["ok"] else 1
 
 

@@ -57,7 +57,7 @@ def run(root: str = None, lint_only: bool = False,
     if added:
         sys.path.insert(0, root)
     try:
-        from . import faults, lint, locks, sanitize, scope
+        from . import faults, lint, locks, sanitize, scope, slo
         findings = list(lint.run_lint(root))
         san, sanitize_checks = sanitize.run_sanitize(root)
         findings.extend(san)
@@ -67,6 +67,8 @@ def run(root: str = None, lint_only: bool = False,
         findings.extend(fl)
         sc, scope_summary = scope.run_scope_static(root)
         findings.extend(sc)
+        sl, slo_summary = slo.run_slo(root)
+        findings.extend(sl)
         semantic_checks = 0
         bounds = {}
         if not lint_only:
@@ -106,10 +108,14 @@ def run(root: str = None, lint_only: bool = False,
         # sites — device-time attribution went blind there) and on a
         # VACUOUS fault contract (a module with blocking boundaries
         # none of which its FAULT_POLICY covers)
+        # and on a VACUOUS slo contract (an SLO_POLICY matching no
+        # registered workload profile — the goodput gate stopped
+        # seeing traffic)
         "ok": (not active and not (strict and stale)
                and not (strict and locks_summary["vacuous"])
                and not (strict and scope_summary["vacuous"])
-               and not (strict and faults_summary["vacuous"])),
+               and not (strict and faults_summary["vacuous"])
+               and not (strict and slo_summary["vacuous"])),
         "strict": strict,
         "findings": [f.to_dict() for f in active],
         "suppressed": len(suppressed),
@@ -126,6 +132,9 @@ def run(root: str = None, lint_only: bool = False,
         "scope_checks": scope_summary["scope_checks"],
         "scope_profiled_regions": scope_summary["profiled_regions"],
         "scope_vacuous": scope_summary["vacuous"],
+        "slo_checks": slo_summary["slo_checks"],
+        "slo_policies": slo_summary["slo_policies"],
+        "slo_vacuous": slo_summary["vacuous"],
         "recompile_bounds": bounds,
     }
 
@@ -172,11 +181,28 @@ def run_plan(args) -> int:
         except ValueError as e:
             print(str(e), file=sys.stderr)
             return 2
+        ici_w = None
+        if getattr(args, "calibrate_journal", None):
+            # the measure->model loop's consumer: re-price every
+            # candidate's ICI term with the journal's measured
+            # ici_byte_weight_calibration row (costmodel.calibrate)
+            try:
+                with open(args.calibrate_journal, encoding="utf-8") as f:
+                    ici_w = costmodel.calibrate(json.load(f))
+            except (OSError, json.JSONDecodeError) as e:
+                print(f"cannot read --calibrate-journal "
+                      f"{args.calibrate_journal}: {e}", file=sys.stderr)
+                return 2
+            if ici_w is None:
+                print("calibrate: journal carries no usable "
+                      "ici_byte_weight_calibration row (skipped "
+                      "off-chip?); scoring with the a-priori weight",
+                      file=sys.stderr)
         payload = costmodel.plan(
             module, config, mesh_axes, max_seq=args.max_seq,
             traffic=traffic, max_batch_cap=args.max_batch,
             kv_pool_blocks=args.kv_blocks, kv_block_size=args.kv_block_size,
-            hbm_gb=args.hbm_gb)
+            hbm_gb=args.hbm_gb, ici_byte_weight=ici_w)
     finally:
         if added:
             try:
@@ -188,7 +214,9 @@ def run_plan(args) -> int:
         print(json.dumps(payload, indent=2, default=str))
         return 0 if payload["chosen"] is not None else 1
     print(f"graftplan: {args.model} on mesh {payload['mesh'] or '1 device'}"
-          f", traffic {args.traffic or 'default'}")
+          f", traffic {args.traffic or 'default'}"
+          + (f", ici_byte_weight {payload['ici_byte_weight']} (calibrated)"
+             if ici_w is not None else ""))
     for i, row in enumerate(payload["plan"][:args.top]):
         mark = "*" if payload["chosen"] and \
             row["label"] == payload["chosen"]["label"] else " "
@@ -263,6 +291,11 @@ def main(argv=None) -> int:
         ap.add_argument("--kv-block-size", type=int, default=16)
         ap.add_argument("--hbm-gb", type=float, default=16.0,
                         help="per-device HBM feasibility budget")
+        ap.add_argument("--calibrate-journal", default=None,
+                        help="bench journal (BENCH_full/BENCH_rNN.json) "
+                        "whose ici_byte_weight_calibration row re-prices "
+                        "the ICI term with this host's measured byte "
+                        "weight (costmodel.calibrate)")
         ap.add_argument("--top", type=int, default=12,
                         help="table rows to print (text mode)")
         ap.add_argument("--root", default=None)
@@ -309,7 +342,8 @@ def main(argv=None) -> int:
               f"{payload['semantic_checks']} semantic checks, "
               f"{payload['sanitize_checks']} sanitize checks, "
               f"{payload['fault_checks']} fault checks, "
-              f"{payload['scope_checks']} scope checks"
+              f"{payload['scope_checks']} scope checks, "
+              f"{payload['slo_checks']} slo checks"
               + ("" if args.lint_only else
                  f", recompile bounds for {len(payload['recompile_bounds'])}"
                  " workload(s)"))

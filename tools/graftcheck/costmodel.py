@@ -773,9 +773,13 @@ def count_programs(cand: Candidate, max_seq: int,
 def score_candidate(module, config, cand: Candidate,
                     mesh_axes: Dict[str, int], max_seq: int,
                     traffic: Sequence[TrafficRow], pspecs,
-                    hbm_gb: float = DEFAULT_HBM_GB) -> PlanRow:
+                    hbm_gb: float = DEFAULT_HBM_GB,
+                    ici_byte_weight: Optional[float] = None) -> PlanRow:
     """Price one verifier-clean candidate. See the module docstring for
-    the cost terms; everything here is avals and traced jaxprs."""
+    the cost terms; everything here is avals and traced jaxprs.
+    ``ici_byte_weight`` overrides the a-priori ``ICI_BYTE_WEIGHT`` —
+    pass :func:`calibrate`'s measured value to score with this host's
+    observed ICI cost instead of the model's guess."""
     row = PlanRow(candidate=cand, ok=True)
     conc = concurrency(traffic)
     eff_batch = max(1, min(cand.max_batch, conc))
@@ -842,24 +846,66 @@ def score_candidate(module, config, cand: Candidate,
     paged_overhead = (2 * kv_row / PAGED_SEG_STEPS
                       if cand.kv_pool_blocks else 0.0)
     weight_term = row.param_bytes_per_device / eff_batch
+    ici_w = (ICI_BYTE_WEIGHT if ici_byte_weight is None
+             else float(ici_byte_weight))
     row.cost_per_token = (weight_term + kv_row + paged_overhead
-                          + ICI_BYTE_WEIGHT * row.comm_bytes_per_token)
+                          + ici_w * row.comm_bytes_per_token)
     return row
 
 
 # -- the planner -------------------------------------------------------------
 
 
+def calibrate(journal) -> Optional[float]:
+    """Measured ICI byte weight from a bench journal's
+    ``ici_byte_weight_calibration`` row (the measurement half of the
+    measure->model loop, ROADMAP item 5): the row journals the
+    compiled executable's network bytes against the model's formula as
+    ``measured_over_modeled``, and the weight the row was measured
+    AGAINST as ``ici_byte_weight`` — the calibrated weight is their
+    product, so a plan scored with it prices ICI traffic at what this
+    host's XLA actually scheduled. Accepts a raw bench payload, a
+    ``BENCH_rNN.json`` driver row (``parsed`` wrapper), or the config
+    row itself; returns None when the journal carries no usable
+    calibration (e.g. the row was skipped off-chip) — callers fall
+    back to the a-priori ``ICI_BYTE_WEIGHT``."""
+    doc = journal
+    if isinstance(doc, dict) and "parsed" in doc:
+        doc = doc.get("parsed")
+    if not isinstance(doc, dict):
+        return None
+    row = None
+    if doc.get("name") == "ici_byte_weight_calibration":
+        row = doc
+    else:
+        for cfg in doc.get("configs") or ():
+            if isinstance(cfg, dict) \
+                    and cfg.get("name") == "ici_byte_weight_calibration":
+                row = cfg
+                break
+    if row is None or row.get("skipped") or row.get("error"):
+        return None
+    ratio = row.get("measured_over_modeled")
+    if not isinstance(ratio, (int, float)) or ratio <= 0:
+        return None
+    base = row.get("ici_byte_weight")
+    if not isinstance(base, (int, float)) or base <= 0:
+        base = ICI_BYTE_WEIGHT
+    return float(base) * float(ratio)
+
+
 def plan(module, config, mesh_axes: Dict[str, int], max_seq: int = 64,
          traffic: Optional[Sequence[TrafficRow]] = None,
          max_batch_cap: int = 8, kv_pool_blocks: int = 0,
          kv_block_size: int = 16, hbm_gb: float = DEFAULT_HBM_GB,
-         include_unsharded: bool = True) -> dict:
+         include_unsharded: bool = True,
+         ici_byte_weight: Optional[float] = None) -> dict:
     """The library API behind ``python -m tools.graftcheck plan``:
     enumerate -> gate -> score -> rank. Returns the JSON-able payload
     (schema: docs/ARCHITECTURE.md "Planning"); ``chosen`` is the
     top-ranked verifier-clean feasible row, or None when nothing
-    survives."""
+    survives. ``ici_byte_weight`` (see :func:`calibrate`) re-prices
+    every candidate's ICI term with a measured weight."""
     traffic = tuple(traffic) if traffic else DEFAULT_TRAFFIC
     rows: List[PlanRow] = []
     for cand in enumerate_candidates(module, config, mesh_axes, max_seq,
@@ -873,12 +919,15 @@ def plan(module, config, mesh_axes: Dict[str, int], max_seq: int = 64,
                                 note="rejected by the semantic verifier"))
             continue
         rows.append(score_candidate(module, config, cand, mesh_axes,
-                                    max_seq, traffic, pspecs, hbm_gb))
+                                    max_seq, traffic, pspecs, hbm_gb,
+                                    ici_byte_weight=ici_byte_weight))
     rows.sort(key=PlanRow.sort_key)
     chosen = next((r for r in rows if r.ok), None)
     return {
         "model": type(config).__name__,
         "mesh": dict(mesh_axes),
+        "ici_byte_weight": (ICI_BYTE_WEIGHT if ici_byte_weight is None
+                            else float(ici_byte_weight)),
         "max_seq": max_seq,
         "traffic": [r.to_dict() for r in traffic],
         "plan": [r.to_dict() for r in rows],

@@ -1,0 +1,279 @@
+"""graftcheck slo pass: declared-SLO static analysis (compile-free).
+
+The graftload harness (``llm_sharding_demo_tpu/loadgen/``) measures
+goodput against DECLARED service-level objectives — and a declared
+target is only worth gating on if the number it binds is actually
+measured. This pass (the static half of graftload, riding ``python -m
+tools.graftcheck`` and the strict in-suite driver, mirroring the
+faults/locks/sanitize/scope split) holds the declarations to that bar:
+
+In-file declarations (the registration-annotation idiom of
+``FAULT_POLICY`` / ``GUARDED_STATE`` / ``PROFILED_SCOPES``):
+
+- ``PROFILES``: dict literal keyed by profile name — the workload
+  registry (``loadgen/profiles.py``);
+- ``SLO_POLICY``: ``{profile: {metric: (target, percentile)}}`` over
+  the fixed vocabulary ``ttft`` / ``tpot`` / ``e2e`` /
+  ``deadline_miss`` — one entry per registered profile;
+- ``SLO_SOURCE_METRICS``: ``{metric: catalog_name}`` — which
+  ``METRIC_CATALOG`` series each vocabulary metric is computed from.
+
+Rules (ids in brackets; suppressions ride the shared baseline):
+
+- [profile-without-slo]        a registered profile with no SLO_POLICY
+                               entry (or an empty one), a module
+                               declaring PROFILES but no SLO_POLICY at
+                               all, a STALE policy entry naming no
+                               registered profile, or a malformed
+                               declaration (non-literal dict, target
+                               not a positive number — deadline_miss
+                               may declare a zero rate cap —
+                               percentile outside (0, 100]).
+- [slo-without-source-metric]  a declared SLO metric outside the fixed
+                               vocabulary, one with no
+                               SLO_SOURCE_METRICS mapping, one whose
+                               mapped series is missing from
+                               METRIC_CATALOG, or one whose mapped
+                               series is never emitted at any
+                               request-path call site (REGISTRY.inc/
+                               observe/gauge or timed()) — a target
+                               nobody measures is a promise nobody can
+                               keep OR break.
+
+``--strict`` additionally fails a VACUOUS pass (a module declaring
+SLO_POLICY with zero entries matching a live profile — the contract
+stopped seeing the registry); ``cli.run --json`` carries
+``slo_checks`` / ``slo_policies`` / ``slo_vacuous``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import lint as L
+from .core import Finding
+from .locks import _module_assign
+
+SLO_RULE_IDS = ("profile-without-slo", "slo-without-source-metric")
+
+# the fixed vocabulary (loadgen/profiles.py SLO_METRICS mirrors this —
+# tests pin the two stay equal)
+SLO_METRICS = ("ttft", "tpot", "e2e", "deadline_miss")
+
+
+def _str_dict_keys(node: ast.AST) -> Optional[List[Tuple[str, ast.AST]]]:
+    """Dict literal -> [(str key, value node)]; None when not that."""
+    if not isinstance(node, ast.Dict):
+        return None
+    out = []
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            return None
+        out.append((k.value, v))
+    return out
+
+
+def _target_tuple(node: ast.AST) -> Optional[Tuple[float, float]]:
+    """``(target, percentile)`` of numeric constants, else None."""
+    if not isinstance(node, (ast.Tuple, ast.List)) or len(node.elts) != 2:
+        return None
+    vals = []
+    for e in node.elts:
+        if not (isinstance(e, ast.Constant)
+                and isinstance(e.value, (int, float))
+                and not isinstance(e.value, bool)):
+            return None
+        vals.append(float(e.value))
+    return vals[0], vals[1]
+
+
+def _emitted_metric_names(root: str,
+                          paths: Optional[List[str]] = None) -> Set[str]:
+    """Metric names emitted at production call sites — the same
+    REGISTRY.inc/observe/gauge + timed() + graftscope.sample surface
+    the metric-catalog rule scans."""
+    from . import metric_catalog as MC
+    names: Set[str] = set()
+    for path in (paths if paths is not None else MC._iter_sources(root)):
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            continue
+        for m in MC._CALL_RE.finditer(text):
+            names.add(m.group(2))
+        for m in MC._TIMED_RE.finditer(text):
+            names.add(m.group(1))
+        for m in MC._SAMPLE_RE.finditer(text):
+            names.add(m.group(1))
+    return names
+
+
+def run_slo(root: str, paths: Optional[List[str]] = None,
+            catalog: Optional[Dict[str, str]] = None,
+            emitted: Optional[Set[str]] = None,
+            ) -> Tuple[List[Finding], dict]:
+    """The whole static pass -> (findings, summary). ``summary``
+    carries ``slo_checks`` (declarations validated + per-metric
+    resolutions — the vacuity guard on the pass itself),
+    ``slo_policies`` (per-module count of policy entries matching a
+    registered profile) and ``vacuous`` (modules whose SLO_POLICY
+    matches no profile — the strict driver fails these).
+    ``catalog``/``emitted`` are injectable for rule fixtures; by
+    default the real METRIC_CATALOG and the scanned production
+    emission sites."""
+    if catalog is None:
+        from llm_sharding_demo_tpu.utils.metrics import METRIC_CATALOG
+        catalog = METRIC_CATALOG
+    if emitted is None:
+        emitted = _emitted_metric_names(root, paths=paths)
+
+    findings: List[Finding] = []
+    checks = 0
+    policies: Dict[str, int] = {}
+    vacuous: List[str] = []
+
+    for path in (paths if paths is not None else L.iter_sources(root)):
+        mod = L.index_module(path, root)
+        if mod is None:
+            continue
+        prof_stmt = _module_assign(mod, "PROFILES")
+        slo_stmt = _module_assign(mod, "SLO_POLICY")
+        src_stmt = _module_assign(mod, "SLO_SOURCE_METRICS")
+        if prof_stmt is None and slo_stmt is None:
+            continue
+        checks += 1
+
+        profile_names: Set[str] = set()
+        if prof_stmt is not None:
+            entries = _str_dict_keys(prof_stmt.value)
+            if entries is None:
+                findings.append(Finding(
+                    "profile-without-slo", mod.relpath,
+                    prof_stmt.lineno, "<module>",
+                    "PROFILES must be a dict literal with string "
+                    "profile-name keys (the slo pass reads them "
+                    "statically)"))
+            else:
+                profile_names = {k for k, _ in entries}
+
+        if prof_stmt is not None and slo_stmt is None:
+            findings.append(Finding(
+                "profile-without-slo", mod.relpath, prof_stmt.lineno,
+                "<module>",
+                f"module registers {len(profile_names)} workload "
+                "profile(s) but declares no SLO_POLICY — declare "
+                "{profile: {metric: (target, percentile)}} so every "
+                "profile's service promise is reviewable"))
+            continue
+
+        sources: Dict[str, str] = {}
+        if src_stmt is not None:
+            entries = _str_dict_keys(src_stmt.value)
+            if entries is not None:
+                sources = {k: v.value for k, v in entries
+                           if isinstance(v, ast.Constant)
+                           and isinstance(v.value, str)}
+
+        decl = _str_dict_keys(slo_stmt.value)
+        line = slo_stmt.lineno
+        if decl is None:
+            findings.append(Finding(
+                "profile-without-slo", mod.relpath, line, "<module>",
+                "SLO_POLICY must be a dict literal keyed by profile "
+                "name"))
+            continue
+
+        matched = 0
+        declared_profiles = {k for k, _ in decl}
+        for name in sorted(profile_names - declared_profiles):
+            checks += 1
+            findings.append(Finding(
+                "profile-without-slo", mod.relpath,
+                (prof_stmt.lineno if prof_stmt is not None else line),
+                name,
+                f"profile {name!r} is registered but declares no "
+                "SLO_POLICY entry — what latency/goodput promise does "
+                "this traffic shape serve under?"))
+        for name, policy_node in decl:
+            checks += 1
+            if profile_names and name not in profile_names:
+                findings.append(Finding(
+                    "profile-without-slo", mod.relpath, line, name,
+                    f"SLO_POLICY declares profile {name!r} but no such "
+                    "profile is registered in PROFILES (stale "
+                    "declaration)"))
+                continue
+            metrics = _str_dict_keys(policy_node)
+            if not metrics:
+                findings.append(Finding(
+                    "profile-without-slo", mod.relpath, line, name,
+                    f"profile {name!r}: SLO_POLICY entry must be a "
+                    "non-empty dict literal {metric: (target, "
+                    "percentile)} — an empty promise gates nothing"))
+                continue
+            matched += 1
+            for metric, target_node in metrics:
+                checks += 1
+                if metric not in SLO_METRICS:
+                    findings.append(Finding(
+                        "slo-without-source-metric", mod.relpath, line,
+                        name,
+                        f"profile {name!r}: unknown SLO metric "
+                        f"{metric!r} (vocabulary: {SLO_METRICS})"))
+                    continue
+                tgt = _target_tuple(target_node)
+                # deadline_miss is a rate CAP, where zero tolerance
+                # (0.0, 100) is the strictest valid promise; latency
+                # targets must be positive durations
+                floor_ok = tgt is not None and (
+                    tgt[0] >= 0 if metric == "deadline_miss"
+                    else tgt[0] > 0)
+                if tgt is None or not floor_ok \
+                        or not 0 < tgt[1] <= 100:
+                    findings.append(Finding(
+                        "profile-without-slo", mod.relpath, line, name,
+                        f"profile {name!r}: metric {metric!r} must "
+                        "declare a (positive target — >= 0 for the "
+                        "deadline_miss rate cap — percentile in "
+                        "(0, 100]) literal pair"))
+                    continue
+                source = sources.get(metric)
+                if source is None:
+                    findings.append(Finding(
+                        "slo-without-source-metric", mod.relpath, line,
+                        name,
+                        f"profile {name!r}: metric {metric!r} has no "
+                        "SLO_SOURCE_METRICS mapping — which "
+                        "METRIC_CATALOG series is this target computed "
+                        "from?"))
+                    continue
+                if source not in catalog:
+                    findings.append(Finding(
+                        "slo-without-source-metric", mod.relpath, line,
+                        name,
+                        f"profile {name!r}: metric {metric!r} maps to "
+                        f"{source!r}, which is not in METRIC_CATALOG — "
+                        "the declared target references a series that "
+                        "does not exist"))
+                    continue
+                if source not in emitted:
+                    findings.append(Finding(
+                        "slo-without-source-metric", mod.relpath, line,
+                        name,
+                        f"profile {name!r}: metric {metric!r} maps to "
+                        f"{source!r}, which no request-path call site "
+                        "emits — a target nobody measures cannot be "
+                        "attained or missed"))
+        policies[mod.relpath] = matched
+        if matched == 0:
+            vacuous.append(mod.relpath)
+
+    summary = {
+        "slo_checks": checks,
+        "slo_policies": policies,
+        "vacuous": sorted(vacuous),
+    }
+    return (sorted(findings, key=lambda f: (f.path, f.line, f.rule)),
+            summary)

@@ -1,0 +1,176 @@
+"""graftload CLI: seeded open-loop load runs against an in-process app.
+
+    python -m tools.graftload [--profiles bursty_chat,agentic]
+                              [--seed 0] [--requests 24]
+                              [--rate-scales 1.0,2.0] [--mode open]
+                              [--json] [--preview N]
+
+Builds a tiny randomly-initialized GPT-2 serving app (pooled iteration
+scheduler — the production composition serving/app.py wires for
+BATCH_MODE=iter + KV_POOL_BLOCKS) entirely in-process, then drives the
+selected ``loadgen.PROFILES`` through the seeded open-loop generator
+and prints one Pareto/goodput row per ``(profile, rate_scale)`` — the
+same rows bench.py journals as ``graftload_pareto`` /
+``slo_attainment`` and ``tools/bench_diff.py`` gates.
+
+``--preview N`` prints the first N scheduled arrivals of each profile
+WITHOUT running them — the replay-identity debugging view (the
+schedule is a pure function of ``(seed, profile, k)``; two invocations
+with the same seed print byte-identical previews).
+
+``--mode closed --width W`` runs the closed-loop comparison generator
+(W workers, back-to-back). It exists to demonstrate WHY the default is
+open-loop: at saturation the closed loop throttles itself and
+under-reports tail latency (pinned by tests/test_graftload.py) — do
+not gate on closed-loop numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def build_demo_app(max_seq: int = 256, max_batch: int = 4,
+                   kv_pool_blocks: int = 0, kv_block_size: int = 16,
+                   recorder_capacity: int = 1024):
+    """(client, recorder, registry) for a tiny in-process pooled
+    serving app — the graftload CLI/bench target. ``kv_pool_blocks=0``
+    sizes the pool to hold ``max_batch`` full-length rows."""
+    import jax
+
+    from llm_sharding_demo_tpu.models import gpt2
+    from llm_sharding_demo_tpu.serving.app import create_app
+    from llm_sharding_demo_tpu.serving.http import TestClient
+    from llm_sharding_demo_tpu.serving.tokenizer import ByteTokenizer
+    from llm_sharding_demo_tpu.utils.config import ServingConfig
+    from llm_sharding_demo_tpu.utils.metrics import MetricsRegistry
+    from llm_sharding_demo_tpu.utils.tracing import FlightRecorder
+
+    cfg_model = gpt2.GPT2Config(vocab_size=256, n_positions=max_seq,
+                                n_embd=32, n_layer=2, n_head=4)
+    params = gpt2.init_params(cfg_model, jax.random.PRNGKey(0))
+    if kv_pool_blocks <= 0:
+        kv_pool_blocks = max_batch * (-(-max_seq // kv_block_size))
+    cfg = ServingConfig(model_id="graftload-demo",
+                        shard_role="coordinator", max_seq=max_seq,
+                        boundaries=(1,), max_batch=max_batch,
+                        batch_mode="iter", batch_wait_ms=10.0,
+                        kv_pool_blocks=kv_pool_blocks,
+                        kv_block_size=kv_block_size)
+    recorder = FlightRecorder(capacity=recorder_capacity)
+    registry = MetricsRegistry()
+    app = create_app(cfg, model=(cfg_model, params),
+                     tokenizer=ByteTokenizer(), registry=registry,
+                     recorder=recorder)
+    return TestClient(app), recorder, registry
+
+
+def run_profiles(client, recorder, profiles: List[str], seed: int,
+                 n: int, rate_scales: List[float], mode: str,
+                 width: int) -> dict:
+    from llm_sharding_demo_tpu import loadgen
+
+    reports = []
+    for name in profiles:
+        prof = loadgen.profile(name)
+        for scale in rate_scales:
+            reports.append(loadgen.run_load(
+                client, prof, seed=seed, n=n, rate_scale=scale,
+                mode=mode, width=width, recorder=recorder))
+    return {
+        "seed": seed,
+        "requests_per_run": n,
+        "mode": mode,
+        "pareto": [loadgen.pareto_row(r) for r in reports],
+        "slo_attainment": [loadgen.slo_row(r) for r in reports],
+        "occupancy": reports[-1]["occupancy"] if reports else {},
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftload",
+        description="seeded open-loop load harness: Pareto + "
+                    "goodput-under-SLO rows against the in-process "
+                    "serving app")
+    ap.add_argument("--profiles", default="bursty_chat,agentic",
+                    help="comma-separated loadgen.PROFILES names")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--requests", type=int, default=24,
+                    help="arrivals per (profile, rate_scale) run")
+    ap.add_argument("--rate-scales", default="1.0",
+                    help="comma-separated multipliers of each "
+                    "profile's declared rate (a sweep traces the "
+                    "Pareto front)")
+    ap.add_argument("--mode", default="open",
+                    choices=("open", "closed", "serial"))
+    ap.add_argument("--width", type=int, default=4,
+                    help="closed-loop worker count (mode=closed)")
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--max-seq", type=int, default=256)
+    ap.add_argument("--pool-blocks", type=int, default=0,
+                    help="KV pool blocks (0: sized for max_batch "
+                    "full rows)")
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--preview", type=int, default=0,
+                    help="print the first N scheduled arrivals per "
+                    "profile and exit (no load run)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if here not in sys.path:
+        sys.path.insert(0, here)
+
+    from llm_sharding_demo_tpu import loadgen
+
+    names = [p.strip() for p in args.profiles.split(",") if p.strip()]
+    for name in names:
+        loadgen.profile(name)                 # fail fast on typos
+
+    if args.preview:
+        out = {name: [a.to_dict() for a in
+                      loadgen.schedule(loadgen.profile(name), args.seed,
+                                       args.preview)]
+               for name in names}
+        print(json.dumps(out, indent=None if args.json else 2,
+                         sort_keys=True))
+        return 0
+
+    scales = [float(s) for s in args.rate_scales.split(",") if s.strip()]
+    client, recorder, _registry = build_demo_app(
+        max_seq=args.max_seq, max_batch=args.max_batch,
+        kv_pool_blocks=args.pool_blocks, kv_block_size=args.block_size,
+        recorder_capacity=max(args.requests * len(names) * len(scales),
+                              64))
+    payload = run_profiles(client, recorder, names, args.seed,
+                           args.requests, scales, args.mode, args.width)
+
+    if args.json:
+        print(json.dumps(payload, indent=2, default=str))
+        return 0
+    print(f"graftload: seed {args.seed}, {args.requests} arrivals per "
+          f"run, mode {args.mode}")
+    for row in payload["pareto"]:
+        print(f"  {row['profile']:<14} x{row['rate_scale']:<4} "
+              f"offered {row['offered_rps']:>6} rps  "
+              f"tput {row['throughput_tokens_per_sec']:>8} tok/s  "
+              f"p99 {row['p99_e2e_ms']:>8} ms  "
+              f"good {row['goodput_fraction']:>6}  "
+              f"shed {row['shed_429']}+{row['shed_503']}  "
+              f"miss {row['deadline_misses']}")
+    for row in payload["slo_attainment"]:
+        misses = [m for m, r in row["slo"].items() if not r["attained"]]
+        print(f"  {row['profile']:<14} SLO attainment "
+              f"{row['slo_attainment']}"
+              + (f"  MISSED: {misses}" if misses else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
