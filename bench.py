@@ -1015,13 +1015,14 @@ def measure_graftload(profiles=("bursty_chat", "agentic"), seed: int = 0,
     # graftscope rings are process-global and earlier bench configs
     # (concurrent_load, fault_recovery) sampled the same series
     occ_since = graftscope.now_ms()
-    pareto, slo_rows = [], []
+    pareto, slo_rows, reports = [], [], []
     for name in profiles:
         prof = loadgen.profile(name)
         for scale in rate_scales:
             rep = loadgen.run_load(client, prof, seed=seed,
                                    n=n_requests, rate_scale=scale,
                                    mode="open", recorder=recorder)
+            reports.append(rep)
             row = loadgen.pareto_row(rep)
             row["workload"] = f"{name}_x{scale:g}".replace(".", "p")
             pareto.append(row)
@@ -1034,8 +1035,75 @@ def measure_graftload(profiles=("bursty_chat", "agentic"), seed: int = 0,
         "requests_per_run": n_requests,
         "pareto": pareto,
         "slo_rows": slo_rows,
+        # the measured TRAFFIC-MIX signal (ISSUE 12 satellite, the
+        # ROADMAP item-5/6 follow-on AUTO_PLAN continuous mode needs):
+        # demand + goodput-under-SLO + induced occupancy per
+        # (profile, rate) — loadgen.traffic_mix_row over the same runs
+        "traffic_mix": loadgen.traffic_mix_row(reports)["workloads"],
         "occupancy": loadgen.occupancy_summary(since_ms=occ_since),
     }
+
+
+def measure_fleet_scaling(seed: int = 0, n_requests: int = 16) -> dict:
+    """graftfleet scaling row (ISSUE 12): the disaggregated fleet —
+    router + 1 prefill replica + N decode replicas over ONE shared
+    pool — driven by the bursty_chat profile at 1 vs 2 decode
+    replicas. The deep-shared-prefix workload is the fleet's favorable
+    case (the prefill replica warms the content-keyed registry once,
+    affinity routing keeps adoptions local), so this row is the
+    replica-scaling signal: throughput/goodput per decode-replica
+    count plus the router's affinity hit rate and typed-shed split.
+
+    Needs the bench chip: on CPU the decode itself dominates and a
+    second replica would measure host contention, not serving scale.
+    """
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return {"skipped": "fleet replica scaling needs the bench chip "
+                           "(on CPU the decode itself dominates and a "
+                           "second replica measures host contention, "
+                           "not serving scale)"}
+
+    from llm_sharding_demo_tpu import loadgen
+    from llm_sharding_demo_tpu.fleet import build_fleet
+
+    prof = loadgen.profile("bursty_chat")
+    rows = []
+    for n_decode in (1, 2):
+        f = build_fleet(n_decode=n_decode, n_prefill=1,
+                        max_seq=256, kv_pool_blocks=0,
+                        recorder_capacity=max(64, 2 * n_requests))
+        # warmup/compile pass so the open-loop tails measure serving
+        loadgen.run_load(f.client, prof, seed=seed + 1, n=2,
+                         mode="serial", recorder=f.recorder)
+        # affinity_stats is cumulative — snapshot after warmup so the
+        # journaled (gated) rates cover only the measured run
+        base = f.app.router.affinity_stats()
+        rep = loadgen.run_load(f.client, prof, seed=seed,
+                               n=n_requests, rate_scale=2.0,
+                               mode="open", recorder=f.recorder)
+        stats = {k: v - base[k]
+                 for k, v in f.app.router.affinity_stats().items()}
+        routed = stats["hits"] + stats["fallbacks"]
+        rows.append({
+            "workload": f"decode_x{n_decode}",
+            "decode_replicas": n_decode,
+            "offered_rps": rep["offered_rps"],
+            "completed": rep["completed"],
+            "throughput_tokens_per_sec":
+                rep["throughput_tokens_per_sec"],
+            "goodput_rps": rep["goodput_rps"],
+            "goodput_fraction": rep["goodput_fraction"],
+            "p99_e2e_ms": rep["p99_e2e_ms"],
+            "shed_429": rep["shed_429"],
+            "shed_503": rep["shed_503"],
+            "affinity_hit_rate": round(stats["hits"] / routed, 4)
+            if routed else 0.0,
+            "replica_sheds": stats["sheds"],
+        })
+    return {"seed": seed, "requests_per_run": n_requests,
+            "workloads": rows}
 
 
 def measure_spec_iterbatch(config, dtype="bfloat16", n_requests: int = 8,
@@ -2002,11 +2070,38 @@ def main() -> None:
                     "sheds counted separately from SLO misses",
         }
 
+    def cfg_traffic_mix():
+        """The measured traffic-mix signal (ISSUE 12 satellite): one
+        row per (profile, rate_scale) joining offered demand, goodput
+        under the declared SLOs, and the occupancy the mix induced —
+        the tuple AUTO_PLAN's continuous mode watches to decide the
+        measured optimum flipped (ROADMAP item-5/6 follow-on)."""
+        r = _graftload_result()
+        if "skipped" in r:
+            return {"skipped": r["skipped"]}
+        return {
+            "seed": r["seed"],
+            "workloads": r["traffic_mix"],
+            "note": "per-(profile, rate) demand/goodput/occupancy join "
+                    "from the shared graftload run; goodput and "
+                    "throughput gated higher-better, queue depth "
+                    "lower-better by bench_diff",
+        }
+
+    def cfg_fleet_scaling():
+        """graftfleet replica scaling (ISSUE 12): bursty_chat through
+        the shared-pool fleet at 1 vs 2 decode replicas — throughput/
+        goodput per replica count, router affinity hit rate, typed-shed
+        split; skip-with-reason off the bench chip."""
+        return measure_fleet_scaling()
+
     safe("cfg14_paged_kv_vs_contiguous", cfg14)
     safe("concurrent_load", cfg_concurrent_load)
     safe("fault_recovery", cfg_fault_recovery)
     safe("graftload_pareto", cfg_graftload_pareto)
     safe("slo_attainment", cfg_slo_attainment)
+    safe("traffic_mix", cfg_traffic_mix)
+    safe("fleet_scaling", cfg_fleet_scaling)
     safe("cfg4_gpt2_medium_4shard", cfg4)
     safe("cfg5_kv_cache_vs_on2", cfg5)
     safe("cfg6_moe_8e_top2_124m_geometry", cfg6)

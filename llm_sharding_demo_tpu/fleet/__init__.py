@@ -1,0 +1,19 @@
+"""graftfleet: disaggregated prefill/decode replica fleet (ROADMAP 2).
+
+The dynamic half of the fleet subsystem — declared topology contracts
+(:mod:`fleet.topology`), registry-keyed affinity placement
+(:mod:`fleet.affinity`), and the seeded shared-pool harness
+(:mod:`fleet.harness`) behind ``serving/router.py``. The static half
+is the graftcheck fleet pass (``tools/graftcheck/fleet.py``).
+"""
+
+from .affinity import AFFINITY_KEY_SOURCE, HashRing, affinity_key
+from .harness import FleetHarness, build_fleet, build_single
+from .topology import (FLEET_ROLES, HANDOFF_POLICY, FleetTopology,
+                       ReplicaHandle)
+
+__all__ = [
+    "AFFINITY_KEY_SOURCE", "FLEET_ROLES", "FleetHarness",
+    "FleetTopology", "HANDOFF_POLICY", "HashRing", "ReplicaHandle",
+    "affinity_key", "build_fleet", "build_single",
+]

@@ -1,0 +1,87 @@
+"""Prefix-cache affinity: route by the registry's OWN content keys.
+
+The whole point of prefix-affinity routing is that the router's notion
+of "same prefix" and the prefix registry's notion of "same prefix"
+NEVER drift: if the router keyed on, say, a hash of the prompt string
+while the registry keys on the int32 token bytes of chunk-aligned
+prefixes, requests that share a cached prefix could scatter across
+replicas (or worse, the router could co-locate requests the registry
+considers distinct). So the affinity key here IS the registry's key —
+``PrefixCachingEngine._key`` applied to the first chunk — and the
+fleet pass (``tools/graftcheck/fleet.py``, ``affinity-key-drift``
+rule) statically fails any independent re-derivation in this module.
+
+Depth one chunk is deliberate: deeper keys fragment traffic that
+shares a system prompt but diverges later (exactly the bursty-chat
+shape), while the first chunk is the widest shared unit the registry
+can cache at all (entries exist only at chunk multiples with at least
+one token left to forward — prompts shorter than that have no
+cacheable prefix and no affinity, and fall through to load placement).
+
+The fallback placement is a CONSISTENT hash ring (sha256 points,
+``VNODES`` virtual nodes per replica): adding or draining one decode
+replica remaps only that replica's arc of keys instead of reshuffling
+the whole fleet's prefix locality — the property a plain
+``hash(key) % n`` loses on every scale event.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from bisect import bisect_left
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..runtime.prefix_cache import PrefixCachingEngine
+
+# Where the affinity key comes from (tools/graftcheck/fleet.py,
+# affinity-key-drift rule: this module must CALL the declared source
+# and derive no content key of its own).
+AFFINITY_KEY_SOURCE = \
+    "llm_sharding_demo_tpu/runtime/prefix_cache.py:PrefixCachingEngine._key"
+
+# virtual nodes per replica on the ring: enough to spread arcs evenly
+# at fleet sizes this repo serves (2-16 replicas)
+VNODES = 64
+
+
+def affinity_key(prompt_ids: Sequence[int], chunk: int) -> Optional[bytes]:
+    """The routing key for a tokenized prompt: the prefix registry's
+    content key for the FIRST full chunk, or None when the prompt is
+    too short to have any cacheable prefix (``m_max < 1`` — the same
+    "leave >= 1 token to forward" floor the registry's lookup walks
+    with). None routes by load, not affinity."""
+    prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
+    if (len(prompt) - 1) // chunk < 1:
+        return None
+    return PrefixCachingEngine._key(prompt, 1, chunk)
+
+
+class HashRing:
+    """Consistent-hash placement over replica names (sha256 points —
+    process-independent, unlike builtin ``hash`` under hash
+    randomization; the ring must agree across router restarts for
+    affinity to mean anything)."""
+
+    def __init__(self, names: Sequence[str], vnodes: int = VNODES):
+        if not names:
+            raise ValueError("HashRing needs at least one replica name")
+        # immutable after construction (scale events build a new ring),
+        # so reads need no lock
+        pts = []
+        for name in names:
+            for i in range(vnodes):
+                h = hashlib.sha256(f"{name}#{i}".encode()).digest()
+                pts.append((int.from_bytes(h[:8], "big"), name))
+        pts.sort()
+        self._ring_points: List[int] = [p for p, _ in pts]
+        self._ring_owners: List[str] = [o for _, o in pts]
+
+    def pick(self, key: bytes) -> str:
+        """The replica owning ``key``'s arc (first point clockwise)."""
+        h = int.from_bytes(hashlib.sha256(key).digest()[:8], "big")
+        i = bisect_left(self._ring_points, h)
+        if i == len(self._ring_points):
+            i = 0
+        return self._ring_owners[i]

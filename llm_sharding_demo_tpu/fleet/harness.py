@@ -1,0 +1,173 @@
+"""graftfleet in-process harness: a seeded multi-replica fleet.
+
+The test/bench vehicle for the disaggregated topology: several REAL
+``serving.app.create_app`` instances — one prefill replica, N decode
+replicas — sharing ONE ``KVBlockPool`` process-locally (the same
+pool-sharing contract a block-device service would provide across
+processes), fronted by a real ``serving/router.py`` app. Everything
+speaks the production dispatch path (``serving/http.py`` TestClient,
+no sockets), so a graftload profile driven at the router exercises
+exactly the hops, sheds, breakers, and block handoffs production
+would.
+
+Determinism: the model weights come from one pinned PRNG key, replica
+names are stable, the router's ring is sha256-based, and graftload
+schedules are pure functions of (seed, profile, k) — so a fleet run
+under pinned GRAFTSCHED/GRAFTFAULT seeds replays its shed/affinity
+accounting identically, and greedy outputs are byte-equal to the
+single-replica path no matter which replica served them (the prefix
+store is exact and every replica holds the same weights).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+
+def demo_model(max_seq: int = 128):
+    """THE tiny pinned demo model every in-process harness serves —
+    one definition (same geometry, same PRNGKey(0) weights) shared by
+    ``build_fleet``, ``build_single``, and ``tools.graftload.
+    build_demo_app``, so the fleet-vs-single byte-equality pins and the
+    graftload bench target cannot drift apart."""
+    import jax
+
+    from ..models import gpt2
+
+    cfg_model = gpt2.GPT2Config(vocab_size=256, n_positions=max_seq,
+                                n_embd=32, n_layer=2, n_head=4)
+    return cfg_model, gpt2.init_params(cfg_model, jax.random.PRNGKey(0))
+
+
+@dataclasses.dataclass
+class FleetHarness:
+    """Everything a test/bench needs: the router's client plus every
+    internal handle (pool conservation asserts, per-replica metric
+    registries, recorder joins)."""
+
+    client: object                    # TestClient at the router
+    app: object                       # the router JSONApp
+    topology: object                  # fleet.topology.FleetTopology
+    pool: object                      # the SHARED KVBlockPool
+    recorder: object                  # router FlightRecorder
+    registry: object                  # router MetricsRegistry
+    registries: Dict[str, object]     # replica name -> MetricsRegistry
+    chunk: int = 64
+
+
+def build_fleet(n_decode: int = 2, n_prefill: int = 1,
+                max_seq: int = 128, max_batch: int = 1,
+                kv_pool_blocks: int = 0, kv_block_size: int = 16,
+                chunk: int = 16, prefix_cache: int = 8,
+                recorder_capacity: int = 512,
+                hop_policy=None) -> FleetHarness:
+    """One shared-pool fleet: ``n_prefill`` prefill replicas (solo
+    paged runners serving /prefill) and ``n_decode`` decode replicas,
+    a router in front. ``max_batch=1`` (default) serves decode through
+    solo ``PagedKVRunner``s — the ``prefill_shared`` ZERO-COPY
+    adoption path, where a registered prefix's blocks land directly in
+    the row's table; fleet concurrency comes from replica count, which
+    is the disaggregation story. ``max_batch>1`` switches decode
+    replicas to the pooled iteration scheduler (adoption then rides
+    join-path admissions through the store; batch seeds prefill
+    directly). ``kv_pool_blocks=0`` sizes the pool so every decode row
+    plus growth headroom fits. ``chunk`` is the prefix store alignment
+    width AND the router's affinity-key width — one value by
+    construction, which is the drift the fleet pass guards wire
+    deploys against."""
+    from ..runtime.kv_pool import KVBlockPool
+    from ..serving.app import create_app
+    from ..serving.http import TestClient
+    from ..serving.router import create_router_app
+    from ..serving.tokenizer import ByteTokenizer
+    from ..utils.config import ServingConfig
+    from ..utils.metrics import MetricsRegistry
+    from ..utils.tracing import FlightRecorder
+    from .topology import FleetTopology, ReplicaHandle
+
+    cfg_model, params = demo_model(max_seq)
+    blocks_per_row = -(-max_seq // kv_block_size)
+    if kv_pool_blocks <= 0:
+        # every decode row at full depth + a couple of rows of growth/
+        # registry headroom (watermark admission holds back the rest)
+        kv_pool_blocks = (n_decode * max_batch + 2) * blocks_per_row
+    heads = getattr(cfg_model, "n_kv_head", cfg_model.n_head)
+    pool = KVBlockPool(cfg_model.n_layer, kv_pool_blocks, heads,
+                       kv_block_size, cfg_model.head_dim, max_seq)
+    tokenizer = ByteTokenizer()
+
+    replicas: List[ReplicaHandle] = []
+    registries: Dict[str, object] = {}
+
+    def spawn(name: str, role: str, mb: int, mode: str) -> None:
+        cfg = ServingConfig(
+            model_id=f"graftfleet-{name}", shard_role="coordinator",
+            max_seq=max_seq, boundaries=(1,), max_batch=mb,
+            batch_mode=mode, batch_wait_ms=10.0,
+            kv_pool_blocks=kv_pool_blocks, kv_block_size=kv_block_size,
+            prefix_cache=prefix_cache, prefix_chunk=chunk,
+            fleet_role=role)
+        registry = MetricsRegistry()
+        recorder = FlightRecorder(capacity=recorder_capacity)
+        app = create_app(cfg, model=(cfg_model, params),
+                         tokenizer=tokenizer, registry=registry,
+                         recorder=recorder, kv_pool=pool)
+        registries[name] = registry
+        replicas.append(ReplicaHandle(name=name, role=role,
+                                      client=TestClient(app),
+                                      recorder=recorder, app=app))
+
+    for i in range(n_prefill):
+        spawn(f"prefill{i}", "prefill", 1, "admission")
+    for i in range(n_decode):
+        spawn(f"decode{i}", "decode", max_batch,
+              "iter" if max_batch > 1 else "admission")
+
+    topology = FleetTopology(replicas)
+    router_registry = MetricsRegistry()
+    router_recorder = FlightRecorder(capacity=recorder_capacity)
+    router_app = create_router_app(topology, tokenizer, chunk=chunk,
+                                   registry=router_registry,
+                                   recorder=router_recorder,
+                                   hop_policy=hop_policy)
+    return FleetHarness(client=TestClient(router_app), app=router_app,
+                        topology=topology, pool=pool,
+                        recorder=router_recorder,
+                        registry=router_registry,
+                        registries=registries, chunk=chunk)
+
+
+def build_single(max_seq: int = 128, max_batch: int = 1,
+                 kv_pool_blocks: int = 0, kv_block_size: int = 16,
+                 chunk: int = 16, prefix_cache: int = 8,
+                 recorder_capacity: int = 512):
+    """The single-replica reference path the fleet is pinned
+    byte-equal against: the SAME model weights and serving composition
+    as one decode replica, its own pool, no router. Returns
+    ``(client, recorder, registry)`` like ``tools.graftload.
+    build_demo_app``."""
+    from ..serving.app import create_app
+    from ..serving.http import TestClient
+    from ..serving.tokenizer import ByteTokenizer
+    from ..utils.config import ServingConfig
+    from ..utils.metrics import MetricsRegistry
+    from ..utils.tracing import FlightRecorder
+
+    cfg_model, params = demo_model(max_seq)
+    if kv_pool_blocks <= 0:
+        kv_pool_blocks = (max_batch + 2) * (-(-max_seq // kv_block_size))
+    cfg = ServingConfig(
+        model_id="graftfleet-single", shard_role="coordinator",
+        max_seq=max_seq, boundaries=(1,),
+        max_batch=max_batch,
+        batch_mode="iter" if max_batch > 1 else "admission",
+        batch_wait_ms=10.0, kv_pool_blocks=kv_pool_blocks,
+        kv_block_size=kv_block_size, prefix_cache=prefix_cache,
+        prefix_chunk=chunk)
+    registry = MetricsRegistry()
+    recorder = FlightRecorder(capacity=recorder_capacity)
+    app = create_app(cfg, model=(cfg_model, params),
+                     tokenizer=ByteTokenizer(), registry=registry,
+                     recorder=recorder)
+    return TestClient(app), recorder, registry

@@ -1,0 +1,140 @@
+"""graftfleet topology: declared replica roles + handoff contracts.
+
+ROADMAP item 2: the paper's coordinator-plus-shards topology stops at
+two toy stages; serving millions of users takes a data-parallel FLEET.
+This module declares what that fleet IS — which roles exist, which
+hops connect them, and what crosses each hop — as statically checkable
+contracts (the registration-annotation idiom of ``FAULT_POLICY`` /
+``GUARDED_STATE``), verified by ``tools/graftcheck/fleet.py``:
+
+- **prefill replicas** run prompt prefills and FILL pool blocks: the
+  chunk-aligned prefix states land in the shared pool's content-keyed
+  prefix registry (``BlockAllocator.register_prefix`` via
+  ``PrefixCachingEngine``), where entries hold their own block refs.
+- **decode replicas** ADOPT those blocks zero-copy: a /generate whose
+  prompt prefix is registered references the registry's physical
+  blocks in its own table (``prefill_shared`` — the PR 5 machinery),
+  CoW-copying only the partially-filled frontier block. Transfer
+  across the prefill->decode boundary is BLOCK HANDOFF, never a
+  tensor copy — Helix's placement-over-uniformity argument applied at
+  the replica level (prefill and decode phases get their own
+  replicas, not a uniform split of one).
+- the **router** fronts the fleet (``serving/router.py``): routes by
+  prefix-cache affinity over the registry's OWN content keys
+  (``fleet/affinity.py``), sheds per-replica through the existing
+  429/503 + Retry-After paths, and honors X-Deadline-Ms end-to-end
+  across the extra hop.
+
+The process-local form (``fleet/harness.py``: several ``create_app``
+instances sharing ONE ``KVBlockPool``) is the seeded test/bench
+vehicle; a multi-process fleet shares the pool through a block-device
+service and keeps exactly these roles and hop contracts.
+
+Declarations the fleet pass reads (dict literals on purpose — the
+keys are statically visible, like ``PROFILES``/``SLO_POLICY``):
+
+- ``FLEET_ROLES``: every role a replica may carry. A role literal in
+  fleet code outside this registry is a finding, and a registered role
+  nothing references is stale.
+- ``HANDOFF_POLICY``: one entry per cross-replica hop,
+  ``{hop: (from_role, to_role, what_crosses_and_who_owns_blocks)}``.
+  Every ``_hop(...)`` dispatch in the router must name a declared
+  entry; a declared entry with no live dispatch is stale.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+# THE role vocabulary (tools/graftcheck/fleet.py: fleet-role rule).
+FLEET_ROLES = {
+    "router": "fleet front end: affinity routing, per-replica "
+              "breakers/shedding, deadline propagation, trace stitching",
+    "prefill": "runs prompt prefills and fills shared pool blocks via "
+               "the content-keyed prefix registry (/prefill)",
+    "decode": "serves /generate, adopting registered prefix blocks "
+              "zero-copy via prefill_shared (block handoff, no copy)",
+}
+
+# THE hop contract (tools/graftcheck/fleet.py: undeclared-replica-hop
+# rule — every router dispatch names an entry here; fleet-role checks
+# the endpoint roles). The third field documents block LIFETIME across
+# the hop: what crosses the wire, and who holds which pool refs when.
+HANDOFF_POLICY = {
+    "router->prefill": (
+        "router", "prefill",
+        "only the prompt crosses; the prefill replica fills pool "
+        "blocks and the registry takes its OWN refs (register_prefix) "
+        "— the replica's transient caller refs are released before "
+        "the response, so the hop hands off zero live leases"),
+    "router->decode": (
+        "router", "decode",
+        "only the request crosses; the decode replica adopts "
+        "registered blocks by reference (lookup_prefix caller refs in "
+        "its own table, frontier block CoW'd before first write) and "
+        "frees them at retirement — block handoff, never tensor copy"),
+}
+
+
+@dataclasses.dataclass
+class ReplicaHandle:
+    """One fleet member as the router sees it: a name (the breaker /
+    metric / trace target label), a declared role, a client speaking
+    the serving wire (``serving/http.py`` TestClient in-process; a
+    requests-backed adapter over real sockets), and — in-process only
+    — the replica's FlightRecorder so the router can stitch the
+    replica's span tree into the request's own (/debug/requests shows
+    one tree per request, hop included)."""
+
+    name: str
+    role: str
+    client: object
+    recorder: Optional[object] = None
+    # the replica's own app handle (harness/test introspection only;
+    # the router never touches it)
+    app: Optional[object] = None
+
+
+class FleetTopology:
+    """Validated replica set: at least one decode replica, unique
+    names, every role registered in ``FLEET_ROLES``."""
+
+    def __init__(self, replicas: List[ReplicaHandle]):
+        names = [r.name for r in replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate replica names: {sorted(names)}")
+        for r in replicas:
+            if r.role not in FLEET_ROLES:
+                raise ValueError(
+                    f"replica {r.name!r} carries unregistered role "
+                    f"{r.role!r} (FLEET_ROLES: {sorted(FLEET_ROLES)})")
+            if r.role == "router":
+                raise ValueError(
+                    "the router fronts the topology; it is not a "
+                    "member replica")
+        self.replicas = list(replicas)
+        if not self.decode_replicas:
+            raise ValueError("a fleet needs at least one decode replica "
+                             "(who would serve /generate?)")
+
+    @property
+    def decode_replicas(self) -> List[ReplicaHandle]:
+        return [r for r in self.replicas if r.role == "decode"]
+
+    @property
+    def prefill_replicas(self) -> List[ReplicaHandle]:
+        return [r for r in self.replicas if r.role == "prefill"]
+
+    def by_name(self, name: str) -> ReplicaHandle:
+        for r in self.replicas:
+            if r.name == name:
+                return r
+        raise KeyError(name)
+
+    def describe(self) -> dict:
+        """The /healthz topology block: names by role."""
+        return {
+            "decode": [r.name for r in self.decode_replicas],
+            "prefill": [r.name for r in self.prefill_replicas],
+        }

@@ -22,7 +22,7 @@ Per-run output: throughput-vs-p99 Pareto rows and goodput-under-SLO
 """
 
 from .driver import (Outcome, occupancy_summary, pareto_row,  # noqa: F401
-                     run_load, slo_row, summarize)
+                     run_load, slo_row, summarize, traffic_mix_row)
 from .profiles import (PROFILES, SLO_METRICS, SLO_POLICY,  # noqa: F401
                        SLO_SOURCE_METRICS, WorkloadProfile, profile,
                        slo_for)
@@ -34,5 +34,5 @@ __all__ = [
     "SLO_SOURCE_METRICS", "WorkloadProfile", "arrival_fields",
     "occupancy_summary", "pareto_row", "profile", "run_load",
     "schedule", "schedule_bytes", "shared_prefix", "slo_for",
-    "slo_row", "summarize",
+    "slo_row", "summarize", "traffic_mix_row",
 ]

@@ -48,6 +48,17 @@ OCCUPANCY_SERIES = ("queue_depth", "batch_occupancy",
                     "kv_cache_blocks_in_use", "iter_live_rows",
                     "hop_breaker_open")
 
+# Fault contract (tools/graftcheck faults pass): the driver's one
+# blocking boundary is the in-process client hop it measures through.
+# The wait is bounded by run_load's join WATCHDOG (TimeoutError once
+# ``join_timeout_s`` passes the schedule horizon), and a dead app is a
+# measured outcome (status=-1 row), never a hang or a swallowed fault.
+FAULT_POLICY = {
+    "client.post": ("watchdog", "none",
+                    "run_load join watchdog; failures land as "
+                    "status=-1 outcomes in the report"),
+}
+
 
 @dataclasses.dataclass
 class Outcome:
@@ -381,3 +392,44 @@ def slo_row(report: dict) -> dict:
             "slo", "slo_attainment", "goodput", "goodput_fraction",
             "goodput_rps")
     return {k: report[k] for k in keep}
+
+
+def traffic_mix_row(reports: List[dict]) -> dict:
+    """The measured TRAFFIC-MIX signal (the ROADMAP item-5/6 follow-on
+    AUTO_PLAN continuous mode consumes): one row per (profile,
+    rate_scale) run joining the demand side (offered rate), the value
+    side (goodput under the declared SLOs), and the occupancy the mix
+    induced inside the serving stack (queue depth, batch occupancy,
+    pool blocks — each run's own windowed graftscope reduction). This
+    is exactly the tuple a live re-planner watches to decide the
+    measured optimum flipped: journaled by bench.py as the
+    ``traffic_mix`` row and gated by tools/bench_diff.py
+    (goodput/throughput higher-better, queue depth lower-better)."""
+    rows = []
+    for rep in reports:
+        occ = rep.get("occupancy", {})
+
+        def _mean_of(prefix: str, occ=occ) -> Optional[float]:
+            vals = [v["mean"] for k, v in occ.items()
+                    if k.startswith(prefix)]
+            return (round(sum(vals) / len(vals), 3) if vals else None)
+
+        rows.append({
+            "workload": f"{rep['profile']}_x{rep['rate_scale']:g}"
+                        .replace(".", "p"),
+            "profile": rep["profile"],
+            "rate_scale": rep["rate_scale"],
+            "offered_rps": rep["offered_rps"],
+            "completed": rep["completed"],
+            "throughput_tokens_per_sec":
+                rep["throughput_tokens_per_sec"],
+            "goodput_rps": rep["goodput_rps"],
+            "goodput_fraction": rep["goodput_fraction"],
+            "shed_429": rep["shed_429"],
+            "shed_503": rep["shed_503"],
+            "deadline_misses": rep["deadline_misses"],
+            "mean_queue_depth": _mean_of("queue_depth"),
+            "mean_batch_occupancy": _mean_of("batch_occupancy"),
+            "mean_blocks_in_use": _mean_of("kv_cache_blocks_in_use"),
+        })
+    return {"workloads": rows}

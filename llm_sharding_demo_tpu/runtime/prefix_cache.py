@@ -83,6 +83,18 @@ DONATED_ARGS = {"_extend": (1,)}
 POOL_MOVER_SCOPES = ("PrefixCachingEngine._gather_entry",
                      "PrefixCachingEngine._insert_pool")
 
+# Registry handoff scopes (tools/graftcheck fleet pass): the ONLY
+# functions allowed to touch the allocator's content-keyed registry
+# surface (``lookup_prefix`` / ``register_prefix``) — the prefill ->
+# decode block-handoff boundary. ``_lookup`` takes the adopter-side
+# caller refs (a decode row referencing a prefill replica's blocks),
+# ``_insert_pool`` registers the producer side (the registry takes its
+# own refs). Enumerating the boundary here is what lets graftsan's
+# per-block grant provenance be read as HANDOFF provenance: every
+# cross-replica block lease traces to one of these two declared sites.
+HANDOFF_SCOPES = ("PrefixCachingEngine._lookup",
+                  "PrefixCachingEngine._insert_pool")
+
 # Lock-discipline contract (tools/graftcheck locks pass): the store and
 # its hit/miss counters live under ``_store_lock`` only — ``stats()``
 # (the /healthz read) must never wait out an in-flight generation's

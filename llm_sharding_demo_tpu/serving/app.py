@@ -30,7 +30,8 @@ plus what the reference lacks:
 from __future__ import annotations
 
 import logging
-from typing import List, Optional
+import re
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -86,6 +87,13 @@ class InputIDs(BaseModel):
     input_ids: List[int]
 
 
+class PrefillReq(BaseModel):
+    """graftfleet /prefill body: just the prompt — the prefill replica
+    fills shared pool blocks; block ids never cross the wire."""
+
+    prompt: str
+
+
 class HiddenStates(BaseModel):
     hidden_states: list  # nested [batch, seq, hidden]
 
@@ -118,15 +126,60 @@ class GenerateReq(BaseModel):
     seed: Optional[int] = None
 
 
+# -- request-identity / deadline header parsing (shared by /generate,
+# -- /prefill, and the fleet router — ONE charset and ONE budget bound,
+# -- so a future widening cannot land in one copy and miss the others)
+
+_RID_RE = re.compile(r"[A-Za-z0-9._:-]{1,128}")
+_PROFILE_RE = re.compile(r"[A-Za-z0-9._:-]{1,64}")
+DEADLINE_MS_ERROR = ("X-Deadline-Ms must be an integer millisecond "
+                     "budget in [1, 86400000]")
+
+
+def parse_request_identity(headers: dict) -> Tuple[str, Optional[str]]:
+    """(rid, profile_label): honor a caller's X-Request-ID, mint one
+    otherwise; both values restricted to a safe charset — they are
+    interpolated into log lines, echoed as headers, and query-matched
+    verbatim (the same injection class _escape_label_value fixes for
+    /metrics)."""
+    raw_rid = (headers.get("x-request-id") or "").strip()
+    rid = (raw_rid if _RID_RE.fullmatch(raw_rid)
+           else tracing.new_request_id())
+    raw_prof = (headers.get("x-workload-profile") or "").strip()
+    return rid, (raw_prof if _PROFILE_RE.fullmatch(raw_prof) else None)
+
+
+def parse_deadline_header(headers: dict):
+    """X-Deadline-Ms -> (deadline, dl_ms, error): (None, None, None)
+    when absent, (None, None, msg) on a malformed/out-of-range value
+    (callers answer 400 — this header is an extension, so
+    status-checking clients get the honest signal; parity only binds
+    the reference's own fields)."""
+    raw_dl = (headers.get("x-deadline-ms") or "").strip()
+    if not raw_dl:
+        return None, None, None
+    try:
+        dl_ms = int(raw_dl)
+    except ValueError:
+        dl_ms = 0
+    if not 1 <= dl_ms <= 86_400_000:
+        return None, None, DEADLINE_MS_ERROR
+    return graftfault.Deadline.from_ms(dl_ms), dl_ms, None
+
+
 def create_app(cfg: Optional[ServingConfig] = None,
                model=None, tokenizer=None,
-               registry=None, recorder=None) -> JSONApp:
+               registry=None, recorder=None, kv_pool=None) -> JSONApp:
     """Build the app. ``model=(config, params)`` / ``tokenizer`` injectable
     for tests; by default resolved via ``serving.loader`` / HF-or-byte
     tokenizer. ``registry`` (utils.metrics.MetricsRegistry) and
     ``recorder`` (utils.tracing.FlightRecorder) are likewise injectable —
     tests can assert the app-level series/traces without touching the
-    process-global defaults."""
+    process-global defaults. ``kv_pool`` (a ``runtime.kv_pool.
+    KVBlockPool`` matching this app's engine geometry) makes this
+    replica serve off a SHARED pool instead of building its own — the
+    graftfleet process-local form, where prefill and decode replicas
+    hand blocks off through one allocator's content-keyed registry."""
     cfg = cfg or from_env()
     reg = registry if registry is not None else REGISTRY
     rec = recorder if recorder is not None else tracing.RECORDER
@@ -381,7 +434,14 @@ def create_app(cfg: Optional[ServingConfig] = None,
                 f"stage); this pod sees {len(jax.devices())}")
     runner = None
     spec_runner = None
-    kv_pool = None
+    prefix_runner = None   # closure target for /prefill's role guard
+    # ``kv_pool`` is the (optional) injected shared pool; non-pooled
+    # configurations must not carry one (validated below), and only the
+    # coordinator's local decode path can host it at all
+    if kv_pool is not None and not (cfg.shard_role == "coordinator"
+                                    and cfg.dispatch == "local"):
+        raise ValueError("kv_pool injection applies to the "
+                         "coordinator's local decode path only")
     # What /healthz reports as n_stages: the decode topology actually
     # serving /generate, not just the configured partition — a monitoring
     # read of "3 stages" while an unstaged engine answers requests is the
@@ -479,12 +539,31 @@ def create_app(cfg: Optional[ServingConfig] = None,
         if cfg.kv_pool_blocks > 0:
             # the paged KV block pool (runtime.kv_pool): one ref-counted
             # block store shared by the prefix store and whichever
-            # decode front end serves /generate
-            from ..runtime.kv_pool import KVBlockPool
-            kv_pool = KVBlockPool.for_engine(
-                spec_runner.plain if spec_runner is not None else runner,
-                num_blocks=cfg.kv_pool_blocks,
-                block_size=cfg.kv_block_size)
+            # decode front end serves /generate. An INJECTED pool
+            # (graftfleet) is shared across replica apps — prefill
+            # replicas fill its registry, decode replicas adopt the
+            # blocks zero-copy; geometry is validated against this
+            # app's engine below (PagedKVRunner / PrefixCachingEngine
+            # constructors), same as an owned pool.
+            if kv_pool is not None:
+                eng_ = (spec_runner.plain if spec_runner is not None
+                        else runner)
+                if kv_pool.max_seq != eng_._cache_seq:
+                    raise ValueError(
+                        f"injected kv_pool spans {kv_pool.max_seq} "
+                        f"slots, engine cache is {eng_._cache_seq} — "
+                        "shared-pool replicas must agree on geometry")
+            else:
+                from ..runtime.kv_pool import KVBlockPool
+                kv_pool = KVBlockPool.for_engine(
+                    spec_runner.plain if spec_runner is not None
+                    else runner,
+                    num_blocks=cfg.kv_pool_blocks,
+                    block_size=cfg.kv_block_size)
+        elif kv_pool is not None:
+            raise ValueError("kv_pool injected but KV_POOL_BLOCKS=0 — "
+                             "a silently unused pool would misreport "
+                             "the serving composition")
         prefix_runner = None
         if cfg.prefix_cache > 0:
             # cross-request KV reuse (runtime.prefix_cache): wraps the
@@ -496,8 +575,8 @@ def create_app(cfg: Optional[ServingConfig] = None,
             from ..runtime.prefix_cache import PrefixCachingEngine
             prefix_runner = PrefixCachingEngine(
                 runner, capacity=cfg.prefix_cache,
-                chunk=cfg.prefill_chunk or 64, spec=spec_runner,
-                pool=kv_pool)
+                chunk=cfg.prefix_chunk or cfg.prefill_chunk or 64,
+                spec=spec_runner, pool=kv_pool)
             runner = prefix_runner
         if cfg.max_batch > 1:
             base = (prefix_runner.plain if prefix_runner is not None
@@ -571,6 +650,11 @@ def create_app(cfg: Optional[ServingConfig] = None,
             "tp_decode": cfg.tp_decode,
             "kv_pool_blocks": cfg.kv_pool_blocks,
             "kv_block_size": cfg.kv_block_size,
+            # graftfleet (llm_sharding_demo_tpu/fleet): this replica's
+            # declared role and the prefix-store alignment width the
+            # router's affinity keys must match
+            "fleet_role": cfg.fleet_role,
+            "prefix_chunk": cfg.prefix_chunk,
         }
         if auto_plan_info is not None:
             # how the knobs above were resolved (AUTO_PLAN=1): the
@@ -634,22 +718,7 @@ def create_app(cfg: Optional[ServingConfig] = None,
         X-Workload-Profile label — the view that triages ONE graftload
         workload profile's slow/failed requests out of a mixed run
         (composes with ``errors``/``slowest``)."""
-        try:
-            n = int(query.get("n", "32"))
-        except ValueError:
-            return 422, {"detail": "n must be an integer"}
-        slowest = query.get("slowest", "").lower() in ("1", "true", "yes")
-        errs = query.get("errors", "").lower() in ("1", "true", "yes")
-        prof = query.get("profile") or None
-        return {
-            "serving": _topology(),
-            "capacity": rec.capacity,
-            "recorded": len(rec),
-            "order": "slowest" if slowest else "newest",
-            **({"profile": prof} if prof else {}),
-            "requests": rec.snapshot(n=n, slowest=slowest,
-                                     errors_only=errs, profile=prof),
-        }
+        return tracing.debug_requests_payload(rec, query, _topology())
 
     @app.get("/debug/profile")
     def debug_profile(query: dict):
@@ -670,6 +739,120 @@ def create_app(cfg: Optional[ServingConfig] = None,
             "serving": _topology(),
             **graftscope.snapshot(n=n),
         }
+
+    @app.post("/prefill")
+    def prefill(req: PrefillReq, headers: dict):
+        """graftfleet prefill-replica endpoint: run the prompt's
+        chunk-aligned prefill and FILL shared pool blocks — the walk
+        lands every full-chunk prefix state in the pool's content-keyed
+        registry (``register_prefix``, the registry holding its own
+        refs), where decode replicas adopt it zero-copy via
+        ``prefill_shared``. Nothing but the prompt crosses the hop and
+        nothing but block ids change hands afterward: transfer is
+        block handoff, never a tensor copy (fleet/topology.py
+        HANDOFF_POLICY documents the lifetime rule). Typed sheds ride
+        the same paths as /generate: pool saturation answers 429 +
+        Retry-After, an exhausted X-Deadline-Ms budget 503."""
+        rid, _profile = parse_request_identity(headers)
+        hdrs = {"X-Request-ID": rid}
+
+        def out(body, status=200):
+            return status, body, hdrs
+
+        if cfg.fleet_role != "prefill":
+            return out({"error": "This instance is not a fleet "
+                                 "prefill replica."}, status=400)
+        # the FLEET_ROLE guard in utils.config makes this unreachable
+        # (prefill requires the pool-backed store); belt and braces for
+        # injected-model tests that bypass from_env
+        if prefix_runner is None or kv_pool is None:
+            return out({"error": "prefill replicas need the pool-backed "
+                                 "prefix store (KV_POOL_BLOCKS + "
+                                 "PREFIX_CACHE)"}, status=400)
+        deadline, _dl_ms, dl_err = parse_deadline_header(headers)
+        if dl_err:
+            return out({"error": dl_err}, status=400)
+        trace = tracing.RequestTrace(rid, fleet="prefill")
+
+        def reject(msg: str):
+            # a proper 400, flight-recorded: /prefill is a new
+            # non-parity endpoint, and the router keys its degraded-
+            # warm accounting on the status code — a 200-with-error
+            # body would count as a successful warm
+            trace.labels.update(error=msg)
+            rec.record(trace)
+            return out({"error": msg}, status=400)
+
+        with trace.span("tokenize"):
+            prompt_ids = tokenizer.encode(req.prompt)
+        if not prompt_ids:
+            return reject("prompt tokenized to zero tokens")
+        if len(prompt_ids) >= cfg.max_seq:
+            return reject(f"prompt ({len(prompt_ids)} tokens) leaves "
+                          f"no forward room under max_seq "
+                          f"({cfg.max_seq})")
+        chunk = prefix_runner.chunk
+        m_total = (len(prompt_ids) - 1) // chunk
+        alloc = kv_pool.allocator
+        # admission: a registry fill the pool cannot host is SHED, not
+        # queued — the 429 + Retry-After discipline every fleet hop
+        # shares (the walk itself also degrades gracefully on a full
+        # pool, skipping the insert; this gate sheds before paying the
+        # prefill compute)
+        need = alloc.blocks_for(m_total * chunk)
+        if need:
+            # registered prefixes SHARE blocks (_insert_pool): a warm
+            # repeat fill allocates nothing, and a partial hit only the
+            # new chunks' blocks — gate on that marginal need, or warm
+            # prefills (the replica's whole point) get shed whenever
+            # the pool is busy. has_prefix takes no leases: this walk
+            # is the same key ladder _lookup descends, refs deferred to
+            # the walk itself.
+            arr = np.asarray(prompt_ids, dtype=np.int32)
+            key_of = prefix_runner._key
+            if alloc.has_prefix(key_of(arr, m_total, chunk)):
+                need = 0
+            else:
+                for m in range(m_total - 1, 0, -1):
+                    if alloc.has_prefix(key_of(arr, m, chunk)):
+                        need -= (m * chunk) // kv_pool.block_size
+                        break
+        if need > 0 and alloc.available() < need:
+            reg.inc("kv_pool_admission_rejections_total")
+            hdrs["Retry-After"] = "1"
+            trace.labels.update(error="kv_pool_saturated")
+            rec.record(trace)
+            return out({"error": "kv_pool_saturated",
+                        "detail": "pool cannot host this prefix fill; "
+                                  "retry after the indicated backoff"},
+                       status=429)
+        try:
+            if deadline is not None:
+                deadline.raise_if_expired("prefill")
+            with tracing.use_trace(trace):
+                _logits, _cache, shared_ids, depth = \
+                    prefix_runner.prefill_shared(
+                        np.asarray(prompt_ids, dtype=np.int32))
+            # the walk's caller refs are released immediately: the
+            # REGISTRY holds the entry's own refs, and this endpoint
+            # hands off ids by content key, never by lease
+            alloc.free(shared_ids)
+        except graftfault.Unavailable as e:
+            hdrs["Retry-After"] = str(max(1, int(round(e.retry_after))))
+            if e.code == "deadline_exceeded":
+                reg.inc("deadline_misses_total")
+            trace.labels.update(error=e.code)
+            rec.record(trace)
+            return out({"error": e.code, "detail": str(e)}, status=503)
+        except Exception as e:  # noqa: BLE001 — flight-record + echo id
+            trace.labels.update(error=f"{type(e).__name__}: {e}")
+            rec.record(trace)
+            return out({"detail": f"{type(e).__name__}: {e}"}, status=500)
+        trace.labels.update(registered_tokens=depth)
+        rec.record(trace)
+        return out({"registered_tokens": depth,
+                    "prefix_entries": alloc.prefix_len(),
+                    "chunk": chunk})
 
     @app.post("/forward")
     def forward_a(req: InputIDs):
@@ -769,7 +952,7 @@ def create_app(cfg: Optional[ServingConfig] = None,
     hop_policy = graftfault.HopPolicy(
         attempts=3, timeout_s=30.0, base_backoff_s=0.25,
         max_backoff_s=2.0, breaker_threshold=5, breaker_cooldown_s=5.0,
-        fatal=(UpstreamError,),
+        fatal=(UpstreamError,), registry=reg,
         on_retry=lambda shard, reason: reg.inc(
             "shard_hop_retries_total", stage=shard, reason=reason))
 
@@ -865,26 +1048,12 @@ def create_app(cfg: Optional[ServingConfig] = None,
 
     @app.post("/generate")
     def generate(req: GenerateReq, headers: dict):
-        # Request identity: honor an X-Request-ID the caller sent, mint
-        # one otherwise; every response (errors included) echoes it as a
-        # response header — the BODY stays wire-parity with the
-        # reference ({"generated": ...}, server.py:210). Caller-supplied
-        # ids are restricted to a safe charset: the id is interpolated
-        # into the structured log line and echoed as a header, so a
-        # quote/newline-bearing value would corrupt both (the same
-        # injection class _escape_label_value fixes for /metrics).
-        import re as _re
-        raw_rid = (headers.get("x-request-id") or "").strip()
-        rid = (raw_rid if _re.fullmatch(r"[A-Za-z0-9._:-]{1,128}", raw_rid)
-               else tracing.new_request_id())
-        # Workload-profile label (graftload): callers tag requests with
-        # the profile that generated them so the flight recorder can be
-        # filtered per traffic shape (/debug/requests?profile=...).
-        # Same safe-charset discipline as the request id — the label is
-        # echoed into trace labels and query-matched verbatim.
-        raw_prof = (headers.get("x-workload-profile") or "").strip()
-        profile_label = (raw_prof if _re.fullmatch(r"[A-Za-z0-9._:-]{1,64}",
-                                                   raw_prof) else None)
+        # Request identity: every response (errors included) echoes the
+        # X-Request-ID as a response header — the BODY stays wire-parity
+        # with the reference ({"generated": ...}, server.py:210). The
+        # X-Workload-Profile label (graftload) lets the flight recorder
+        # filter per traffic shape (/debug/requests?profile=...).
+        rid, profile_label = parse_request_identity(headers)
         hdrs = {"X-Request-ID": rid}
 
         def out(body, status=200):
@@ -900,22 +1069,9 @@ def create_app(cfg: Optional[ServingConfig] = None,
         # remaining budget; a row past its deadline is cancelled at the
         # next segment boundary with its blocks freed, and the caller
         # gets a typed 503 + Retry-After instead of a hung connection.
-        raw_dl = (headers.get("x-deadline-ms") or "").strip()
-        deadline = None
-        if raw_dl:
-            try:
-                dl_ms = int(raw_dl)
-            except ValueError:
-                dl_ms = 0
-            if not 1 <= dl_ms <= 86_400_000:
-                # a proper 400, not the reference's 200-with-error wire
-                # quirk: this header is an extension, so status-checking
-                # clients get the honest signal (parity only binds the
-                # reference's own fields)
-                return out({"error": "X-Deadline-Ms must be an integer "
-                            "millisecond budget in [1, 86400000]"},
-                           status=400)
-            deadline = graftfault.Deadline.from_ms(dl_ms)
+        deadline, dl_ms, dl_err = parse_deadline_header(headers)
+        if dl_err:
+            return out({"error": dl_err}, status=400)
         trace = tracing.RequestTrace(rid, mode=req.mode,
                                      dispatch=cfg.dispatch)
         if profile_label is not None:
@@ -967,7 +1123,16 @@ def create_app(cfg: Optional[ServingConfig] = None,
             else:
                 need = kv_pool.allocator.blocks_for(
                     len(prompt_ids) + req.max_new_tokens)
-                ok, retry = kv_pool.allocator.available() >= need, 1.0
+                # seeded pool-exhaustion spike (graftfault): the solo
+                # paged runner's 429 gate sheds exactly as a full pool
+                # would — the fleet router's per-replica shed/fallback
+                # math is testable deterministically (the pooled iter
+                # scheduler has the same site in admission_load)
+                spike = graftfault.inject("serving.admission",
+                                          "pool_spike")
+                ok = (spike is None
+                      and kv_pool.allocator.available() >= need)
+                retry = 1.0
             if not ok:
                 reg.inc("kv_pool_admission_rejections_total")
                 hdrs["Retry-After"] = str(max(1, int(round(retry))))

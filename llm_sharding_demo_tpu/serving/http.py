@@ -148,7 +148,16 @@ class Response:
 
 
 class TestClient:
-    """In-process client running the exact server dispatch path."""
+    """In-process client running the exact server dispatch path.
+
+    ``timeout_s`` exists for wire parity with a socket-backed client
+    (the fleet router derives it from the request's remaining
+    X-Deadline-Ms budget per hop): in-process dispatch is synchronous
+    and bounded by the replica's OWN deadline enforcement — the budget
+    also travels in-band as the X-Deadline-Ms header — so the argument
+    is accepted and unused here, while a requests-backed adapter
+    passes it through as the socket timeout.
+    """
 
     __test__ = False  # not a pytest collection target
 
@@ -156,11 +165,13 @@ class TestClient:
         self.app = app
 
     def get(self, path: str,
-            headers: Optional[Dict[str, str]] = None) -> Response:
+            headers: Optional[Dict[str, str]] = None,
+            timeout_s: Optional[float] = None) -> Response:
         return Response(*self.app.handle("GET", path, None, headers))
 
     def post(self, path: str, json: Any = None,  # noqa: A002
-             headers: Optional[Dict[str, str]] = None) -> Response:
+             headers: Optional[Dict[str, str]] = None,
+             timeout_s: Optional[float] = None) -> Response:
         import json as _json
         return Response(*self.app.handle(
             "POST", path, _json.dumps(json).encode(), headers))

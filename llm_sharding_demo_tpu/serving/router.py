@@ -1,0 +1,542 @@
+"""graftfleet router: prefix-affinity front end for a replica fleet.
+
+The data-parallel generalization of the paper's coordinator (ROADMAP
+item 2): instead of one coordinator driving two toy stage shards, a
+router fronts N replicas split by PHASE — prefill replicas fill shared
+pool blocks, decode replicas adopt them zero-copy through the
+content-keyed prefix registry (``fleet/topology.py`` declares the
+roles and what crosses each hop). Per request the router:
+
+1. **routes by prefix-cache affinity**: the prompt's first-chunk
+   content key — THE registry's own key, ``fleet/affinity.py`` — picks
+   a decode replica off a consistent-hash ring, so requests sharing a
+   cached prefix land where that prefix's blocks are warm. Keyless
+   (short) prompts place by least load.
+2. **warms the registry** through a prefill replica (``/prefill``)
+   when one exists — a failed prefill hop DEGRADES (the decode replica
+   prefills cold; correctness is unaffected, only the reuse win), it
+   never fails the request.
+3. **sheds per-replica**: a 429/503 from the chosen replica is typed
+   backpressure, not death — the router falls over to the
+   least-loaded other decode replica and only returns the shed
+   (Retry-After intact) when every candidate refused. Transport
+   failures ride a per-target ``HopPolicy`` circuit breaker
+   (``hop_breaker_open{target=...}``), so a dead replica fails fast
+   instead of stacking timeouts.
+4. **honors X-Deadline-Ms end-to-end**: every hop's timeout derives
+   from the remaining budget and the decremented budget is forwarded
+   in-band, so the replica's own deadline machinery (queue-wait
+   checks, segment-boundary cancellation) keeps enforcing it past the
+   extra hop.
+5. **stitches traces**: the replica's span tree (fetched from its
+   flight recorder by the propagated X-Request-ID) is grafted under
+   the router's hop span — ``/debug/requests`` here shows ONE tree
+   per request, hop included.
+
+Every cross-replica dispatch goes through ``FleetRouter._hop`` naming
+a declared ``HANDOFF_POLICY`` entry, and the raw client call lives
+only in the ``HOP_SCOPES`` function — both statically enforced by the
+fleet pass (``tools/graftcheck/fleet.py``).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+from ..fleet.affinity import HashRing, affinity_key
+from ..fleet.topology import FleetTopology, ReplicaHandle
+from ..utils import graftfault, graftsched, tracing
+from ..utils.metrics import REGISTRY
+from .app import GenerateReq, parse_deadline_header, parse_request_identity
+from .http import JSONApp
+
+log = logging.getLogger(__name__)
+
+# Lock-discipline contract (tools/graftcheck locks pass): the router's
+# cross-thread state is the per-replica in-flight counters and the
+# affinity accounting — all leaf reads/bumps under ``_lock``; hops and
+# sleeps run OUTSIDE any hold.
+GUARDED_STATE = {"_inflight": "_lock", "affinity_hits": "_lock",
+                 "affinity_fallbacks": "_lock", "sheds": "_lock"}
+LOCK_ORDER = ("_lock",)
+
+# Fault contract (tools/graftcheck faults pass): the router's one
+# blocking boundary is the replica hop. Its per-attempt timeout derives
+# from the request's remaining X-Deadline-Ms budget (also forwarded
+# in-band so the replica keeps enforcing it); retries ride the typed
+# per-target HopPolicy (capped backoff + breaker); failure degrades to
+# least-loaded fallback and ultimately a typed 429/503 + Retry-After.
+FAULT_POLICY = {
+    "client.post": ("request", "hop-policy",
+                    "per-target breaker, least-loaded fallback, typed "
+                    "429/503 + Retry-After"),
+}
+
+# The ONLY scope allowed to speak the replica wire directly (fleet
+# pass, undeclared-replica-hop rule): every other path dispatches
+# through ``_hop``, which names a declared HANDOFF_POLICY entry.
+HOP_SCOPES = ("FleetRouter._attempt",)
+
+
+class _InjectedShed:
+    """What a seeded ``http_503`` injection returns: the response shape
+    of a real replica shed, so the drill drives the caller's typed
+    shed/fallback path (Retry-After honored, breaker untouched) instead
+    of the transport-retry path a real 503 never takes."""
+
+    status_code = 503
+    text = '{"error": "graftfault_injected_503"}'
+
+    def __init__(self):
+        self.headers = {"Retry-After": "1"}
+
+    def json(self):
+        return {"error": "graftfault_injected_503",
+                "detail": "graftfault: injected replica 503"}
+
+
+class ReplicaError(RuntimeError):
+    """A replica hop failed at transport level (exception, or a 5xx
+    that is not typed backpressure) — retried under the HopPolicy and
+    counted against the target's breaker."""
+
+    def __init__(self, target: str, detail: str):
+        super().__init__(f"replica {target}: {detail}")
+        self.target = target
+        self.detail = detail
+
+
+class FleetRouter:
+    """Routing/shedding/stitching state for one fleet topology."""
+
+    def __init__(self, topology: FleetTopology, tokenizer,
+                 chunk: int = 64, registry=None, recorder=None,
+                 hop_policy: Optional[graftfault.HopPolicy] = None):
+        if chunk < 1:
+            raise ValueError("chunk must be >= 1 (the prefix registry's "
+                             "alignment width)")
+        self.topology = topology
+        self.tokenizer = tokenizer
+        self.chunk = chunk
+        self.registry = registry if registry is not None else REGISTRY
+        self.recorder = (recorder if recorder is not None
+                         else tracing.RECORDER)
+        self.ring = HashRing([r.name for r in topology.decode_replicas])
+        # warm traffic spreads across prefill replicas by the SAME
+        # consistent-hash discipline as decode placement (a raw byte of
+        # the content key would not do: affinity keys are little-endian
+        # int32 token bytes, so fixed positions are structurally 0)
+        self.prefill_ring = (
+            HashRing([p.name for p in topology.prefill_replicas])
+            if topology.prefill_replicas else None)
+        # one policy, per-TARGET breakers (HopPolicy keys its breaker
+        # table by the shard= label — here the replica name, which is
+        # also the hop_breaker_open{target=...} series label)
+        self.policy = hop_policy or graftfault.HopPolicy(
+            attempts=2, timeout_s=30.0, base_backoff_s=0.05,
+            max_backoff_s=0.5, breaker_threshold=4,
+            breaker_cooldown_s=2.0,
+            on_retry=lambda target, reason: self.registry.inc(
+                "shard_hop_retries_total", stage=target, reason=reason))
+        if self.policy.registry is None:
+            # breaker gauges must land where this router's /metrics
+            # reads — also for a caller-supplied policy, which would
+            # otherwise fall back to the process-global REGISTRY
+            self.policy.registry = self.registry
+        self._lock = graftsched.lock("router.FleetRouter._lock")
+        self._inflight: Dict[str, int] = {
+            r.name: 0 for r in topology.replicas}
+        self.affinity_hits = 0
+        self.affinity_fallbacks = 0
+        self.sheds = 0
+
+    # -- load accounting ------------------------------------------------------
+
+    def _note_start(self, name: str) -> None:
+        with self._lock:
+            self._inflight[name] += 1
+
+    def _note_done(self, name: str) -> None:
+        with self._lock:
+            self._inflight[name] -= 1
+
+    def inflight(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._inflight)
+
+    def _note_affinity(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self.affinity_hits += 1
+            else:
+                self.affinity_fallbacks += 1
+
+    def _note_shed(self) -> None:
+        with self._lock:
+            self.sheds += 1
+
+    def affinity_stats(self) -> dict:
+        with self._lock:
+            return {"hits": self.affinity_hits,
+                    "fallbacks": self.affinity_fallbacks,
+                    "sheds": self.sheds}
+
+    # -- the hop --------------------------------------------------------------
+
+    def _attempt(self, replica: ReplicaHandle, path: str, payload: dict,
+                 headers: Dict[str, str], timeout_s: float):
+        """THE wire touchpoint (HOP_SCOPES): one POST to one replica.
+        In-process the client ignores ``timeout_s`` (dispatch is
+        synchronous and the in-band X-Deadline-Ms budget is the real
+        bound); a socket-backed client passes it to requests."""
+        client = replica.client
+        resp = client.post(path, json=payload, headers=headers,
+                           timeout_s=timeout_s)
+        if resp.status_code in (500, 502, 504):
+            # an untyped replica failure is a transport-class fault:
+            # retried, breaker-counted. Typed backpressure (429/503)
+            # and request errors (4xx) return to the caller's logic.
+            raise ReplicaError(replica.name,
+                               f"HTTP {resp.status_code}: {resp.text[:120]}")
+        return resp
+
+    def _hop(self, hop: str, replica: ReplicaHandle, path: str,
+             payload: dict, headers: Dict[str, str],
+             deadline: Optional[graftfault.Deadline]):
+        """One declared cross-replica dispatch (``hop`` names the
+        HANDOFF_POLICY entry) through the per-target breaker. Seeded
+        fault injection (site ``router.replica_hop``) lands before the
+        wire call so the retry/fallback path replays deterministically.
+        """
+        fwd = dict(headers)
+
+        def attempt(timeout_s: float):
+            if deadline is not None:
+                # the budget travels IN-BAND: the replica's own deadline
+                # machinery enforces what remains after this hop's
+                # queueing — recomputed PER ATTEMPT, so a retry after a
+                # burned first attempt + backoff forwards the true
+                # remainder, not the stale pre-hop budget
+                fwd["X-Deadline-Ms"] = str(
+                    max(1, int(deadline.remaining() * 1e3)))
+            kind = graftfault.inject("router.replica_hop", "reset",
+                                     "timeout", "http_503", "slow")
+            if kind in ("reset", "timeout"):
+                raise ReplicaError(replica.name,
+                                   f"graftfault: injected hop {kind}")
+            if kind == "http_503":
+                # a replica answering 503 is TYPED backpressure, not a
+                # transport fault: the drill must return it as a
+                # response so the caller's shed/fallback accounting
+                # replays exactly what a real 503 storm drives — not
+                # retries and a breaker open a real 503 never causes
+                return _InjectedShed()
+            if kind == "slow":
+                time.sleep(min(0.02, timeout_s))
+            return self._attempt(replica, path, payload, fwd, timeout_s)
+
+        return self.policy.call(attempt, shard=replica.name,
+                                deadline=deadline)
+
+    # -- placement ------------------------------------------------------------
+
+    def decode_order(self, key: Optional[bytes]) -> List[ReplicaHandle]:
+        """Candidate decode replicas, best first: the affinity-ring
+        owner (when the prompt has a cacheable prefix), then the rest
+        by ascending in-flight load (name-tiebroken so replays are
+        deterministic)."""
+        reps = self.topology.decode_replicas
+        load = self.inflight()
+        by_load = sorted(reps, key=lambda r: (load.get(r.name, 0), r.name))
+        if key is None:
+            return by_load
+        primary = self.ring.pick(key)
+        return ([r for r in reps if r.name == primary]
+                + [r for r in by_load if r.name != primary])
+
+
+def create_router_app(topology: FleetTopology, tokenizer,
+                      chunk: int = 64, registry=None, recorder=None,
+                      hop_policy=None) -> JSONApp:
+    """Build the router's serving surface. ``tokenizer`` must match the
+    replicas' (affinity keys are token-content keys); ``chunk`` must
+    match their prefix stores' alignment width — key drift between the
+    router and the registry is exactly what the fleet pass exists to
+    prevent."""
+    router = FleetRouter(topology, tokenizer, chunk=chunk,
+                         registry=registry, recorder=recorder,
+                         hop_policy=hop_policy)
+    reg = router.registry
+    rec = router.recorder
+    app = JSONApp(title="llm-sharding-demo-tpu-router", version="0.1.0")
+    app.router = router  # harness/test introspection
+
+    @app.get("/metrics")
+    def metrics():
+        return reg.prometheus()
+
+    @app.get("/healthz")
+    def healthz():
+        return {
+            "status": "ok",
+            "role": "router",
+            "replicas": topology.describe(),
+            "chunk": router.chunk,
+            "inflight": router.inflight(),
+            "breakers": {r.name: router.policy.breaker_state(r.name)
+                         for r in topology.replicas},
+            "affinity": router.affinity_stats(),
+        }
+
+    @app.get("/debug/requests")
+    def debug_requests(query: dict):
+        """The router-side flight recorder: one JOINED tree per request
+        (router spans + the replica's grafted subtree). Same filters as
+        the replica view (?n/?slowest/?errors/?profile)."""
+        return tracing.debug_requests_payload(
+            rec, query, {"role": "router",
+                         "replicas": topology.describe()})
+
+    @app.post("/generate")
+    def generate(req: GenerateReq, headers: dict):
+        rid, profile_label = parse_request_identity(headers)
+        fwd = {"X-Request-ID": rid}
+        if profile_label is not None:
+            fwd["X-Workload-Profile"] = profile_label
+        hdrs = {"X-Request-ID": rid}
+
+        def out(body, status=200):
+            return status, body, hdrs
+
+        deadline, _dl_ms, dl_err = parse_deadline_header(headers)
+        if dl_err:
+            return out({"error": dl_err}, status=400)
+
+        trace = tracing.RequestTrace(rid, fleet="router", mode=req.mode)
+        if profile_label is not None:
+            trace.labels.update(profile=profile_label)
+
+        with trace.span("tokenize"):
+            prompt_ids = tokenizer.encode(req.prompt)
+        if not prompt_ids:
+            # reference-parity 200-with-error, but flight-recorded:
+            # unrecorded rejects vanish from /debug/requests and
+            # corrupt the router's accounting
+            trace.labels.update(error="prompt tokenized to zero tokens")
+            rec.record(trace)
+            return out({"error": "prompt tokenized to zero tokens"})
+        key = affinity_key(prompt_ids, router.chunk)
+        body = req.model_dump()
+
+        try:
+            # -- prefill handoff (router->prefill): warm the registry.
+            # Failure DEGRADES — the decode replica prefills cold. A
+            # dead/unreachable replica falls over to the next prefill
+            # replica (the registry is shared, so any of them can
+            # warm); the walk starts at the prefill ring's owner so
+            # warm traffic spreads deterministically across N
+            # replicas. A typed shed does NOT fall over: the pool is
+            # shared, so every prefill replica sees the same
+            # saturation.
+            prefills = topology.prefill_replicas
+            if prefills and key is not None:
+                primary = router.prefill_ring.pick(key)
+                start = next(i for i, p in enumerate(prefills)
+                             if p.name == primary)
+                warmed = False
+                for p in prefills[start:] + prefills[:start]:
+                    t0 = time.perf_counter()
+                    try:
+                        router._note_start(p.name)
+                        try:
+                            resp = router._hop("router->prefill", p,
+                                               "/prefill",
+                                               {"prompt": req.prompt},
+                                               fwd, deadline)
+                        finally:
+                            router._note_done(p.name)
+                    except graftfault.DeadlineExceeded:
+                        raise
+                    except (ReplicaError, graftfault.Unavailable) as e:
+                        log.warning("prefill hop failed on %s: %s",
+                                    p.name, e)
+                        trace.add_span("prefill_hop", t0,
+                                       time.perf_counter(),
+                                       target=p.name,
+                                       degraded=str(e)[:120])
+                        continue
+                    if resp.status_code != 200:
+                        # a typed shed (429/503 kv_pool_saturated) or
+                        # request error is NOT a warm — count it
+                        # degraded so dashboards see the lost reuse
+                        trace.add_span("prefill_hop", t0,
+                                       time.perf_counter(),
+                                       target=p.name,
+                                       degraded=f"http_{resp.status_code}")
+                        break
+                    reg.inc("fleet_requests_total", target=p.name,
+                            role="prefill")
+                    _graft_replica(trace, "prefill_hop", p, rid,
+                                   resp, t0, time.perf_counter())
+                    warmed = True
+                    break
+                if not warmed:
+                    # degraded, not failed: the decode replica
+                    # prefills cold — correctness holds, only the
+                    # reuse win is lost (and counted, once per
+                    # request, so dashboards see it)
+                    reg.inc("fleet_prefill_degraded_total")
+
+            # -- decode handoff (router->decode): affinity target
+            # first, least-loaded fallback on typed sheds or a dead
+            # target's open breaker.
+            order = router.decode_order(key)
+            last_shed = None          # (status, body, Retry-After)
+            last_unavailable = None
+            resp = None
+            target = None
+            for i, r in enumerate(order):
+                if deadline is not None:
+                    deadline.raise_if_expired("route to decode replica")
+                t0 = time.perf_counter()
+                router._note_start(r.name)
+                try:
+                    resp = router._hop("router->decode", r, "/generate",
+                                       body, fwd, deadline)
+                except graftfault.DeadlineExceeded:
+                    raise
+                except (ReplicaError, graftfault.Unavailable) as e:
+                    last_unavailable = e
+                    trace.add_span("decode_hop", t0, time.perf_counter(),
+                                   target=r.name, failed=str(e)[:120])
+                    resp = None
+                    continue
+                finally:
+                    router._note_done(r.name)
+                if resp.status_code in (429, 503):
+                    shed_body = resp.json()
+                    if shed_body.get("error") == "deadline_exceeded":
+                        # the request's OWN budget died on the replica
+                        # — not backpressure: no other replica can save
+                        # it, so falling over would just re-run a
+                        # doomed request n_decode times. Surface it.
+                        hdrs["Retry-After"] = (
+                            resp.headers.get("Retry-After") or "1")
+                        trace.add_span("decode_hop", t0,
+                                       time.perf_counter(),
+                                       target=r.name,
+                                       deadline_exceeded=True)
+                        trace.labels.update(error="deadline_exceeded")
+                        rec.record(trace)
+                        return out(shed_body, status=resp.status_code)
+                    router._note_shed()
+                    reg.inc("fleet_sheds_total", target=r.name,
+                            code=str(resp.status_code))
+                    last_shed = (resp.status_code, shed_body,
+                                 resp.headers.get("Retry-After"))
+                    trace.add_span("decode_hop", t0, time.perf_counter(),
+                                   target=r.name,
+                                   shed=resp.status_code)
+                    resp = None
+                    continue
+                target = r
+                reg.inc("fleet_requests_total", target=r.name,
+                        role="decode")
+                rep_tree = _graft_replica(trace, "decode_hop", r, rid,
+                                          resp, t0, time.perf_counter())
+                # a 4xx or the reference-parity 200-with-error body
+                # completed the route but served no generation: keep it
+                # out of the affinity accounting (bench's gated
+                # affinity_hit_rate must measure routing quality, not
+                # malformed-request volume) and label the trace
+                err = (resp.json().get("error")
+                       if resp.status_code != 200
+                       or "error" in resp.json() else None)
+                if err is not None:
+                    trace.labels.update(error=str(err)[:120])
+                else:
+                    # lift the replica's summary labels onto the
+                    # ROUTER trace: loadgen's trace join (and the
+                    # fleet bench rows built on it) reads ttft_ms/
+                    # new_tokens from the recorder it is handed —
+                    # here, the router's. TTFT is re-based to the
+                    # router clock (router time before the hop plus
+                    # the replica's own first-token latency), which
+                    # is what the client experienced.
+                    rl = (rep_tree or {}).get("labels", {})
+                    if "ttft_ms" in rl:
+                        trace.labels.update(ttft_ms=round(
+                            (t0 - trace.t0) * 1e3
+                            + float(rl["ttft_ms"]), 3))
+                    for lk in ("new_tokens", "prompt_tokens",
+                               "finish_reason"):
+                        if lk in rl:
+                            trace.labels.setdefault(lk, rl[lk])
+                    hit = key is not None and i == 0
+                    router._note_affinity(hit)
+                    if hit:
+                        reg.inc("fleet_affinity_hits_total")
+                    else:
+                        reg.inc("fleet_affinity_fallbacks_total",
+                                reason="no_key" if key is None
+                                else "fallback")
+                break
+
+            if resp is None:
+                # every decode replica refused: surface the TYPED shed
+                # (Retry-After intact) — the fleet being saturated is
+                # backpressure, not an opaque failure
+                if last_shed is not None:
+                    status, payload, retry = last_shed
+                    hdrs["Retry-After"] = retry or "1"
+                    trace.labels.update(error=payload.get(
+                        "error", f"shed_{status}"))
+                    rec.record(trace)
+                    return out(payload, status=status)
+                e = last_unavailable
+                retry = getattr(e, "retry_after", 1.0)
+                hdrs["Retry-After"] = str(max(1, int(round(retry))))
+                trace.labels.update(error="fleet_unavailable")
+                rec.record(trace)
+                return out({"error": "fleet_unavailable",
+                            "detail": str(e)}, status=503)
+        except graftfault.Unavailable as e:
+            hdrs["Retry-After"] = str(max(1, int(round(e.retry_after))))
+            if e.code == "deadline_exceeded":
+                reg.inc("deadline_misses_total")
+            trace.labels.update(error=e.code)
+            rec.record(trace)
+            return out({"error": e.code, "detail": str(e)}, status=503)
+
+        trace.labels.update(target=target.name,
+                            status=resp.status_code)
+        trace.finish()
+        rec.record(trace)
+        payload = resp.json()
+        # pass replica response headers the caller relies on through
+        # (the echoed rid is the router's own)
+        for h in ("Retry-After",):
+            if h in resp.headers:
+                hdrs[h] = resp.headers[h]
+        return out(payload, status=resp.status_code)
+
+    return app
+
+
+def _graft_replica(trace: tracing.RequestTrace, name: str,
+                   replica: ReplicaHandle, rid: str, resp,
+                   t0: float, t1: float) -> Optional[dict]:
+    """Stitch the replica's span tree under a hop span (in-process:
+    the replica's flight recorder is on the handle; a wire deploy
+    would fetch /debug/requests?n=1 by rid). Missing recorder or an
+    evicted ring entry degrade to a bare hop span. Returns the
+    replica's serialized trace so the caller can lift its summary
+    labels (ttft_ms/new_tokens) onto the router trace."""
+    payload = None
+    if replica.recorder is not None:
+        payload = replica.recorder.find(rid)
+    trace.graft(name, payload, t0, t1, target=replica.name,
+                status=resp.status_code)
+    return payload

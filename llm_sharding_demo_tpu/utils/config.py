@@ -102,6 +102,19 @@ class ServingConfig:
     kv_pool_blocks: int = 0
     # Cache slots per pool block; MAX_SEQ must be a multiple of it.
     kv_block_size: int = 16
+    # Prefix-store alignment width (runtime.prefix_cache): >0 overrides
+    # the store's chunk (default: PREFILL_CHUNK, else 64). The fleet
+    # router's affinity keys are content keys at THIS width, so every
+    # replica and the router must agree on it — which is why it is a
+    # first-class knob instead of an incidental default. 0 = default.
+    prefix_chunk: int = 0
+    # graftfleet role (llm_sharding_demo_tpu/fleet): "" serves
+    # standalone; "prefill" serves /prefill (fills shared pool blocks
+    # via the content-keyed prefix registry); "decode" serves /generate
+    # adopting registered blocks zero-copy. Both fleet roles require
+    # the pool-backed prefix store (KV_POOL_BLOCKS + PREFIX_CACHE) —
+    # the registry IS the handoff medium.
+    fleet_role: str = ""
     # Auto-sharding planner (tools/graftcheck/costmodel): AUTO_PLAN=1
     # resolves the decode topology/batching/KV knobs at startup by
     # running the compile-free planner over the loaded model config and
@@ -166,6 +179,31 @@ class ServingConfig:
         if self.kv_block_size < 1:
             raise ValueError(
                 f"KV_BLOCK_SIZE={self.kv_block_size} must be >= 1")
+        if self.prefix_chunk < 0:
+            raise ValueError(
+                f"PREFIX_CHUNK={self.prefix_chunk} must be >= 0 "
+                "(0: default alignment, >0: the store's chunk width)")
+        if self.prefix_chunk > 0 and self.prefix_cache == 0:
+            raise ValueError(
+                "PREFIX_CHUNK tunes the prefix store's alignment; it "
+                "needs PREFIX_CACHE > 0 (a silently ignored knob would "
+                "misreport the serving composition)")
+        if self.fleet_role not in ("", "prefill", "decode"):
+            raise ValueError(
+                f"FLEET_ROLE={self.fleet_role!r} not ''|prefill|decode")
+        if self.fleet_role:
+            if not (self.shard_role == "coordinator"
+                    and self.dispatch == "local"):
+                raise ValueError(
+                    "FLEET_ROLE applies to coordinator + local dispatch "
+                    "replicas (the fleet router fronts whole replicas, "
+                    "not stage shards)")
+            if self.kv_pool_blocks == 0 or self.prefix_cache == 0:
+                raise ValueError(
+                    f"FLEET_ROLE={self.fleet_role!r} requires the "
+                    "pool-backed prefix store (KV_POOL_BLOCKS > 0 and "
+                    "PREFIX_CACHE > 0): the content-keyed registry is "
+                    "the prefill->decode block-handoff medium")
         if self.kv_pool_blocks > 0 and self.max_seq % self.kv_block_size:
             raise ValueError(
                 f"MAX_SEQ={self.max_seq} must be a multiple of "
@@ -254,6 +292,8 @@ def from_env() -> ServingConfig:
         batch_mode=os.environ.get("BATCH_MODE", "admission"),
         kv_pool_blocks=_env_int("KV_POOL_BLOCKS", 0),
         kv_block_size=_env_int("KV_BLOCK_SIZE", 16),
+        prefix_chunk=_env_int("PREFIX_CHUNK", 0),
+        fleet_role=os.environ.get("FLEET_ROLE", ""),
         auto_plan=_env_bool("AUTO_PLAN"),
         auto_plan_traffic=os.environ.get("AUTO_PLAN_TRAFFIC", ""),
     )

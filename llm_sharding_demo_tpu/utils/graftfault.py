@@ -370,13 +370,25 @@ def inject(site: str, *kinds: str) -> Optional[str]:
 # -- the hop policy -----------------------------------------------------------
 
 
-def _sample_breaker(shard: str, value: float) -> None:
-    """One ``hop_breaker_open`` occupancy point (graftscope series) on
-    a breaker state TRANSITION — 1.0 at open, 0.0 when a probe closes
-    it. Lazy import: graftscope is pure measurement apparatus and this
-    module must stay importable without it mid-bootstrap."""
+def _sample_breaker(target: str, value: float, registry=None) -> None:
+    """One ``hop_breaker_open`` point per breaker state TRANSITION —
+    1.0 at open, 0.0 when a probe closes it — labeled per TARGET: a
+    HopPolicy keys one breaker per downstream (the coordinator's stage
+    shards; the fleet router's N replicas, one breaker each), and an
+    unlabeled gauge would collapse the fleet's breakers into one
+    indistinguishable series. Emitted BOTH as a registry gauge (the
+    scrapeable /metrics form — registered in METRIC_CATALOG, so the
+    metric-catalog rule covers the labeled emission; the policy owner's
+    injected registry when it has one, else the process default, so an
+    app serving its own /metrics sees its own breakers) and as a
+    graftscope occupancy point (the /debug/profile timeline a
+    graftload run reduces). Lazy imports: this module must stay
+    importable mid-bootstrap without the measurement apparatus."""
     from . import graftscope
-    graftscope.sample("hop_breaker_open", value, shard=shard)
+    from .metrics import REGISTRY
+    (REGISTRY if registry is None else registry).gauge(
+        "hop_breaker_open", value, target=target)
+    graftscope.sample("hop_breaker_open", value, target=target)
 
 
 @dataclasses.dataclass
@@ -413,9 +425,13 @@ class HopPolicy:
                  breaker_threshold: int = 5,
                  breaker_cooldown_s: float = 5.0,
                  jitter_seed: int = 0, fatal: Tuple[type, ...] = (),
-                 on_retry=None, sleep=time.sleep):
+                 on_retry=None, sleep=time.sleep, registry=None):
         if attempts < 1:
             raise ValueError("attempts must be >= 1")
+        # breaker gauges land here (None = the process REGISTRY); an
+        # app built around an injected MetricsRegistry passes its own
+        # so its /metrics shows its own breakers
+        self.registry = registry
         self.attempts = attempts
         self.timeout_s = float(timeout_s)
         self.base_backoff_s = float(base_backoff_s)
@@ -470,7 +486,7 @@ class HopPolicy:
                 # a concurrent open/close pair can never land its
                 # points in inverted order (a cheap ring append, not a
                 # blocking call — the blocking-under-lock class).
-                _sample_breaker(shard, 1.0)
+                _sample_breaker(shard, 1.0, self.registry)
         return opened
 
     def _note_success(self, shard: str) -> None:
@@ -479,7 +495,7 @@ class HopPolicy:
                         and self._breakers[shard].opened_at is not None)
             self._breakers[shard] = _Breaker()   # fully closed
             if was_open:
-                _sample_breaker(shard, 0.0)      # probe closed it
+                _sample_breaker(shard, 0.0, self.registry)  # probe closed it
 
     def _probe_release(self, shard: str) -> None:
         """Clear a HALF-OPEN probe claim that ended without a verdict

@@ -115,11 +115,26 @@ METRIC_CATALOG: Dict[str, str] = {
     "deadline_misses_total": "counter",
     # live-state gauges
     "queue_depth": "gauge",                 # waiting requests per scheduler
-    # per-shard circuit-breaker state (graftfault HopPolicy): 1 while a
-    # shard's breaker is OPEN, 0 when a probe closes it — sampled into
-    # the graftscope occupancy series on transitions, so a graftload
-    # run sees breaker flaps on the same timeline as queue depth
+    # per-TARGET circuit-breaker state (graftfault HopPolicy): 1 while
+    # that downstream's breaker is OPEN, 0 when a probe closes it. The
+    # target label names the breaker's downstream — a stage shard on
+    # the coordinator, a replica name on the fleet router (N
+    # downstreams, one breaker and one labeled series each). Emitted
+    # as a REGISTRY gauge AND sampled into the graftscope occupancy
+    # series on transitions, so a graftload run sees breaker flaps on
+    # the same timeline as queue depth.
     "hop_breaker_open": "gauge",
+    # graftfleet router (serving/router.py): request routing per
+    # target/role, affinity accounting (ring-owner routes vs fallback
+    # placements), typed per-replica sheds encountered walking the
+    # candidate list (whether fallback absorbed them or the shed was
+    # surfaced), and prefill hops that degraded to a cold decode-side
+    # prefill
+    "fleet_requests_total": "counter",
+    "fleet_affinity_hits_total": "counter",
+    "fleet_affinity_fallbacks_total": "counter",
+    "fleet_sheds_total": "counter",
+    "fleet_prefill_degraded_total": "counter",
     "batch_occupancy": "gauge",             # live rows / compiled width
     "iter_live_rows": "gauge",              # live iterbatch rows
     # KV memory in BLOCK denomination, labeled by the writer component

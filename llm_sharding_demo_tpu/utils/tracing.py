@@ -266,6 +266,27 @@ class RequestTrace(_TraceSink):
             walk(self.spans)
         return out
 
+    def graft(self, name: str, payload: Optional[dict], t0: float,
+              t1: float, **labels) -> Span:
+        """Join a downstream replica's serialized trace (its
+        ``to_dict`` payload, fetched by the propagated X-Request-ID)
+        into THIS trace as a hop span over ``[t0, t1)`` whose children
+        are the replica's own spans — the fleet router's cross-replica
+        stitch, so ``/debug/requests`` shows ONE tree per request with
+        the hop visible. The replica's relative timeline is re-based
+        onto the hop start (same-process clocks in the harness; across
+        real processes the skew is the hop's queueing, which is
+        exactly what the offset shows). ``payload=None`` (recorder
+        missing, ring entry evicted) degrades to a bare hop span."""
+        hop = Span(name, t0, t1, labels=labels)
+        if payload is not None:
+            hop.labels.setdefault("replica_request_id",
+                                  payload.get("request_id"))
+            hop.children = [span_from_dict(c, t0)
+                            for c in payload.get("spans", ())]
+        self._commit(hop)
+        return hop
+
     def to_dict(self) -> dict:
         with self._lock:
             spans = [s.to_dict(self.t0) for s in self.spans]
@@ -276,6 +297,20 @@ class RequestTrace(_TraceSink):
         if self.labels:
             d["labels"] = dict(self.labels)
         return d
+
+
+def span_from_dict(d: dict, base: float) -> Span:
+    """Rebuild a serialized span (a ``Span.to_dict`` payload) as a live
+    Span re-based onto ``base`` (a local perf_counter instant) — the
+    cross-replica stitch's unit: a downstream replica's relative-ms
+    timeline becomes spans on THIS process's clock, child shape
+    preserved."""
+    t0 = base + d.get("start_ms", 0.0) / 1e3
+    s = Span(d.get("name", "?"), t0,
+             t0 + d.get("duration_ms", 0.0) / 1e3,
+             labels=d.get("labels"))
+    s.children = [span_from_dict(c, base) for c in d.get("spans", ())]
+    return s
 
 
 class _FanoutTrace(_TraceSink):
@@ -373,6 +408,18 @@ class FlightRecorder:
         with self._lock:
             return len(self._traces)
 
+    def find(self, request_id: str) -> Optional[dict]:
+        """Newest recorded trace with this X-Request-ID as a JSON
+        timeline, or None — the join point the fleet router stitches
+        replica span trees through (newest wins on rid reuse, same as
+        the graftload TTFT join)."""
+        with self._lock:
+            traces = list(self._traces)
+        for t in reversed(traces):
+            if t.request_id == request_id:
+                return t.to_dict()
+        return None
+
     def snapshot(self, n: Optional[int] = None, slowest: bool = False,
                  errors_only: bool = False,
                  profile: Optional[str] = None) -> List[dict]:
@@ -396,6 +443,32 @@ class FlightRecorder:
         if n is not None:
             traces = traces[:max(n, 0)]
         return [t.to_dict() for t in traces]
+
+
+def debug_requests_payload(recorder: FlightRecorder, query: dict,
+                           serving: dict):
+    """The ``/debug/requests`` response body (?n/?slowest/?errors/
+    ?profile) — ONE implementation shared by the replica surface
+    (serving/app.py) and the fleet router (serving/router.py), so a
+    new query filter cannot land on one debug surface and silently
+    desynchronize the other. ``serving`` is the per-app identity
+    block. Returns ``(422, detail)`` on an unparseable ``n``."""
+    try:
+        n = int(query.get("n", "32"))
+    except ValueError:
+        return 422, {"detail": "n must be an integer"}
+    slowest = query.get("slowest", "").lower() in ("1", "true", "yes")
+    errs = query.get("errors", "").lower() in ("1", "true", "yes")
+    prof = query.get("profile") or None
+    return {
+        "serving": serving,
+        "capacity": recorder.capacity,
+        "recorded": len(recorder),
+        "order": "slowest" if slowest else "newest",
+        **({"profile": prof} if prof else {}),
+        "requests": recorder.snapshot(n=n, slowest=slowest,
+                                      errors_only=errs, profile=prof),
+    }
 
 
 # process-wide default recorder (what serving.app uses; injectable there)

@@ -78,6 +78,7 @@ _QUICK_MODULES = {
     "test_graftfault",      # fault contracts + seeded injection + deadlines
     "test_graftscope",      # device-time attribution + bench_diff gate
     "test_graftload",       # open-loop load harness + declared SLOs
+    "test_graftfleet",      # disaggregated fleet: router, handoff, pass
 }
 
 

@@ -143,6 +143,31 @@ def test_repo_passes_graftcheck():
         "llm_sharding_demo_tpu/loadgen/profiles.py", 0) >= 5, (
         "loadgen/profiles.py: the SLO_POLICY contract no longer "
         "matches the registered PROFILES")
+    assert payload["fleet_checks"] >= 10, (
+        "graftfleet fleet pass went vacuous — a new fleet-role / "
+        "undeclared-replica-hop / handoff-provenance / "
+        "affinity-key-drift finding anywhere in the tree fails this "
+        "strict run (rule fixtures in tests/test_graftfleet.py)")
+    assert payload["fleet_vacuous"] == [], (
+        "fleet contract declarations matching nothing live: "
+        f"{payload['fleet_vacuous']}")
+    # the declared topology is LIVE: both hops dispatched, the router's
+    # wire scope real, the adoption boundary enumerated, the affinity
+    # key derived from the registry's own derivation
+    fpol2 = payload["fleet_policies"]
+    assert fpol2.get("llm_sharding_demo_tpu/fleet/topology.py", 0) >= 2, (
+        "fleet/topology.py: HANDOFF_POLICY no longer matches the "
+        "router's live _hop dispatches")
+    assert fpol2.get("llm_sharding_demo_tpu/serving/router.py", 0) >= 1, (
+        "serving/router.py: HOP_SCOPES no longer matches any replica "
+        "wire call")
+    assert fpol2.get(
+        "llm_sharding_demo_tpu/runtime/prefix_cache.py", 0) >= 2, (
+        "runtime/prefix_cache.py: HANDOFF_SCOPES no longer matches the "
+        "registry surface (lookup_prefix/register_prefix sites moved)")
+    assert fpol2.get("llm_sharding_demo_tpu/fleet/affinity.py", 0) >= 1, (
+        "fleet/affinity.py: the affinity key is no longer derived from "
+        "the declared AFFINITY_KEY_SOURCE")
     assert payload["suppressed"] >= 1, (
         "the documented sync points should be baselined findings — an "
         "empty suppression set means the host-sync rule stopped seeing "

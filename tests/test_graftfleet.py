@@ -1,0 +1,813 @@
+"""graftfleet in-suite driver (ISSUE 12 tentpole).
+
+Four layers of pinning:
+
+1. **the acceptance run**: a seeded 2-replica fleet (router + 1
+   prefill + 2 decode replicas sharing ONE pool) driven by the
+   graftload ``bursty_chat`` profile under GRAFTSAN=1 GRAFTSCHED=1
+   GRAFTFAULT=1 — per-request outputs byte-equal to the
+   single-replica path, every non-200 a typed 429/503 + Retry-After,
+   pool conservation at /healthz mid-run, zero sanitizer/race/leak
+   findings;
+2. **routing/shedding math**: prefix-affinity placement over the
+   registry's own content keys (shared prefixes co-locate, keyless
+   prompts place by load), least-loaded fallback under seeded
+   ``FaultPlan`` pool spikes with affinity/shed accounting pinned
+   replay-identical, per-target breakers labeled in
+   ``hop_breaker_open{target=...}``, X-Deadline-Ms honored across the
+   extra hop;
+3. **trace stitching**: the router joins its hop spans with the
+   replica's span tree by the propagated X-Request-ID — ONE tree per
+   request at the router's /debug/requests;
+4. **the fleet static pass** (tools/graftcheck/fleet.py): rule
+   fixtures (fleet-role, undeclared-replica-hop, handoff-provenance,
+   affinity-key-drift, stale/vacuous declarations) each produce
+   findings with file:line, and the repo itself passes non-vacuously
+   (asserted by tests/test_graftcheck.py's strict driver).
+
+Satellites pinned here too: the ``traffic_mix`` journal row shape and
+its bench_diff classification, and the labeled breaker's
+METRIC_CATALOG registration.
+"""
+
+import glob
+import os
+import textwrap
+import threading
+import time
+
+import pytest
+
+from llm_sharding_demo_tpu import loadgen
+from llm_sharding_demo_tpu.fleet import (FLEET_ROLES, HANDOFF_POLICY,
+                                         FleetTopology, HashRing,
+                                         ReplicaHandle, affinity_key,
+                                         build_fleet, build_single)
+from llm_sharding_demo_tpu.utils import graftfault
+from llm_sharding_demo_tpu.utils.metrics import METRIC_CATALOG
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    """One shared plain fleet (no fault plan, no env harnesses) for
+    the routing/stitching/telemetry tests — the jitted programs are
+    the expensive part and these tests all drive the same geometry."""
+    return build_fleet(n_decode=2, n_prefill=1)
+
+
+def _gen(client, prompt, deadline_ms=None, rid=None, max_new=8,
+         mode="greedy", seed=None):
+    body = {"prompt": prompt, "max_new_tokens": max_new, "mode": mode}
+    if seed is not None:
+        body["seed"] = seed
+    headers = {}
+    if deadline_ms is not None:
+        headers["X-Deadline-Ms"] = str(deadline_ms)
+    if rid is not None:
+        headers["X-Request-ID"] = rid
+    return client.post("/generate", json=body, headers=headers)
+
+
+# -- 1. topology + affinity units --------------------------------------------
+
+
+def test_topology_validates_roles_and_decode_presence():
+    def handle(name, role):
+        return ReplicaHandle(name=name, role=role, client=object())
+
+    with pytest.raises(ValueError, match="duplicate replica names"):
+        FleetTopology([handle("a", "decode"), handle("a", "decode")])
+    with pytest.raises(ValueError, match="unregistered role"):
+        FleetTopology([handle("a", "warmup")])
+    with pytest.raises(ValueError, match="not a member replica"):
+        FleetTopology([handle("a", "router")])
+    with pytest.raises(ValueError, match="at least one decode"):
+        FleetTopology([handle("a", "prefill")])
+    topo = FleetTopology([handle("p0", "prefill"),
+                          handle("d0", "decode"),
+                          handle("d1", "decode")])
+    assert topo.describe() == {"decode": ["d0", "d1"],
+                               "prefill": ["p0"]}
+    # every HANDOFF_POLICY endpoint is a registered role — the same
+    # completeness the fleet pass enforces statically
+    for hop, (src, dst, doc) in HANDOFF_POLICY.items():
+        assert src in FLEET_ROLES and dst in FLEET_ROLES, hop
+        assert len(doc) > 20, f"{hop}: lifetime rule must be documented"
+
+
+def test_affinity_key_is_the_registry_key_and_floors_short_prompts():
+    import numpy as np
+
+    from llm_sharding_demo_tpu.runtime.prefix_cache import \
+        PrefixCachingEngine
+
+    ids = list(range(40))
+    got = affinity_key(ids, chunk=16)
+    want = PrefixCachingEngine._key(
+        np.asarray(ids, dtype=np.int32), 1, 16)
+    assert got == want
+    # same first chunk, different tail -> same key (the co-location
+    # property); different first chunk -> different key
+    assert affinity_key(ids[:16] + [99] * 10, chunk=16) == got
+    assert affinity_key([7] * 40, chunk=16) != got
+    # prompts with no cacheable prefix (m_max < 1) have no key: 16
+    # tokens leave nothing to forward past the chunk boundary
+    assert affinity_key(ids[:16], chunk=16) is None
+    assert affinity_key([1, 2, 3], chunk=16) is None
+
+
+def test_hash_ring_is_stable_and_consistent():
+    names = ["decode0", "decode1", "decode2"]
+    keys = [f"key-{i}".encode() for i in range(200)]
+    a = HashRing(names)
+    b = HashRing(names)
+    owners = [a.pick(k) for k in keys]
+    # process-independent (sha256, not builtin hash): two rings agree
+    assert owners == [b.pick(k) for k in keys]
+    assert set(owners) == set(names), "ring must spread keys"
+    # consistency: dropping one replica remaps ONLY that replica's arc
+    shrunk = HashRing(["decode0", "decode1"])
+    moved = sum(1 for k, o in zip(keys, owners)
+                if o != "decode2" and shrunk.pick(k) != o)
+    assert moved == 0, ("removing decode2 must not remap keys owned "
+                        "by surviving replicas")
+
+
+def test_config_fleet_role_validation():
+    from llm_sharding_demo_tpu.utils.config import ServingConfig
+
+    def cfg(**kw):
+        base = dict(model_id="m", shard_role="coordinator",
+                    boundaries=(1,))
+        base.update(kw)
+        return ServingConfig(**base)
+
+    with pytest.raises(ValueError, match="not ''\\|prefill\\|decode"):
+        cfg(fleet_role="warmup", kv_pool_blocks=8, prefix_cache=4)
+    with pytest.raises(ValueError, match="pool-backed prefix store"):
+        cfg(fleet_role="prefill")                 # no pool, no store
+    with pytest.raises(ValueError, match="pool-backed prefix store"):
+        cfg(fleet_role="decode", kv_pool_blocks=8)  # pool, no store
+    with pytest.raises(ValueError, match="PREFIX_CHUNK"):
+        cfg(prefix_chunk=16)                      # knob with no store
+    ok = cfg(fleet_role="decode", kv_pool_blocks=8, prefix_cache=4,
+             prefix_chunk=16)
+    assert ok.fleet_role == "decode" and ok.prefix_chunk == 16
+
+
+# -- 2. routing, shedding, deadline, breaker ----------------------------------
+
+
+def test_affinity_routes_shared_prefixes_to_ring_owner(fleet):
+    """Prompts sharing a first-chunk prefix land on the consistent-hash
+    owner of THE registry's content key; the counters account hits."""
+    shared = "system: fleet affinity test prompt prefix."   # > chunk
+    before = fleet.registry.snapshot()
+    targets = set()
+    for tail in (" alpha", " beta", " gamma"):
+        r = _gen(fleet.client, shared + tail)
+        assert r.status_code == 200
+        t = fleet.recorder.find(r.headers["X-Request-ID"])
+        hops = [s for s in t["spans"] if s["name"] == "decode_hop"]
+        assert len(hops) == 1
+        targets.add(hops[0]["labels"]["target"])
+    assert len(targets) == 1, "shared prefix must co-locate"
+    ids = [ord(c) for c in (shared + " alpha")]  # ByteTokenizer is ord()
+    want = fleet.app.router.ring.pick(affinity_key(ids, fleet.chunk))
+    assert targets == {want}
+    after = fleet.registry.snapshot()
+    assert (after.get("fleet_affinity_hits_total", 0)
+            - before.get("fleet_affinity_hits_total", 0)) == 3
+
+
+def test_keyless_prompts_place_by_least_load(fleet):
+    """A prompt too short for any cacheable prefix has no affinity key
+    and places by ascending in-flight load (deterministic tiebreak)."""
+    r = _gen(fleet.client, "hi")
+    assert r.status_code == 200
+    order = fleet.app.router.decode_order(None)
+    assert [h.name for h in order] == sorted(h.name for h in order)
+    t = fleet.recorder.find(r.headers["X-Request-ID"])
+    hop = [s for s in t["spans"] if s["name"] == "decode_hop"][0]
+    assert hop["labels"]["target"] == order[0].name
+
+
+def test_seeded_pool_spike_falls_back_least_loaded_and_pins_accounting():
+    """Satellite: router shedding math under per-replica 429 storms
+    with seeded FaultPlan pool spikes — affinity hit-rate and shed
+    accounting replay-identical per seed."""
+    shared = "system: seeded shed accounting prompt prefix!"
+    runs = []
+    for _ in range(2):
+        f = build_fleet(n_decode=2, n_prefill=1)
+        plan = graftfault.FaultPlan(seed=5, rate=1.0,
+                                    sites={"serving.admission"},
+                                    kinds={"pool_spike"},
+                                    max_injections=1)
+        with graftfault.use(plan):
+            r = _gen(f.client, shared + " tail-0")
+        assert r.status_code == 200, (r.status_code, r.json())
+        stats = f.app.router.affinity_stats()
+        # the affinity owner shed (the one injected spike), the other
+        # decode replica absorbed the request
+        assert stats == {"hits": 0, "fallbacks": 1, "sheds": 1}
+        ids = [ord(c) for c in (shared + " tail-0")]
+        owner = f.app.router.ring.pick(affinity_key(ids, f.chunk))
+        snap = f.registry.snapshot()
+        shed_keys = [k for k in snap
+                     if k.startswith("fleet_sheds_total")]
+        assert shed_keys and all(f'target={owner}' in k
+                                 for k in shed_keys)
+        served = [k for k in snap
+                  if k.startswith("fleet_requests_total")
+                  and 'role=decode' in k]
+        assert len(served) == 1 and f'target={owner}' not in served[0]
+        runs.append((r.json(), stats, sorted(snap)))
+    assert runs[0] == runs[1], "seeded shed accounting must replay"
+
+
+def test_429_storm_surfaces_typed_shed_with_retry_after():
+    """When EVERY decode replica refuses, the router surfaces the
+    typed shed (Retry-After intact), not an opaque failure."""
+    f = build_fleet(n_decode=2, n_prefill=1)
+    plan = graftfault.FaultPlan(seed=1, rate=1.0,
+                                sites={"serving.admission"},
+                                kinds={"pool_spike"})
+    with graftfault.use(plan):
+        r = _gen(f.client, "system: storm test prompt, long enough "
+                           "to carry an affinity key.")
+    assert r.status_code == 429
+    assert r.json()["error"] == "kv_pool_saturated"
+    assert int(r.headers["Retry-After"]) >= 1
+    # one shed per decode replica: the router walked the whole
+    # candidate list before surfacing backpressure
+    assert f.app.router.affinity_stats()["sheds"] == 2
+
+
+def test_deadline_propagates_across_the_router_hop():
+    f = build_fleet(n_decode=2, n_prefill=1)
+    r = _gen(f.client, "system: deadline propagation test prompt!!",
+             deadline_ms=1)
+    assert r.status_code == 503
+    assert "deadline" in r.json()["error"]
+    assert int(r.headers["Retry-After"]) >= 1
+
+
+def test_replica_deadline_death_fast_fails_without_fallback():
+    """A 503 whose body is the request's OWN deadline death is not
+    backpressure: no other replica can save it, so the router surfaces
+    it immediately instead of re-running the doomed request (and
+    inflating shed counters) on every other decode replica."""
+    f = build_fleet(n_decode=2, n_prefill=0)
+    calls = []
+
+    class _DeadlineDead:
+        status_code = 503
+        headers = {"Retry-After": "1"}
+
+        def json(self):
+            return {"error": "deadline_exceeded",
+                    "detail": "budget burned mid-decode"}
+
+    class _Client:
+        def __init__(self, name):
+            self._name = name
+
+        def post(self, *a, **k):
+            calls.append(self._name)
+            return _DeadlineDead()
+
+    for rep in f.topology.decode_replicas:
+        rep.client = _Client(rep.name)
+    before = f.app.router.affinity_stats()["sheds"]
+    r = _gen(f.client, "system: doomed deadline prompt, long enough!",
+             rid="fleet-dl-fastfail")
+    assert r.status_code == 503
+    assert r.json()["error"] == "deadline_exceeded"
+    assert int(r.headers["Retry-After"]) >= 1
+    assert len(calls) == 1, f"no fallback re-run, got {calls}"
+    assert f.app.router.affinity_stats()["sheds"] == before
+    tree = [t for t in f.client.get("/debug/requests?n=4")
+            .json()["requests"] if t["request_id"] == "fleet-dl-fastfail"]
+    assert tree and tree[0]["labels"]["error"] == "deadline_exceeded"
+
+
+def test_error_body_completes_route_without_affinity_accounting(fleet):
+    """A reference-parity 200-with-error body (bad request shape)
+    completes the route but stays OUT of the hit/fallback accounting
+    bench's gated affinity_hit_rate is computed from — malformed
+    request volume must not mask a routing regression."""
+    before = fleet.app.router.affinity_stats()
+    r = _gen(fleet.client, "system: unknown-mode affinity test!!!!!",
+             rid="fleet-err-body", mode="beam")
+    assert r.status_code == 200
+    assert "unknown mode" in r.json()["error"]
+    assert fleet.app.router.affinity_stats() == before
+    tree = [t for t in fleet.client.get("/debug/requests?n=8")
+            .json()["requests"] if t["request_id"] == "fleet-err-body"]
+    assert tree and "unknown mode" in tree[0]["labels"]["error"]
+
+
+def test_zero_token_reject_is_flight_recorded(fleet):
+    """The router's parity 200-with-error reject for empty prompts is
+    still flight-recorded — unrecorded rejects vanish from
+    /debug/requests and corrupt the router's accounting."""
+    r = fleet.client.post("/generate",
+                          json={"prompt": "", "max_new_tokens": 4},
+                          headers={"X-Request-ID": "fleet-empty-0"})
+    assert r.json()["error"] == "prompt tokenized to zero tokens"
+    mine = [t for t in fleet.client.get("/debug/requests?n=8")
+            .json()["requests"] if t["request_id"] == "fleet-empty-0"]
+    assert len(mine) == 1
+    assert mine[0]["labels"]["error"]
+
+
+def test_dead_prefill_replica_fails_over_to_healthy_one():
+    """Transport-dead prefill replicas fall over to the next one (the
+    registry is shared, so any prefill replica can warm); the degraded
+    counter moves only when NO replica warmed — once per request, not
+    per attempt."""
+    from llm_sharding_demo_tpu.serving.router import ReplicaError
+
+    f = build_fleet(n_decode=1, n_prefill=2)
+    p0, p1 = f.topology.prefill_replicas
+
+    def kill(p):
+        real = p.client
+
+        class _Dead:
+            def post(self, *a, **k):
+                raise ReplicaError(p.name, "replica down (test)")
+
+        p.client = _Dead()
+        return real
+
+    prompt = "system: prefill failover test prompt, long enough!!"
+    for dead in (p0, p1):
+        real = kill(dead)
+        rid = f"fleet-failover-{dead.name}"
+        r = _gen(f.client, prompt, rid=rid)
+        dead.client = real
+        assert r.status_code == 200 and "generated" in r.json()
+        tree = [t for t in f.client.get("/debug/requests?n=8")
+                .json()["requests"] if t["request_id"] == rid][0]
+        phops = [s for s in tree["spans"] if s["name"] == "prefill_hop"]
+        warmed = [h for h in phops if "degraded" not in h["labels"]]
+        assert warmed, f"dead={dead.name}: no healthy warm in {phops}"
+    # both dead: every hop degraded, counted ONCE, decode prefills
+    # cold and the request still succeeds
+    before = f.registry.snapshot().get("fleet_prefill_degraded_total",
+                                       0.0)
+    reals = [kill(p0), kill(p1)]
+    r = _gen(f.client, prompt, rid="fleet-failover-both")
+    p0.client, p1.client = reals
+    assert r.status_code == 200 and "generated" in r.json()
+    after = f.registry.snapshot().get("fleet_prefill_degraded_total",
+                                      0.0)
+    assert after - before == 1.0
+    tree = [t for t in f.client.get("/debug/requests?n=8")
+            .json()["requests"]
+            if t["request_id"] == "fleet-failover-both"][0]
+    phops = [s for s in tree["spans"] if s["name"] == "prefill_hop"]
+    assert len(phops) == 2
+    assert all("degraded" in h["labels"] for h in phops)
+    # warm traffic spreads across prefill replicas by the prefill
+    # ring (consistent hash over the CONTENT key — the key is only
+    # the first chunk, so the varied text must land in chunk 1)
+    first = {}
+    for i in range(8):
+        rid = f"fleet-spread-{i}"
+        r = _gen(f.client,
+                 f"user{i}: spread probe prompt, long enough to key!",
+                 rid=rid, max_new=2)
+        assert r.status_code == 200
+        tree = [t for t in f.client.get("/debug/requests?n=16")
+                .json()["requests"] if t["request_id"] == rid][0]
+        hop = [s for s in tree["spans"]
+               if s["name"] == "prefill_hop"][0]
+        first[i] = hop["labels"]["target"]
+    assert sorted(set(first.values())) == ["prefill0", "prefill1"], first
+
+
+def test_hop_breaker_opens_per_target_with_labeled_gauge():
+    """Satellite: hop_breaker_open carries a per-target label — N
+    downstream replicas, one breaker and one labeled series each —
+    registered in METRIC_CATALOG and emitted on the ROUTER'S own
+    registry (the one its /metrics serves), not the process global."""
+    assert METRIC_CATALOG.get("hop_breaker_open") == "gauge"
+    f = build_fleet(
+        n_decode=2, n_prefill=0,
+        hop_policy=graftfault.HopPolicy(
+            attempts=1, timeout_s=5.0, base_backoff_s=0.001,
+            max_backoff_s=0.002, breaker_threshold=2,
+            breaker_cooldown_s=60.0))
+    plan = graftfault.FaultPlan(seed=2, rate=1.0,
+                                sites={"router.replica_hop"},
+                                kinds={"reset"})
+    with graftfault.use(plan):
+        for _ in range(3):
+            r = _gen(f.client, "system: breaker storm prompt prefix.")
+            assert r.status_code == 503
+            assert int(r.headers["Retry-After"]) >= 1
+    states = {name: f.app.router.policy.breaker_state(name)
+              for name in ("decode0", "decode1")}
+    assert set(states.values()) == {"open"}, states
+    snap = f.registry.snapshot()
+    for name in ("decode0", "decode1"):
+        key = f'hop_breaker_open{{target={name}}}'
+        assert snap.get(key) == 1.0, (key, sorted(
+            k for k in snap if k.startswith("hop_breaker_open")))
+    # /healthz exposes the same per-target states
+    h = f.client.get("/healthz").json()
+    assert h["breakers"]["decode0"] == "open"
+
+
+# -- 3. cross-replica trace stitching -----------------------------------------
+
+
+def test_router_stitches_replica_span_tree_into_one_request_tree(fleet):
+    """Satellite: X-Request-ID propagates through the router hop and
+    the router's /debug/requests shows ONE joined tree per request —
+    hop spans whose children are the replica's own spans."""
+    rid = "fleet-stitch-test-0001"
+    r = _gen(fleet.client, "system: trace stitching test prompt!!!",
+             rid=rid)
+    assert r.status_code == 200
+    assert r.headers["X-Request-ID"] == rid
+    dbg = fleet.client.get("/debug/requests?n=4").json()
+    mine = [t for t in dbg["requests"] if t["request_id"] == rid]
+    assert len(mine) == 1, "the router records one tree per request"
+    spans = {s["name"]: s for s in mine[0]["spans"]}
+    assert "tokenize" in spans
+    hops = [n for n in spans if n.endswith("_hop")]
+    assert "decode_hop" in hops and "prefill_hop" in hops
+    for hop in hops:
+        child_names = [c["name"] for c in spans[hop].get("spans", ())]
+        assert child_names, f"{hop}: replica subtree must be grafted"
+        assert spans[hop]["labels"]["replica_request_id"] == rid
+    # the replica's own recorder has the same rid — the stitch joined
+    # trees, it did not move them
+    d0 = fleet.topology.by_name(
+        spans["decode_hop"]["labels"]["target"])
+    assert d0.recorder.find(rid) is not None
+
+
+def test_router_trace_carries_replica_summary_labels(fleet):
+    """Satellite follow-through: loadgen's trace join reads ttft_ms/
+    new_tokens from the TRACE-level labels of the recorder it is
+    handed — for fleet runs, the ROUTER'S. The router lifts the
+    replica's summary labels onto its own trace (TTFT re-based to the
+    router clock), so the fleet bench rows measure real throughput
+    and joined tails instead of structural zeros."""
+    rid = "fleet-labels-0001"
+    r = _gen(fleet.client, "system: label lift test prompt!!!!!!!",
+             rid=rid, max_new=6)
+    assert r.status_code == 200
+    lab = [t for t in fleet.client.get("/debug/requests?n=4")
+           .json()["requests"] if t["request_id"] == rid][0]["labels"]
+    assert int(lab["new_tokens"]) == 6
+    assert float(lab["ttft_ms"]) > 0
+    # and through the join itself: a short serial run at the router
+    # yields nonzero token throughput and joined ttft tails
+    rep = loadgen.run_load(fleet.client, loadgen.profile("bursty_chat"),
+                           seed=11, n=3, mode="serial",
+                           recorder=fleet.recorder)
+    assert rep["throughput_tokens_per_sec"] > 0
+    assert rep["p99_ttft_ms"] > 0
+
+
+# -- 4. the acceptance run ----------------------------------------------------
+
+
+def test_fleet_byte_equal_to_single_replica_under_all_harnesses(
+        monkeypatch):
+    """Acceptance: router + 1 prefill + 2 decode replicas over ONE
+    shared pool, driven by the graftload bursty_chat profile under
+    GRAFTSAN=1 GRAFTSCHED=1 GRAFTFAULT=1 — per-request outputs
+    byte-equal to the single-replica path, every non-200 typed
+    (429/503 + Retry-After), pool conservation at /healthz mid-run,
+    zero sanitizer/race/leak findings, and the prefill replica really
+    warmed the shared registry."""
+    from llm_sharding_demo_tpu.runtime import kv_pool
+    from llm_sharding_demo_tpu.utils import graftsched
+
+    monkeypatch.setenv("GRAFTSAN", "1")
+    monkeypatch.setenv("GRAFTSCHED", "1")
+    monkeypatch.setenv("GRAFTSCHED_SEED", "4")
+    monkeypatch.setenv("GRAFTFAULT", "1")
+    monkeypatch.setenv("GRAFTFAULT_SEED", "9")
+    monkeypatch.setenv("GRAFTFAULT_RATE", "0.08")
+    monkeypatch.setenv("GRAFTFAULT_SITES",
+                       "serving.admission,router.replica_hop")
+    graftsched.clear()
+    graftfault.reset()
+    try:
+        f = build_fleet(n_decode=2, n_prefill=1, kv_pool_blocks=64)
+        single, single_rec, _sreg = build_single(kv_pool_blocks=64)
+        prof = loadgen.profile("bursty_chat")
+
+        stop = threading.Event()
+        health = []
+
+        def watch():
+            d0 = f.topology.by_name("decode0").client
+            while not stop.is_set():
+                health.append((d0.get("/healthz"),
+                               f.client.get("/healthz")))
+                time.sleep(0.02)
+
+        watcher = threading.Thread(target=watch, daemon=True)
+        watcher.start()
+        try:
+            rep_fleet = loadgen.run_load(f.client, prof, seed=6, n=10,
+                                         mode="serial",
+                                         recorder=f.recorder)
+        finally:
+            stop.set()
+            watcher.join(timeout=10)
+        graftfault.reset()       # fresh site counters for the
+        graftsched.clear()       # reference run's replayed plan
+        monkeypatch.setenv("GRAFTFAULT", "1")   # re-arm env plan
+        rep_single = loadgen.run_load(single, prof, seed=6, n=10,
+                                      mode="serial",
+                                      recorder=single_rec)
+
+        assert rep_fleet["errors"] == 0, rep_fleet["error_codes"]
+        both_200 = 0
+        for of, os_ in zip(rep_fleet["outcomes"],
+                           rep_single["outcomes"]):
+            assert of.status in (200, 429, 503), (of.status, of.code)
+            if of.status != 200:
+                assert of.code, "typed shed must carry an error code"
+            if of.status == 200 and os_.status == 200:
+                assert of.generated == os_.generated, (
+                    f"request {of.k}: fleet output diverged from the "
+                    "single-replica path")
+                both_200 += 1
+        assert both_200 >= 6, (
+            "the pinned seed should complete most requests on both "
+            f"paths (got {both_200}/10)")
+
+        # the prefill replica warmed the SHARED registry and decode
+        # replicas adopted from it (zero-copy block handoff)
+        assert f.pool.allocator.prefix_len() > 0
+        snap = f.registry.snapshot()
+        assert any(k.startswith("fleet_requests_total")
+                   and 'role=prefill' in k for k in snap)
+
+        # conservation at every mid-run poll, replica and router both
+        assert health, "watcher never sampled /healthz"
+        for hd, hr in health:
+            assert hd.status_code == 200 and hr.status_code == 200
+            st = hd.json()["kv_pool_stats"]
+            assert st["blocks_in_use"] + st["blocks_free"] \
+                == st["blocks_total"]
+            assert hr.json()["role"] == "router"
+    finally:
+        graftfault.reset()
+    kv_pool.graftsan_sweep(timeout=10.0)
+    assert graftsched.findings() == [], \
+        [x.format() for x in graftsched.findings()]
+
+
+def test_fleet_open_loop_smoke_all_outcomes_typed(monkeypatch):
+    """Concurrent arrivals through the router (open loop): every
+    outcome typed, conservation holds after the run, the reduction is
+    well-formed."""
+    monkeypatch.setenv("GRAFTSAN", "1")
+    f = build_fleet(n_decode=2, n_prefill=1, kv_pool_blocks=64)
+    rep = loadgen.run_load(f.client, loadgen.profile("bursty_chat"),
+                           seed=3, n=8, rate_scale=2.0, mode="open",
+                           recorder=f.recorder)
+    assert rep["errors"] == 0, rep["error_codes"]
+    for o in rep["outcomes"]:
+        assert o.status in (200, 429, 503), (o.status, o.code)
+    st = f.pool.allocator.stats()
+    assert st.blocks_in_use + st.blocks_free == st.blocks_total
+    assert 0.0 <= rep["goodput_fraction"] <= 1.0
+
+
+# -- 5. traffic_mix journal row (satellite) -----------------------------------
+
+
+def _bd():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO, "tools", "bench_diff.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_traffic_mix_row_joins_demand_value_and_occupancy(fleet):
+    reports = [loadgen.run_load(fleet.client, loadgen.profile(name),
+                                seed=2, n=3, mode="serial",
+                                recorder=fleet.recorder)
+               for name in ("bursty_chat", "agentic")]
+    row = loadgen.traffic_mix_row(reports)
+    assert len(row["workloads"]) == 2
+    for w, rep in zip(row["workloads"], reports):
+        assert w["profile"] == rep["profile"]
+        assert w["workload"].startswith(rep["profile"])
+        for k in ("offered_rps", "completed",
+                  "throughput_tokens_per_sec", "goodput_rps",
+                  "goodput_fraction", "shed_429", "shed_503",
+                  "deadline_misses", "mean_queue_depth",
+                  "mean_batch_occupancy", "mean_blocks_in_use"):
+            assert k in w, k
+        # the pool series rode graftscope during the run — the
+        # occupancy join is real, not a column of Nones
+        assert w["mean_blocks_in_use"] is not None
+
+
+def test_bench_diff_classifies_fleet_and_traffic_mix_metrics():
+    bd = _bd()
+    assert bd.classify("throughput_tokens_per_sec") == "higher"
+    assert bd.classify("goodput_rps") == "higher"
+    assert bd.classify("mean_queue_depth") == "lower"
+    assert bd.classify("mean_batch_occupancy") == "higher"
+    assert bd.classify("affinity_hit_rate") == "higher"
+    assert bd.classify("mean_blocks_in_use") is None   # report-only
+    assert bd.classify("deadline_misses") is None      # report-only
+
+
+# -- 6. the fleet static pass: rule fixtures ----------------------------------
+
+
+def _fleet_fixture(tmp_path, files):
+    from tools.graftcheck import fleet as F
+    paths = []
+    for relpath, source in files.items():
+        p = tmp_path / relpath
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(source))
+        paths.append(str(p))
+    extra = sorted(set(glob.glob(str(tmp_path / "**" / "*.py"),
+                                 recursive=True)) - set(paths))
+    return F.run_fleet(str(tmp_path), paths=paths + extra)
+
+
+def test_fixture_role_completeness_and_stale_vocabulary(tmp_path):
+    got, summary = _fleet_fixture(tmp_path, {
+        "llm_sharding_demo_tpu/fleet/topology.py": """\
+            FLEET_ROLES = {"router": "r", "decode": "d", "ghost": "g"}
+            HANDOFF_POLICY = {
+                "router->decode": ("router", "decode", "doc"),
+                "router->mystery": ("router", "warp_drive", "doc"),
+            }
+            """,
+        "llm_sharding_demo_tpu/serving/router.py": """\
+            HOP_SCOPES = ("R._attempt",)
+
+            class R:
+                def _attempt(self, client):
+                    return client.post("/x", json={})
+
+                def go(self, rep, cfg):
+                    self._hop("router->decode", rep)
+                    self._hop("router->mystery", rep)
+                    return cfg.fleet_role == "prefill"
+            """,
+    })
+    msgs = [f.message for f in got if f.rule == "fleet-role"]
+    assert any("'warp_drive'" in m and "not register" in m
+               for m in msgs)
+    assert any("'ghost'" in m and "stale vocabulary" in m
+               for m in msgs)
+    assert any("'prefill'" in m and "not registered" in m
+               for m in msgs)
+
+
+def test_fixture_undeclared_hop_and_rogue_wire_call(tmp_path):
+    got, _ = _fleet_fixture(tmp_path, {
+        "llm_sharding_demo_tpu/fleet/topology.py": """\
+            FLEET_ROLES = {"router": "r", "decode": "d"}
+            HANDOFF_POLICY = {
+                "router->decode": ("router", "decode", "doc"),
+                "router->stale": ("router", "decode", "doc"),
+            }
+            """,
+        "llm_sharding_demo_tpu/serving/router.py": """\
+            HOP_SCOPES = ("R._attempt", "R._gone")
+
+            class R:
+                def _attempt(self, client):
+                    return client.post("/x", json={})
+
+                def rogue(self, replica):
+                    return replica.client.post("/y", json={})
+
+                def go(self, rep, name):
+                    self._hop("router->decode", rep)
+                    self._hop("router->undeclared", rep)
+                    self._hop(name, rep)
+            """,
+    })
+    msgs = [f.message for f in got
+            if f.rule == "undeclared-replica-hop"]
+    by_scope = {f.scope for f in got
+                if f.rule == "undeclared-replica-hop"}
+    assert any("'router->undeclared'" in m and "no such hop" in m
+               for m in msgs)
+    assert any("not a string literal" in m for m in msgs)
+    assert any("'router->stale'" in m and "stale contract" in m
+               for m in msgs)
+    assert any("'R._gone'" in m and "stale declaration" in m
+               for m in msgs)
+    assert "R.rogue" in by_scope, "wire call outside HOP_SCOPES"
+
+
+def test_fixture_handoff_provenance(tmp_path):
+    got, _ = _fleet_fixture(tmp_path, {
+        "llm_sharding_demo_tpu/runtime/prefix_cache.py": """\
+            HANDOFF_SCOPES = ("Eng._lookup", "Eng._gone")
+            POOL_MOVER_SCOPES = ("Eng._lookup",)
+
+            class Eng:
+                def _lookup(self, alloc, key):
+                    return alloc.lookup_prefix(key)
+
+                def rogue(self, alloc, key, ids):
+                    alloc.register_prefix(key, ids)
+            """,
+        "llm_sharding_demo_tpu/runtime/other.py": """\
+            def sneaky(alloc, key):
+                return alloc.lookup_prefix(key)
+            """,
+    })
+    hits = [f for f in got if f.rule == "handoff-provenance"]
+    assert any(f.scope == "Eng.rogue" for f in hits)
+    assert any(f.scope == "Eng._gone" and "stale" in f.message
+               for f in hits)
+    assert any(f.path.endswith("other.py")
+               and "outside any HANDOFF_SCOPES" in f.message
+               for f in hits)
+    # and the graftsan tie-in: HANDOFF_SCOPES without the lease
+    # contract is its own finding
+    got2, _ = _fleet_fixture(tmp_path / "b", {
+        "llm_sharding_demo_tpu/runtime/prefix_cache.py": """\
+            HANDOFF_SCOPES = ("Eng._lookup",)
+
+            class Eng:
+                def _lookup(self, alloc, key):
+                    return alloc.lookup_prefix(key)
+            """,
+    })
+    assert any("POOL_MOVER_SCOPES" in f.message for f in got2
+               if f.rule == "handoff-provenance")
+
+
+def test_fixture_affinity_key_drift(tmp_path):
+    files = {
+        "llm_sharding_demo_tpu/runtime/prefix_cache.py": """\
+            class Eng:
+                @staticmethod
+                def _key(prompt, m, chunk):
+                    return bytes(prompt[: m * chunk])
+            """,
+        "llm_sharding_demo_tpu/fleet/affinity.py": """\
+            import hashlib
+
+            AFFINITY_KEY_SOURCE = (
+                "llm_sharding_demo_tpu/runtime/prefix_cache.py:"
+                "Eng._key")
+
+            def affinity_key(ids, chunk):
+                k = Eng._key(ids, 1, chunk)
+                return hashlib.sha256(k).digest()   # re-derivation!
+            """,
+    }
+    got, _ = _fleet_fixture(tmp_path, files)
+    hits = [f for f in got if f.rule == "affinity-key-drift"]
+    assert any("ALSO digests content itself" in f.message
+               for f in hits), [f.message for f in hits]
+    # a missing source function is the other drift shape
+    got2, summary2 = _fleet_fixture(tmp_path / "b", {
+        "llm_sharding_demo_tpu/fleet/affinity.py": """\
+            AFFINITY_KEY_SOURCE = "llm_sharding_demo_tpu/nope.py:X._k"
+
+            def affinity_key(ids):
+                return bytes(ids)
+            """,
+    })
+    assert any(f.rule == "affinity-key-drift"
+               and "naming an existing module" in f.message
+               for f in got2)
+
+
+def test_fixture_vacuous_contract_reported(tmp_path):
+    _, summary = _fleet_fixture(tmp_path, {
+        "llm_sharding_demo_tpu/fleet/topology.py": """\
+            FLEET_ROLES = {"decode": "d"}
+            HANDOFF_POLICY = {
+                "router->decode": ("router", "decode", "doc"),
+            }
+            """,
+    })
+    # a HANDOFF_POLICY with no live dispatch anywhere is vacuous — the
+    # strict driver fails on it
+    assert ("llm_sharding_demo_tpu/fleet/topology.py"
+            in summary["vacuous"])
+    assert summary["fleet_checks"] >= 2

@@ -497,6 +497,8 @@ def test_verifier_json_schema_shape():
                             "scope_checks", "scope_profiled_regions",
                             "scope_vacuous", "slo_checks",
                             "slo_policies", "slo_vacuous",
+                            "fleet_checks", "fleet_policies",
+                            "fleet_vacuous",
                             "recompile_bounds"}
     assert isinstance(payload["ok"], bool)
     assert isinstance(payload["sanitize_checks"], int)
@@ -512,6 +514,9 @@ def test_verifier_json_schema_shape():
     assert isinstance(payload["slo_checks"], int)
     assert isinstance(payload["slo_policies"], dict)
     assert isinstance(payload["slo_vacuous"], list)
+    assert isinstance(payload["fleet_checks"], int)
+    assert isinstance(payload["fleet_policies"], dict)
+    assert isinstance(payload["fleet_vacuous"], list)
     assert isinstance(payload["strict"], bool)
     assert isinstance(payload["findings"], list)
     assert isinstance(payload["suppressed"], int)

@@ -57,8 +57,16 @@ _HIGHER_BETTER = ("tokens_per_sec", "tokens_per_second", "speedup",
                   # graftload rows: goodput-under-SLO and declared-SLO
                   # attainment regress DOWNWARD (fewer requests inside
                   # their declared budgets)
-                  "goodput", "slo_attainment")
-_LOWER_BETTER = ("_ms", "latency", "step_ms", "prefill_ms")
+                  "goodput", "slo_attainment",
+                  # graftfleet rows: a regressing router scatters warm
+                  # prefixes (affinity hit rate drops) and an emptier
+                  # batch at the same offered load means admission or
+                  # scheduling got worse, not better
+                  "affinity_hit_rate", "batch_occupancy")
+_LOWER_BETTER = ("_ms", "latency", "step_ms", "prefill_ms",
+                 # traffic_mix occupancy join: deeper queues at the
+                 # same offered rate = the serving stack fell behind
+                 "queue_depth")
 # environment properties, not code performance: the tunnel's RTT, the
 # reference CPU's own rate, and the attribution run's host-dependent
 # byte rates vary by machine/route — comparing them across rounds would

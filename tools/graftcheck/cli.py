@@ -57,7 +57,7 @@ def run(root: str = None, lint_only: bool = False,
     if added:
         sys.path.insert(0, root)
     try:
-        from . import faults, lint, locks, sanitize, scope, slo
+        from . import faults, fleet, lint, locks, sanitize, scope, slo
         findings = list(lint.run_lint(root))
         san, sanitize_checks = sanitize.run_sanitize(root)
         findings.extend(san)
@@ -69,6 +69,8 @@ def run(root: str = None, lint_only: bool = False,
         findings.extend(sc)
         sl, slo_summary = slo.run_slo(root)
         findings.extend(sl)
+        ft, fleet_summary = fleet.run_fleet(root)
+        findings.extend(ft)
         semantic_checks = 0
         bounds = {}
         if not lint_only:
@@ -111,11 +113,15 @@ def run(root: str = None, lint_only: bool = False,
         # and on a VACUOUS slo contract (an SLO_POLICY matching no
         # registered workload profile — the goodput gate stopped
         # seeing traffic)
+        # and on a VACUOUS fleet contract (topology declarations —
+        # HANDOFF_POLICY / HOP_SCOPES / HANDOFF_SCOPES /
+        # AFFINITY_KEY_SOURCE — matching nothing live)
         "ok": (not active and not (strict and stale)
                and not (strict and locks_summary["vacuous"])
                and not (strict and scope_summary["vacuous"])
                and not (strict and faults_summary["vacuous"])
-               and not (strict and slo_summary["vacuous"])),
+               and not (strict and slo_summary["vacuous"])
+               and not (strict and fleet_summary["vacuous"])),
         "strict": strict,
         "findings": [f.to_dict() for f in active],
         "suppressed": len(suppressed),
@@ -135,6 +141,9 @@ def run(root: str = None, lint_only: bool = False,
         "slo_checks": slo_summary["slo_checks"],
         "slo_policies": slo_summary["slo_policies"],
         "slo_vacuous": slo_summary["vacuous"],
+        "fleet_checks": fleet_summary["fleet_checks"],
+        "fleet_policies": fleet_summary["fleet_policies"],
+        "fleet_vacuous": fleet_summary["vacuous"],
         "recompile_bounds": bounds,
     }
 
@@ -343,7 +352,8 @@ def main(argv=None) -> int:
               f"{payload['sanitize_checks']} sanitize checks, "
               f"{payload['fault_checks']} fault checks, "
               f"{payload['scope_checks']} scope checks, "
-              f"{payload['slo_checks']} slo checks"
+              f"{payload['slo_checks']} slo checks, "
+              f"{payload['fleet_checks']} fleet checks"
               + ("" if args.lint_only else
                  f", recompile bounds for {len(payload['recompile_bounds'])}"
                  " workload(s)"))

@@ -180,6 +180,12 @@ def classify_call(call: ast.Call) -> Optional[Tuple[str, str]]:
     leaf = recv.rpartition(".")[2]
     if recv == "requests" and not f.attr.startswith("exception"):
         return f"requests.{f.attr}", "hop"
+    if leaf == "client" and f.attr in ("post", "get"):
+        # a serving-wire client hop (the fleet router's replica
+        # dispatch; the loadgen driver's measured request path) — the
+        # in-process TestClient and a requests-backed adapter share
+        # this shape, and both are fault boundaries
+        return f"client.{f.attr}", "hop"
     if recv == "subprocess" and f.attr in _SUBPROCESS_FNS:
         return f"subprocess.{f.attr}", "subprocess"
     if f.attr == "communicate":

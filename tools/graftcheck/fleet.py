@@ -1,0 +1,610 @@
+"""graftcheck fleet pass: fleet-topology static analysis (compile-free).
+
+graftfleet (``llm_sharding_demo_tpu/fleet/`` + ``serving/router.py``)
+disaggregates prefill from decode replicas and hands KV blocks across
+that boundary through the pool's content-keyed prefix registry. Every
+property that makes the handoff safe is easy to silently lose in a
+refactor: a new code path could speak the replica wire outside the
+router's breaker/deadline discipline, touch the registry surface
+outside the lease-checked adoption scopes, invent a role the topology
+never declared, or re-derive the affinity key until the router and the
+registry disagree about what "same prefix" means. Mirroring the
+graftsan/graftlock/graftfault static+dynamic split, this module is the
+STATIC half of the fleet subsystem: topology becomes a DECLARED
+contract, enforced by AST rules over the production tree (the dynamic
+half — the router, the shared-pool harness, the seeded shed/affinity
+replay — lives in ``fleet/`` and ``serving/router.py``).
+
+In-file declarations (the registration-annotation idiom of
+``FAULT_POLICY`` / ``GUARDED_STATE`` / ``POOL_MOVER_SCOPES``):
+
+- ``FLEET_ROLES``: dict literal ``{role: description}`` — THE role
+  vocabulary (``fleet/topology.py``).
+- ``HANDOFF_POLICY``: dict literal ``{hop: (from_role, to_role,
+  lifetime_doc)}`` — one entry per cross-replica hop; the third field
+  documents what crosses the wire and who owns which pool refs when.
+- ``HOP_SCOPES``: tuple of function qualnames allowed to speak the
+  replica wire directly (``serving/router.py``) — every other dispatch
+  must go through ``_hop(...)`` naming a declared HANDOFF_POLICY entry.
+- ``HANDOFF_SCOPES``: tuple of function qualnames allowed to touch the
+  allocator's content-keyed registry surface (``lookup_prefix`` /
+  ``register_prefix``) — the prefill->decode adoption boundary
+  (``runtime/prefix_cache.py``).
+- ``AFFINITY_KEY_SOURCE``: ``"relpath:Qualified.name"`` string naming
+  THE function the router's affinity key must come from
+  (``fleet/affinity.py`` → the prefix registry's own ``_key``).
+
+Rules (ids in brackets; suppressions ride the shared baseline):
+
+- [fleet-role]             malformed FLEET_ROLES / HANDOFF_POLICY
+                           declarations, a HANDOFF_POLICY endpoint
+                           role missing from FLEET_ROLES, a role
+                           string compared against a ``fleet_role`` /
+                           ``.role`` attribute that the registry does
+                           not know, or a registered role nothing in
+                           the tree references (stale vocabulary).
+- [undeclared-replica-hop] a replica wire call (``client.post/get``)
+                           in fleet code outside a declared HOP_SCOPES
+                           scope (or with no declaration at all), a
+                           stale HOP_SCOPES entry, a ``_hop(...)``
+                           dispatch whose hop name is not a string
+                           literal or names no HANDOFF_POLICY entry,
+                           or a declared hop no dispatch ever takes
+                           (stale contract).
+- [handoff-provenance]     the registry surface touched outside a
+                           declared HANDOFF_SCOPES scope — the block-
+                           lifetime argument for the adoption boundary
+                           only holds inside the scopes graftsan's
+                           lease discipline covers, so a module
+                           declaring HANDOFF_SCOPES must also carry
+                           the POOL_MOVER_SCOPES contract — plus stale
+                           scope entries.
+- [affinity-key-drift]     AFFINITY_KEY_SOURCE unparseable or naming a
+                           function that does not exist, the declaring
+                           module never calling the source, or a
+                           content digest (hashlib / builtin ``hash``)
+                           inside a source-calling function — the
+                           router re-deriving "same prefix" is exactly
+                           the drift that scatters warm prefixes
+                           across replicas.
+
+``--strict`` additionally fails a VACUOUS pass (a declaration-carrying
+module none of whose contract entries match anything live — the fleet
+contract stopped seeing the code); ``cli.run --json`` carries
+``fleet_checks`` / ``fleet_policies`` / ``fleet_vacuous``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import lint as L
+from .core import Finding
+from .locks import _dotted, _module_assign, _parents, _scope_of
+
+FLEET_RULE_IDS = ("fleet-role", "undeclared-replica-hop",
+                  "handoff-provenance", "affinity-key-drift")
+
+# where replica wire calls are held to HOP_SCOPES (the fleet's own
+# surface; loadgen/tests drive TestClients too, but only fleet code
+# carries the cross-replica hop contract)
+_FLEET_PREFIXES = ("llm_sharding_demo_tpu/fleet/",)
+_FLEET_FILES = {"llm_sharding_demo_tpu/serving/router.py"}
+
+# the registry's def site: its own body is the implementation, not a
+# consumer of the handoff surface
+_REGISTRY_DEF_RELPATH = "llm_sharding_demo_tpu/runtime/kv_pool.py"
+_REGISTRY_SURFACE = {"lookup_prefix", "register_prefix"}
+
+# attribute names whose string comparisons name fleet roles
+_ROLE_ATTRS = {"fleet_role", "role"}
+
+
+def _is_fleet_module(relpath: str) -> bool:
+    return (relpath in _FLEET_FILES
+            or any(relpath.startswith(p) for p in _FLEET_PREFIXES))
+
+
+# -- declarations -------------------------------------------------------------
+
+
+def _str_dict(node: ast.AST) -> Optional[List[Tuple[str, ast.AST]]]:
+    if not isinstance(node, ast.Dict):
+        return None
+    out = []
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            return None
+        out.append((k.value, v))
+    return out
+
+
+def declared_roles(mod: L.ModuleInfo,
+                   ) -> Tuple[Optional[Set[str]], int, List[str]]:
+    """``FLEET_ROLES`` -> (roles, decl line, malformed messages)."""
+    stmt = _module_assign(mod, "FLEET_ROLES")
+    if stmt is None:
+        return None, 0, []
+    entries = _str_dict(stmt.value)
+    if entries is None:
+        return set(), stmt.lineno, [
+            "FLEET_ROLES must be a dict literal with string role keys "
+            "(the fleet pass reads the vocabulary statically)"]
+    bad = [f"role {k!r}: description must be a string literal"
+           for k, v in entries
+           if not (isinstance(v, ast.Constant)
+                   and isinstance(v.value, str))]
+    return {k for k, _ in entries}, stmt.lineno, bad
+
+
+def declared_handoffs(mod: L.ModuleInfo,
+                      ) -> Tuple[Optional[Dict[str, Tuple[str, str, str]]],
+                                 int, List[str]]:
+    """``HANDOFF_POLICY`` -> ({hop: (from, to, doc)}, decl line,
+    malformed messages)."""
+    stmt = _module_assign(mod, "HANDOFF_POLICY")
+    if stmt is None:
+        return None, 0, []
+    entries = _str_dict(stmt.value)
+    if entries is None:
+        return {}, stmt.lineno, [
+            "HANDOFF_POLICY must be a dict literal with string hop keys"]
+    out: Dict[str, Tuple[str, str, str]] = {}
+    bad: List[str] = []
+    for hop, v in entries:
+        vals: Optional[List[str]] = None
+        if isinstance(v, (ast.Tuple, ast.List)):
+            vals = [e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+            if len(vals) != len(v.elts):
+                vals = None
+        if vals is None or len(vals) != 3:
+            bad.append(f"hop {hop!r}: policy must be a (from_role, "
+                       "to_role, block_lifetime_doc) string triple")
+            continue
+        out[hop] = (vals[0], vals[1], vals[2])
+    return out, stmt.lineno, bad
+
+
+def _declared_scopes(mod: L.ModuleInfo, name: str,
+                     ) -> Tuple[Optional[Set[str]], int]:
+    stmt = _module_assign(mod, name)
+    if stmt is None:
+        return None, 0
+    vals = L._string_tuple(stmt.value)
+    return (vals if vals is not None else set()), stmt.lineno
+
+
+def declared_affinity_source(mod: L.ModuleInfo,
+                             ) -> Tuple[Optional[str], int]:
+    stmt = _module_assign(mod, "AFFINITY_KEY_SOURCE")
+    if stmt is None:
+        return None, 0
+    if (isinstance(stmt.value, ast.Constant)
+            and isinstance(stmt.value.value, str)):
+        return stmt.value.value, stmt.lineno
+    return "", stmt.lineno
+
+
+# -- use extraction -----------------------------------------------------------
+
+
+def _role_literals(mod: L.ModuleInfo) -> List[Tuple[int, str, str]]:
+    """String literals compared against a role attribute:
+    ``cfg.fleet_role != "prefill"`` / ``self.fleet_role not in ("",
+    "prefill", "decode")`` / ``r.role == "router"`` ->
+    [(line, scope-attr, literal)]. Only fleet-surface comparisons are
+    meaningful role uses; everything else compares other vocabulary."""
+    out: List[Tuple[int, str, str]] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left] + list(node.comparators)
+        attr = None
+        for s in sides:
+            d = _dotted(s)
+            if d is not None and d.rpartition(".")[2] in _ROLE_ATTRS:
+                attr = d.rpartition(".")[2]
+        if attr is None:
+            continue
+        for s in sides:
+            if isinstance(s, ast.Constant) and isinstance(s.value, str):
+                out.append((s.lineno, attr, s.value))
+            elif isinstance(s, (ast.Tuple, ast.List, ast.Set)):
+                for e in s.elts:
+                    if isinstance(e, ast.Constant) \
+                            and isinstance(e.value, str):
+                        out.append((e.lineno, attr, e.value))
+    return out
+
+
+def _wire_calls(mod: L.ModuleInfo) -> List[Tuple[int, str, str]]:
+    """Replica wire touchpoints: ``<...>client.post/get(...)`` ->
+    [(line, scope, dotted receiver)]."""
+    parents = _parents(mod.tree)
+    out = []
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("post", "get")):
+            continue
+        recv = _dotted(node.func.value)
+        if recv is None or recv.rpartition(".")[2] != "client":
+            continue
+        out.append((node.lineno, _scope_of(node, parents, mod), recv))
+    return out
+
+
+def _hop_dispatches(mod: L.ModuleInfo,
+                    ) -> List[Tuple[int, str, Optional[str]]]:
+    """``*._hop("name", ...)`` dispatch sites -> [(line, scope,
+    literal hop name or None when not a string literal)]."""
+    parents = _parents(mod.tree)
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        named = ((isinstance(f, ast.Attribute) and f.attr == "_hop")
+                 or (isinstance(f, ast.Name) and f.id == "_hop"))
+        if not named:
+            continue
+        hop = None
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            hop = node.args[0].value
+        out.append((node.lineno, _scope_of(node, parents, mod), hop))
+    return out
+
+
+def _registry_calls(mod: L.ModuleInfo) -> List[Tuple[int, str, str]]:
+    """Content-keyed registry surface calls (``.lookup_prefix`` /
+    ``.register_prefix``) -> [(line, scope, method)]."""
+    parents = _parents(mod.tree)
+    out = []
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _REGISTRY_SURFACE):
+            out.append((node.lineno, _scope_of(node, parents, mod),
+                        node.func.attr))
+    return out
+
+
+def _digest_calls_in(fn: ast.AST) -> List[int]:
+    """Content-digest call lines inside ``fn``'s own body (hashlib.* or
+    builtin ``hash``) — an independent key derivation."""
+    out = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id == "hash":
+            out.append(node.lineno)
+        d = _dotted(f) or ""
+        if d.split(".", 1)[0] == "hashlib":
+            out.append(node.lineno)
+    return out
+
+
+# -- the pass -----------------------------------------------------------------
+
+
+def run_fleet(root: str, paths: Optional[List[str]] = None,
+              ) -> Tuple[List[Finding], dict]:
+    """The whole static pass over the production surface ->
+    (findings, summary). ``summary`` carries ``fleet_checks`` (real
+    analysis units: declarations validated, hop dispatches resolved,
+    wire/registry sites scoped, role literals checked, affinity
+    sources resolved — the vacuity guard on the pass itself),
+    ``fleet_policies`` (per-declaring-module count of contract entries
+    matching something live) and ``vacuous`` (declaration-carrying
+    modules whose contract matches nothing — the strict driver fails
+    these)."""
+    mods: List[L.ModuleInfo] = []
+    for path in (paths if paths is not None else L.iter_sources(root)):
+        mod = L.index_module(path, root)
+        if mod is not None:
+            mods.append(mod)
+
+    findings: List[Finding] = []
+    checks = 0
+    policies: Dict[str, int] = {}
+    vacuous: List[str] = []
+
+    # -- phase 1: collect declarations ------------------------------------
+    roles: Set[str] = set()
+    roles_mod: Optional[L.ModuleInfo] = None
+    roles_line = 0
+    handoffs: Dict[str, Tuple[str, str, str]] = {}
+    handoffs_mod: Optional[L.ModuleInfo] = None
+    handoffs_line = 0
+    hop_scopes: Dict[str, Tuple[Set[str], int]] = {}       # relpath ->
+    handoff_scopes: Dict[str, Tuple[Set[str], int]] = {}
+    affinity: Dict[str, Tuple[str, int]] = {}
+
+    for mod in mods:
+        r, line, bad = declared_roles(mod)
+        if r is not None:
+            checks += 1
+            roles |= r
+            roles_mod, roles_line = mod, line
+            for msg in bad:
+                findings.append(Finding("fleet-role", mod.relpath, line,
+                                        "<module>", msg))
+        h, hline, bad = declared_handoffs(mod)
+        if h is not None:
+            checks += 1
+            handoffs.update(h)
+            handoffs_mod, handoffs_line = mod, hline
+            for msg in bad:
+                findings.append(Finding("fleet-role", mod.relpath, hline,
+                                        "<module>", msg))
+        s, sline = _declared_scopes(mod, "HOP_SCOPES")
+        if s is not None:
+            hop_scopes[mod.relpath] = (s, sline)
+        s, sline = _declared_scopes(mod, "HANDOFF_SCOPES")
+        if s is not None:
+            handoff_scopes[mod.relpath] = (s, sline)
+        src, aline = declared_affinity_source(mod)
+        if src is not None:
+            affinity[mod.relpath] = (src, aline)
+
+    # -- fleet-role: endpoint completeness --------------------------------
+    role_uses: Set[str] = set()
+    if handoffs_mod is not None:
+        for hop, (src_role, dst_role, _doc) in sorted(handoffs.items()):
+            checks += 1
+            for endpoint in (src_role, dst_role):
+                if roles and endpoint not in roles:
+                    findings.append(Finding(
+                        "fleet-role", handoffs_mod.relpath,
+                        handoffs_line, "<module>",
+                        f"HANDOFF_POLICY hop {hop!r} names endpoint "
+                        f"role {endpoint!r}, which FLEET_ROLES does "
+                        "not register — declare the role or fix the "
+                        "hop"))
+                else:
+                    role_uses.add(endpoint)
+
+    # -- per-module use scans ---------------------------------------------
+    dispatched: Set[str] = set()
+    wire_scoped: Dict[str, Set[str]] = {}      # relpath -> live scopes
+    registry_scoped: Dict[str, Set[str]] = {}
+
+    for mod in mods:
+        # role literals (fleet surface + any module declaring roles,
+        # i.e. wherever the vocabulary is actually spoken)
+        if roles and (_is_fleet_module(mod.relpath)
+                      or mod.relpath.startswith(
+                          "llm_sharding_demo_tpu/serving/")
+                      or mod.relpath.endswith("utils/config.py")):
+            for line, attr, lit in _role_literals(mod):
+                checks += 1
+                if lit == "":
+                    continue          # "" = standalone, not a role
+                if lit not in roles:
+                    findings.append(Finding(
+                        "fleet-role", mod.relpath, line, attr,
+                        f"role literal {lit!r} compared against "
+                        f"{attr!r} is not registered in FLEET_ROLES "
+                        f"({sorted(roles)}) — an unregistered role "
+                        "can neither be routed to nor checked"))
+                else:
+                    role_uses.add(lit)
+
+        # hop dispatches
+        for line, scope, hop in _hop_dispatches(mod):
+            checks += 1
+            if hop is None:
+                findings.append(Finding(
+                    "undeclared-replica-hop", mod.relpath, line, scope,
+                    "_hop dispatch whose hop name is not a string "
+                    "literal — the fleet pass cannot match it against "
+                    "HANDOFF_POLICY (name the declared hop inline)"))
+            elif hop not in handoffs:
+                findings.append(Finding(
+                    "undeclared-replica-hop", mod.relpath, line, scope,
+                    f"_hop dispatch names {hop!r} but HANDOFF_POLICY "
+                    "declares no such hop — what crosses this wire "
+                    "and who owns the blocks afterward?"))
+            else:
+                dispatched.add(hop)
+
+        # wire calls in fleet code
+        if _is_fleet_module(mod.relpath):
+            calls = _wire_calls(mod)
+            declared, decl_line = hop_scopes.get(mod.relpath,
+                                                 (None, 0))
+            for line, scope, recv in calls:
+                checks += 1
+                if declared is None:
+                    findings.append(Finding(
+                        "undeclared-replica-hop", mod.relpath, line,
+                        scope,
+                        f"fleet module speaks the replica wire "
+                        f"({recv}.post/get) but declares no "
+                        "HOP_SCOPES — the breaker/deadline/shed "
+                        "discipline only covers dispatch through "
+                        "declared scopes"))
+                elif scope not in declared:
+                    findings.append(Finding(
+                        "undeclared-replica-hop", mod.relpath, line,
+                        scope,
+                        f"replica wire call in {scope!r}, which "
+                        "HOP_SCOPES does not declare — route the "
+                        "dispatch through _hop so the per-target "
+                        "breaker and deadline budget cover it"))
+                else:
+                    wire_scoped.setdefault(mod.relpath,
+                                           set()).add(scope)
+            if declared is not None:
+                for scope in sorted(
+                        declared - wire_scoped.get(mod.relpath, set())):
+                    checks += 1
+                    findings.append(Finding(
+                        "undeclared-replica-hop", mod.relpath,
+                        decl_line, scope,
+                        f"HOP_SCOPES declares {scope!r} but it makes "
+                        "no replica wire call (stale declaration)"))
+
+        # registry surface provenance
+        if mod.relpath != _REGISTRY_DEF_RELPATH:
+            calls = _registry_calls(mod)
+            declared, decl_line = handoff_scopes.get(mod.relpath,
+                                                     (None, 0))
+            for line, scope, meth in calls:
+                checks += 1
+                if declared is None:
+                    findings.append(Finding(
+                        "handoff-provenance", mod.relpath, line, scope,
+                        f"{meth} call on the content-keyed registry "
+                        "outside any HANDOFF_SCOPES declaration — the "
+                        "prefill->decode adoption boundary must be "
+                        "enumerated so block lifetime is reviewable"))
+                elif scope not in declared:
+                    findings.append(Finding(
+                        "handoff-provenance", mod.relpath, line, scope,
+                        f"{meth} call in {scope!r}, which "
+                        "HANDOFF_SCOPES does not declare — registry "
+                        "handoff outside the declared adoption "
+                        "boundary"))
+                else:
+                    registry_scoped.setdefault(mod.relpath,
+                                               set()).add(scope)
+            if declared is not None:
+                for scope in sorted(
+                        declared
+                        - registry_scoped.get(mod.relpath, set())):
+                    checks += 1
+                    findings.append(Finding(
+                        "handoff-provenance", mod.relpath, decl_line,
+                        scope,
+                        f"HANDOFF_SCOPES declares {scope!r} but it "
+                        "touches no registry surface (stale "
+                        "declaration)"))
+                # the lifetime argument rides graftsan's lease
+                # discipline: the module enumerating the adoption
+                # boundary must carry the POOL_MOVER_SCOPES contract
+                checks += 1
+                if _module_assign(mod, "POOL_MOVER_SCOPES") is None:
+                    findings.append(Finding(
+                        "handoff-provenance", mod.relpath, decl_line,
+                        "<module>",
+                        "module declares HANDOFF_SCOPES but no "
+                        "POOL_MOVER_SCOPES — the adoption boundary's "
+                        "block-lifetime claim depends on graftsan's "
+                        "lease-checked mover scopes"))
+
+    # -- stale hop contracts ----------------------------------------------
+    if handoffs_mod is not None:
+        for hop in sorted(set(handoffs) - dispatched):
+            checks += 1
+            findings.append(Finding(
+                "undeclared-replica-hop", handoffs_mod.relpath,
+                handoffs_line, "<module>",
+                f"HANDOFF_POLICY declares hop {hop!r} but no _hop "
+                "dispatch takes it (stale contract)"))
+
+    # -- stale roles -------------------------------------------------------
+    if roles_mod is not None:
+        for role in sorted(roles - role_uses):
+            checks += 1
+            findings.append(Finding(
+                "fleet-role", roles_mod.relpath, roles_line,
+                "<module>",
+                f"FLEET_ROLES registers {role!r} but no handoff "
+                "endpoint or role check references it (stale "
+                "vocabulary)"))
+
+    # -- affinity-key drift ------------------------------------------------
+    by_relpath = {m.relpath: m for m in mods}
+    for relpath, (src, line) in sorted(affinity.items()):
+        mod = by_relpath[relpath]
+        checks += 1
+        target_rel, sep, qual = src.partition(":")
+        target = by_relpath.get(target_rel) if sep else None
+        if target is None and sep:
+            # source file may sit outside the scanned paths subset
+            # (rule fixtures); try indexing it directly
+            cand = os.path.join(root, target_rel)
+            if os.path.exists(cand):
+                target = L.index_module(cand, root)
+        if not sep or not qual or target is None:
+            findings.append(Finding(
+                "affinity-key-drift", relpath, line, "<module>",
+                f"AFFINITY_KEY_SOURCE {src!r} must be "
+                "'relpath:Qualified.name' naming an existing module "
+                "— the router's key must trace to the registry's own "
+                "derivation"))
+            continue
+        if qual not in target.functions:
+            findings.append(Finding(
+                "affinity-key-drift", relpath, line, "<module>",
+                f"AFFINITY_KEY_SOURCE names {qual!r}, which "
+                f"{target_rel} does not define — the declared key "
+                "source is gone (drift, or a stale declaration)"))
+            continue
+        leaf = qual.rpartition(".")[2]
+        callers = []
+        for fn_qual, fn in sorted(mod.functions.items()):
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == leaf):
+                    callers.append((fn_qual, fn))
+                    break
+        checks += len(callers)
+        if not callers:
+            findings.append(Finding(
+                "affinity-key-drift", relpath, line, "<module>",
+                f"module declares AFFINITY_KEY_SOURCE but never calls "
+                f"{qual!r} — the affinity key is not derived from the "
+                "registry's own content keys"))
+        for fn_qual, fn in callers:
+            for dline in _digest_calls_in(fn):
+                findings.append(Finding(
+                    "affinity-key-drift", relpath, dline, fn_qual,
+                    f"{fn_qual} derives the affinity key via "
+                    f"{qual!r} but ALSO digests content itself "
+                    "(hashlib/hash) — two derivations of 'same "
+                    "prefix' is exactly the drift that scatters warm "
+                    "prefixes across replicas"))
+        policies[relpath] = (policies.get(relpath, 0)
+                             + (1 if callers else 0))
+        if not callers:
+            vacuous.append(relpath)
+
+    # -- vacuity accounting ------------------------------------------------
+    if roles_mod is not None:
+        live = len(roles & role_uses)
+        policies[roles_mod.relpath] = policies.get(roles_mod.relpath, 0)
+        if roles and not live:
+            vacuous.append(roles_mod.relpath)
+    if handoffs_mod is not None:
+        live = len(set(handoffs) & dispatched)
+        policies[handoffs_mod.relpath] = (
+            policies.get(handoffs_mod.relpath, 0) + live)
+        if handoffs and not live:
+            vacuous.append(handoffs_mod.relpath)
+    for relpath, (declared, _line) in sorted(hop_scopes.items()):
+        live = len(declared & wire_scoped.get(relpath, set()))
+        policies[relpath] = policies.get(relpath, 0) + live
+        if declared and not live:
+            vacuous.append(relpath)
+    for relpath, (declared, _line) in sorted(handoff_scopes.items()):
+        live = len(declared & registry_scoped.get(relpath, set()))
+        policies[relpath] = policies.get(relpath, 0) + live
+        if declared and not live:
+            vacuous.append(relpath)
+
+    summary = {
+        "fleet_checks": checks,
+        "fleet_policies": policies,
+        "vacuous": sorted(set(vacuous)),
+    }
+    return (sorted(findings, key=lambda f: (f.path, f.line, f.rule)),
+            summary)

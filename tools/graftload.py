@@ -40,9 +40,7 @@ def build_demo_app(max_seq: int = 256, max_batch: int = 4,
     """(client, recorder, registry) for a tiny in-process pooled
     serving app — the graftload CLI/bench target. ``kv_pool_blocks=0``
     sizes the pool to hold ``max_batch`` full-length rows."""
-    import jax
-
-    from llm_sharding_demo_tpu.models import gpt2
+    from llm_sharding_demo_tpu.fleet.harness import demo_model
     from llm_sharding_demo_tpu.serving.app import create_app
     from llm_sharding_demo_tpu.serving.http import TestClient
     from llm_sharding_demo_tpu.serving.tokenizer import ByteTokenizer
@@ -50,9 +48,7 @@ def build_demo_app(max_seq: int = 256, max_batch: int = 4,
     from llm_sharding_demo_tpu.utils.metrics import MetricsRegistry
     from llm_sharding_demo_tpu.utils.tracing import FlightRecorder
 
-    cfg_model = gpt2.GPT2Config(vocab_size=256, n_positions=max_seq,
-                                n_embd=32, n_layer=2, n_head=4)
-    params = gpt2.init_params(cfg_model, jax.random.PRNGKey(0))
+    cfg_model, params = demo_model(max_seq)
     if kv_pool_blocks <= 0:
         kv_pool_blocks = max_batch * (-(-max_seq // kv_block_size))
     cfg = ServingConfig(model_id="graftload-demo",
