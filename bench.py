@@ -1106,6 +1106,95 @@ def measure_fleet_scaling(seed: int = 0, n_requests: int = 16) -> dict:
             "workloads": rows}
 
 
+def measure_plan_switch(seed: int = 7, n_requests: int = 10) -> dict:
+    """graftwatch live re-planning row (ISSUE 13): the seeded mix flip
+    (serial single-stream -> open burst -> serial again, agentic
+    profile) against the AUTO_PLAN_CONTINUOUS app — the bench-grade
+    twin of tests/test_graftwatch.py's acceptance run. Journals the
+    live switch count, goodput/throughput before (solo plan, serial
+    phase) and after (batched plan, burst phase) the switch, and the
+    pinned invariant as a number: compiled programs minted by replaying
+    the whole mix across further live switches — ZERO beyond the
+    pre-certified set, gated lower-better by bench_diff so any upward
+    drift reads as a certified-envelope leak, not noise.
+
+    Needs the bench chip: on CPU the decode itself dominates and the
+    open-loop burst would measure the host, not the switch.
+    """
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return {"skipped": "plan-switch goodput needs the bench chip "
+                           "(on CPU the decode itself dominates and "
+                           "the open-loop burst measures the host, "
+                           "not the live re-planner)"}
+
+    from llm_sharding_demo_tpu import loadgen
+    from tools.graftload import build_demo_app
+
+    prof = loadgen.profile("agentic")
+    sched = loadgen.schedule(prof, seed, n_requests)
+    # certify the plan set against the schedule's OWN traffic classes
+    # (byte-level prompt lengths — the demo app's ByteTokenizer), so
+    # the certified bounds cover the whole measured run
+    classes = sorted({(len(a.prompt.encode("utf-8")), a.max_new)
+                      for a in sched})
+    traffic = ",".join(f"{p}/{n}" for p, n in classes)
+    client, recorder, _reg = build_demo_app(
+        max_seq=256, max_batch=4, recorder_capacity=max(64, 8 * n_requests),
+        continuous=True, auto_plan_traffic=traffic)
+    sw = client.app.plan_switcher
+
+    def caches():
+        solo = sw.plans["solo"]
+        eng, pool = solo.engine, solo.pool
+        return sum(fn._cache_size() for fn in (
+            eng._prefill, eng._prefill_chunked, eng._decode_seg,
+            pool._gather, pool._scatter, pool._scatter_row, pool._copy))
+
+    def run(mode, rate=1.0):
+        return loadgen.run_load(client, prof, seed=seed, n=n_requests,
+                                mode=mode, rate_scale=rate,
+                                recorder=recorder)
+
+    # warmup/compile pass so phase goodput measures serving, not
+    # first-touch XLA compiles
+    loadgen.run_load(client, prof, seed=seed + 1, n=2, mode="serial",
+                     recorder=recorder)
+    before = run("serial")            # single-stream: stays solo
+    burst = run("open", rate=60.0)    # the burst: flips to batched
+    run("serial")                     # drains back toward solo
+    programs_after_mix = caches()
+    # the full mix again: more live switches, zero new programs is the
+    # journaled invariant
+    run("serial")
+    after = run("open", rate=60.0)
+    run("serial")
+    recompiles = caches() - programs_after_mix
+    hv = sw.health_view()
+    return {
+        "seed": seed,
+        "requests_per_run": n_requests,
+        "switches": hv["switches"],
+        "switch_flips": [f'{e["from"]}->{e["to"]}'
+                         for e in sw.events() if e["switched"]],
+        "active_plan": hv["active"],
+        "certified_program_total": sum(
+            sw.certified[p]["program_total"] for p in sw.certified),
+        # THE invariant, as a gated metric (lower-better, expect 0)
+        "recompiles_beyond_certified": recompiles,
+        "goodput_fraction_before": before["goodput_fraction"],
+        "goodput_fraction_after": after["goodput_fraction"],
+        "throughput_tokens_per_sec_before":
+            before["throughput_tokens_per_sec"],
+        "throughput_tokens_per_sec_after":
+            after["throughput_tokens_per_sec"],
+        "p99_e2e_ms_before": before["p99_e2e_ms"],
+        "p99_e2e_ms_burst": burst["p99_e2e_ms"],
+        "p99_e2e_ms_after": after["p99_e2e_ms"],
+    }
+
+
 def measure_spec_iterbatch(config, dtype="bfloat16", n_requests: int = 8,
                            max_batch: int = 4, steps: int = 160,
                            prompt_len: int = 64, stagger_s: float = 0.04,
@@ -2095,6 +2184,15 @@ def main() -> None:
         split; skip-with-reason off the bench chip."""
         return measure_fleet_scaling()
 
+    def cfg_plan_switch():
+        """graftwatch live re-planning (ISSUE 13): seeded mix flip
+        against the AUTO_PLAN_CONTINUOUS app — switch count, goodput/
+        throughput before vs after the switch, and recompiles beyond
+        the pre-certified plan set (the pinned ZERO, gated lower-better
+        so a certified-envelope leak fails the trajectory); skip-with-
+        reason off the bench chip."""
+        return measure_plan_switch()
+
     safe("cfg14_paged_kv_vs_contiguous", cfg14)
     safe("concurrent_load", cfg_concurrent_load)
     safe("fault_recovery", cfg_fault_recovery)
@@ -2102,6 +2200,7 @@ def main() -> None:
     safe("slo_attainment", cfg_slo_attainment)
     safe("traffic_mix", cfg_traffic_mix)
     safe("fleet_scaling", cfg_fleet_scaling)
+    safe("plan_switch", cfg_plan_switch)
     safe("cfg4_gpt2_medium_4shard", cfg4)
     safe("cfg5_kv_cache_vs_on2", cfg5)
     safe("cfg6_moe_8e_top2_124m_geometry", cfg6)

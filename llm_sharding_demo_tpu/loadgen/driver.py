@@ -43,10 +43,12 @@ from .profiles import SLO_POLICY, WorkloadProfile
 from .schedule import Arrival, schedule
 
 # graftscope series the occupancy summary reduces (queue/batch/pool/
-# breaker — the load-level view of the serving stack's internal state)
+# breaker/plan — the load-level view of the serving stack's internal
+# state; auto_plan_active puts graftwatch plan switches on the same
+# timeline as the queue depth that provoked them)
 OCCUPANCY_SERIES = ("queue_depth", "batch_occupancy",
                     "kv_cache_blocks_in_use", "iter_live_rows",
-                    "hop_breaker_open")
+                    "hop_breaker_open", "auto_plan_active")
 
 # Fault contract (tools/graftcheck faults pass): the driver's one
 # blocking boundary is the in-process client hop it measures through.

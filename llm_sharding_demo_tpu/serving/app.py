@@ -435,6 +435,7 @@ def create_app(cfg: Optional[ServingConfig] = None,
     runner = None
     spec_runner = None
     prefix_runner = None   # closure target for /prefill's role guard
+    switcher = None        # graftwatch continuous-mode plan switcher
     # ``kv_pool`` is the (optional) injected shared pool; non-pooled
     # configurations must not carry one (validated below), and only the
     # coordinator's local decode path can host it at all
@@ -453,7 +454,75 @@ def create_app(cfg: Optional[ServingConfig] = None,
         dtype = cfg.inference_dtype
         # chunked prefill bounds compile count per prompt length; 0 -> off
         pchunk = cfg.prefill_chunk or None
-        if cfg.spec_decode > 0:
+        if cfg.auto_plan_continuous:
+            # Continuous re-planning (utils/graftwatch, the dynamic
+            # half of the graftcheck watch pass): ONE engine and ONE
+            # block pool back a PRE-CERTIFIED switchable plan set —
+            # the solo paged runner and the pooled iteration
+            # scheduler, both built HERE, at startup. The switcher
+            # only ever re-routes admissions between these front ends
+            # (it can never construct a runner), which is the whole
+            # "a plan switch causes zero recompiles beyond the
+            # certified set" invariant; the certified program cost of
+            # each plan is proven through recompile.certify machinery
+            # in graftwatch.certify_plan_set and served at
+            # GET /debug/plan. Composition exclusions live in
+            # utils.config (__post_init__).
+            from ..models import is_window_independent as _wi_c
+            if not _wi_c(config):
+                raise ValueError(
+                    "AUTO_PLAN_CONTINUOUS requires window-independent "
+                    f"routing (dense families); {type(config).__name__} "
+                    "serves hand-tuned")
+            try:
+                from ..utils import graftwatch
+                import tools.graftcheck  # noqa: F401 — certifier dep
+            except ImportError as e:
+                raise ValueError(
+                    "AUTO_PLAN_CONTINUOUS needs the repo's tools/ "
+                    "package importable (run from the repo checkout "
+                    "root) — the plan set is certified through "
+                    "tools/graftcheck") from e
+            from ..runtime.engine import DecodeEngine
+            engine = DecodeEngine(params, config, max_seq=cfg.max_seq,
+                                  dtype=dtype)
+            decode_stages = 1
+            if kv_pool is not None:
+                if kv_pool.max_seq != engine._cache_seq:
+                    raise ValueError(
+                        f"injected kv_pool spans {kv_pool.max_seq} "
+                        f"slots, engine cache is {engine._cache_seq} — "
+                        "shared-pool replicas must agree on geometry")
+            else:
+                from ..runtime.kv_pool import KVBlockPool
+                kv_pool = KVBlockPool.for_engine(
+                    engine, num_blocks=cfg.kv_pool_blocks,
+                    block_size=cfg.kv_block_size)
+            weights = graftwatch.CostWeights.apriori()
+            if cfg.auto_plan_journal:
+                # telemetry-calibrated byte weights: the journaled
+                # graftscope_attribution drift rows (and the ICI
+                # calibration row) re-price the live scoring with this
+                # host's measured rates. A malformed journal raises the
+                # typed CalibrationError at startup — never a silent
+                # fall-back to the a-priori weights.
+                import json as _json
+                with open(cfg.auto_plan_journal, encoding="utf-8") as f:
+                    weights = graftwatch.fit_cost_weights(_json.load(f))
+            plans, plan_cost_map, certified = graftwatch.build_plan_set(
+                engine, kv_pool, config, max_seq=cfg.max_seq,
+                max_batch=cfg.max_batch,
+                traffic=cfg.auto_plan_traffic or None,
+                batch_wait_ms=cfg.batch_wait_ms)
+            watcher = graftwatch.TelemetryWatcher(registry=reg)
+            switcher = graftwatch.PlanSwitcher(
+                plans, plan_cost_map, certified, watcher,
+                weights=weights, registry=reg)
+            log.info('{"event": "auto_plan_continuous", "plans": %s, '
+                     '"active": "%s", "weights": "%s"}',
+                     sorted(plans), switcher.health_view()["active"],
+                     weights.source)
+        elif cfg.spec_decode > 0:
             # prompt-lookup speculation (runtime.spec_decode):
             # single-stream requests emit up to draft_len+1 tokens per
             # forward — token-exact for greedy, distribution-exact for
@@ -536,7 +605,11 @@ def create_app(cfg: Optional[ServingConfig] = None,
         else:
             runner = PipelineRunner(params, config, list(cfg.boundaries),
                                     max_seq=cfg.max_seq, dtype=dtype)
-        if cfg.kv_pool_blocks > 0:
+        if switcher is not None:
+            # continuous mode built its engine, pool, and certified
+            # plan set above; admissions route through the switcher
+            pass
+        elif cfg.kv_pool_blocks > 0:
             # the paged KV block pool (runtime.kv_pool): one ref-counted
             # block store shared by the prefix store and whichever
             # decode front end serves /generate. An INJECTED pool
@@ -578,7 +651,9 @@ def create_app(cfg: Optional[ServingConfig] = None,
                 chunk=cfg.prefix_chunk or cfg.prefill_chunk or 64,
                 spec=spec_runner, pool=kv_pool)
             runner = prefix_runner
-        if cfg.max_batch > 1:
+        if switcher is not None:
+            pass   # the plan set IS the batching decision, per wave
+        elif cfg.max_batch > 1:
             base = (prefix_runner.plain if prefix_runner is not None
                     else runner)
             if cfg.batch_mode == "iter":
@@ -656,7 +731,13 @@ def create_app(cfg: Optional[ServingConfig] = None,
             "fleet_role": cfg.fleet_role,
             "prefix_chunk": cfg.prefix_chunk,
         }
-        if auto_plan_info is not None:
+        if switcher is not None:
+            # continuous mode (graftwatch): auto_plan is LIVE, not
+            # startup-only — the current plan, switch count, and wave
+            # config, merged over any startup-planner row
+            topo["auto_plan"] = {**(auto_plan_info or {}),
+                                 **switcher.health_view()}
+        elif auto_plan_info is not None:
             # how the knobs above were resolved (AUTO_PLAN=1): the
             # planner's chosen row, so monitoring can tell a planned
             # topology from a hand-tuned one
@@ -667,7 +748,14 @@ def create_app(cfg: Optional[ServingConfig] = None,
     def healthz():
         live = {}
         from ..runtime.iterbatch import IterBatchingEngine as _IB
-        if isinstance(runner, _IB):
+        if switcher is not None:
+            # continuous mode: the pooled scheduler's stats stay
+            # visible whichever plan is active (its worker lives for
+            # the process; "active" rides the auto_plan block)
+            for _r in switcher.plans.values():
+                if isinstance(_r, _IB):
+                    live["iter_batch_stats"] = _r.stats()
+        elif isinstance(runner, _IB):
             # iteration-level scheduler: joins/segments/eos-retires
             # (spec_segments counts draft-verify segments when
             # SPEC_DECODE composes)
@@ -739,6 +827,29 @@ def create_app(cfg: Optional[ServingConfig] = None,
             "serving": _topology(),
             **graftscope.snapshot(n=n),
         }
+
+    @app.get("/debug/plan")
+    def debug_plan(query: dict):
+        """Continuous-planning decision state (utils/graftwatch): the
+        active plan, per-plan scores under the live windowed estimate,
+        calibrated byte weights, each plan's certified program cost,
+        the bounded switch-event journal (``?n=K`` caps events), and
+        the declared PLAN_SIGNALS provenance map with live signal
+        values. Off continuous mode the payload still answers (mode
+        "startup"/"off") so monitoring can tell WHY there is no switch
+        history instead of reading a 404."""
+        if switcher is None:
+            return {
+                "serving": _topology(),
+                "mode": "startup" if auto_plan_info is not None
+                else "off",
+                "auto_plan": auto_plan_info,
+            }
+        try:
+            n = int(query.get("n", "16"))
+        except ValueError:
+            return 422, {"detail": "n must be an integer"}
+        return {"serving": _topology(), **switcher.describe(n=n)}
 
     @app.post("/prefill")
     def prefill(req: PrefillReq, headers: dict):
@@ -903,40 +1014,65 @@ def create_app(cfg: Optional[ServingConfig] = None,
         # spec-only rounds/batches (policy equality keeps FIFO) and
         # decode through the batched verify loop.
         eng = runner
-        import dataclasses as _dc
+        plan_release = None
+        if switcher is not None:
+            # continuous mode: ONE admission observation per request,
+            # wave-boundary re-planning inside admit(), and the plan
+            # that serves THIS request returned — in-flight requests
+            # keep the runner they were admitted to across a switch
+            # (both front ends share every compiled program and the
+            # one block pool, so nothing leaks and nothing recompiles)
+            eng, plan_label = switcher.admit(len(prompt_ids),
+                                             req.max_new_tokens)
+            plan_release = switcher.release
+            tr = tracing.current_trace()
+            if tr is not None:
+                tr.labels.update(plan=plan_label)
+        # the try/finally opens HERE, not at the generate call: anything
+        # below can raise (the deadline pre-check especially — expired
+        # budgets are routine under the abandonment profile), and a
+        # skipped release would leak the watcher's in-flight estimate
+        # permanently, biasing every later plan decision wide
+        try:
+            import dataclasses as _dc
 
-        from ..runtime.batcher import BatchingEngine as _BE
-        from ..runtime.engine import DecodeEngine as _DE
-        from ..runtime.iterbatch import IterBatchingEngine as _IB
-        eligible = (spec_runner is not None
-                    and spec_runner.eligible(len(prompt_ids),
-                                             req.max_new_tokens))
-        if eligible and isinstance(runner, (_BE, _IB)):
-            sampling = _dc.replace(sampling, spec=True)
-        elif eligible and cfg.prefix_cache == 0:
-            eng = spec_runner
-        from ..runtime.kv_pool import PagedKVRunner as _PR
-        kw = {}
-        if eos_id is not None and isinstance(eng, (_DE, _IB, _PR)):
-            # segment-boundary early exit: stop_at_eos requests stop
-            # paying device time for dead tokens past the stop (tokens
-            # emitted are the exact prefix of the uncapped stream; the
-            # iter scheduler additionally frees the row's slot). Other
-            # runners (spec/prefix/admission-batcher/pipeline) keep the
-            # host-side truncation below — same wire result.
-            kw["eos_id"] = eos_id
-        if deadline is not None:
-            # the deadline budget is honored END-TO-END on the iter
-            # scheduler (queue wait, segment-boundary cancellation with
-            # blocks freed) and per-hop on remote dispatch; other
-            # runners at least refuse work the budget cannot cover
-            deadline.raise_if_expired("generate")
-            if isinstance(eng, _IB):
-                kw["deadline"] = deadline
-        result = eng.generate(np.asarray(prompt_ids),
-                              max_new_tokens=req.max_new_tokens,
-                              sampling=sampling,
-                              key=jax.random.PRNGKey(seed), **kw)
+            from ..runtime.batcher import BatchingEngine as _BE
+            from ..runtime.engine import DecodeEngine as _DE
+            from ..runtime.iterbatch import IterBatchingEngine as _IB
+            eligible = (spec_runner is not None
+                        and spec_runner.eligible(len(prompt_ids),
+                                                 req.max_new_tokens))
+            if eligible and isinstance(runner, (_BE, _IB)):
+                sampling = _dc.replace(sampling, spec=True)
+            elif eligible and cfg.prefix_cache == 0:
+                eng = spec_runner
+            from ..runtime.kv_pool import PagedKVRunner as _PR
+            kw = {}
+            if eos_id is not None and isinstance(eng, (_DE, _IB, _PR)):
+                # segment-boundary early exit: stop_at_eos requests stop
+                # paying device time for dead tokens past the stop
+                # (tokens emitted are the exact prefix of the uncapped
+                # stream; the iter scheduler additionally frees the
+                # row's slot). Other runners (spec/prefix/admission-
+                # batcher/pipeline) keep the host-side truncation below
+                # — same wire result.
+                kw["eos_id"] = eos_id
+            if deadline is not None:
+                # the deadline budget is honored END-TO-END on the iter
+                # scheduler (queue wait, segment-boundary cancellation
+                # with blocks freed) and per-hop on remote dispatch;
+                # other runners at least refuse work the budget cannot
+                # cover
+                deadline.raise_if_expired("generate")
+                if isinstance(eng, _IB):
+                    kw["deadline"] = deadline
+            result = eng.generate(np.asarray(prompt_ids),
+                                  max_new_tokens=req.max_new_tokens,
+                                  sampling=sampling,
+                                  key=jax.random.PRNGKey(seed), **kw)
+        finally:
+            if plan_release is not None:
+                plan_release()   # the watcher's in-flight estimate
         # row_tokens strips any left pad the engine introduced (chunked
         # prefill alignment); plain runs return the row unchanged
         return [int(t) for t in result.row_tokens(0)]
@@ -1117,9 +1253,15 @@ def create_app(cfg: Optional[ServingConfig] = None,
             # the solo paged runner rejects only what the pool could
             # never host right now.
             from ..runtime.iterbatch import IterBatchingEngine as _IB2
-            if isinstance(runner, _IB2):
-                ok, retry = runner.admission_load(len(prompt_ids),
-                                                  req.max_new_tokens)
+            # continuous mode gates against the ACTIVE plan (advisory,
+            # like every admission answer here: the worker's actual
+            # grant is the atomic admit_alloc path, so a wave switch
+            # between this gate and dispatch costs one queue beat,
+            # never a wrong failure)
+            gate_runner = runner if switcher is None else switcher.peek()
+            if isinstance(gate_runner, _IB2):
+                ok, retry = gate_runner.admission_load(
+                    len(prompt_ids), req.max_new_tokens)
             else:
                 need = kv_pool.allocator.blocks_for(
                     len(prompt_ids) + req.max_new_tokens)
@@ -1253,6 +1395,10 @@ def create_app(cfg: Optional[ServingConfig] = None,
             body["finish_reason"] = finish_reason
         return out(body)
 
+    # continuous mode's decision state, exposed for the in-suite pins
+    # (tests reach the certified plan set and the event journal through
+    # the app object; the wire surface is GET /debug/plan)
+    app.plan_switcher = switcher
     return app
 
 

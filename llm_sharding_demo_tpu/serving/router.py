@@ -47,7 +47,7 @@ from typing import Dict, List, Optional
 
 from ..fleet.affinity import HashRing, affinity_key
 from ..fleet.topology import FleetTopology, ReplicaHandle
-from ..utils import graftfault, graftsched, tracing
+from ..utils import graftfault, graftsched, graftwatch, tracing
 from ..utils.metrics import REGISTRY
 from .app import GenerateReq, parse_deadline_header, parse_request_identity
 from .http import JSONApp
@@ -256,6 +256,34 @@ class FleetRouter:
         return ([r for r in reps if r.name == primary]
                 + [r for r in by_load if r.name != primary])
 
+    def prefill_order(self, key: Optional[bytes]
+                      ) -> List[ReplicaHandle]:
+        """Candidate prefill replicas, best first: the ring walk
+        rotated to the content key's owner (deterministic warm spread
+        across N replicas), REORDERED by the watcher's per-replica
+        queue-depth estimate — the router's own in-flight counters,
+        which are what it can observe of each replica's backlog. The
+        sort is stable (graftwatch.order_by_queue_depth), so an idle
+        fleet keeps exact ring placement while a backed-up prefill
+        replica demotes past its peers instead of serializing every
+        warm behind it (graftfleet follow-on b: fanout was
+        first-replica-only in ring order)."""
+        prefills = self.topology.prefill_replicas
+        if not prefills or self.prefill_ring is None:
+            return list(prefills)
+        if key is None:
+            names = [p.name for p in prefills]
+        else:
+            primary = self.prefill_ring.pick(key)
+            start = next(i for i, p in enumerate(prefills)
+                         if p.name == primary)
+            names = [p.name for p in
+                     prefills[start:] + prefills[:start]]
+        load = self.inflight()
+        ordered = graftwatch.order_by_queue_depth(names, load)
+        by_name = {p.name: p for p in prefills}
+        return [by_name[n] for n in ordered]
+
 
 def create_router_app(topology: FleetTopology, tokenizer,
                       chunk: int = 64, registry=None, recorder=None,
@@ -335,18 +363,17 @@ def create_router_app(topology: FleetTopology, tokenizer,
             # Failure DEGRADES — the decode replica prefills cold. A
             # dead/unreachable replica falls over to the next prefill
             # replica (the registry is shared, so any of them can
-            # warm); the walk starts at the prefill ring's owner so
-            # warm traffic spreads deterministically across N
-            # replicas. A typed shed does NOT fall over: the pool is
-            # shared, so every prefill replica sees the same
-            # saturation.
+            # warm); the walk starts at the prefill ring's owner and
+            # is REORDERED by the watcher's per-replica queue-depth
+            # estimate (router.prefill_order), so warm traffic spreads
+            # deterministically across N idle replicas and routes
+            # around a backed-up one. A typed shed does NOT fall over:
+            # the pool is shared, so every prefill replica sees the
+            # same saturation.
             prefills = topology.prefill_replicas
             if prefills and key is not None:
-                primary = router.prefill_ring.pick(key)
-                start = next(i for i, p in enumerate(prefills)
-                             if p.name == primary)
                 warmed = False
-                for p in prefills[start:] + prefills[:start]:
+                for p in router.prefill_order(key):
                     t0 = time.perf_counter()
                     try:
                         router._note_start(p.name)

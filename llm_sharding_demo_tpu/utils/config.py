@@ -132,6 +132,24 @@ class ServingConfig:
     # a single interactive stream (the planner's default), which
     # reproduces the hand-tuned single-stream serving config.
     auto_plan_traffic: str = ""
+    # Continuous re-planning (utils/graftwatch): AUTO_PLAN_CONTINUOUS=1
+    # pre-builds and pre-certifies the switchable plan set at startup
+    # (solo paged admission <-> pooled iteration scheduling over ONE
+    # shared engine + block pool) and switches the serving plan between
+    # request waves from the telemetry watcher's windowed traffic-mix
+    # estimate. Requires the pooled iter composition (KV_POOL_BLOCKS,
+    # MAX_BATCH > 1, BATCH_MODE=iter — the batched plan IS the
+    # configured scheduler); the single-program features that own other
+    # compile spaces (SPEC_DECODE / PREFIX_CACHE / PREFILL_CHUNK /
+    # PP|TP|EP_DECODE) are excluded so every switch stays inside the
+    # certified program set. Decision state at GET /debug/plan;
+    # /healthz "auto_plan" reports the LIVE plan.
+    auto_plan_continuous: bool = False
+    # Bench journal (BENCH_full/BENCH_rNN.json path) whose
+    # graftscope_attribution / ici_byte_weight_calibration rows
+    # calibrate the continuous planner's byte weights at startup
+    # (graftwatch.fit_cost_weights). Empty = a-priori weights.
+    auto_plan_journal: str = ""
 
     def __post_init__(self):
         if self.shard_role not in VALID_ROLES:
@@ -204,6 +222,29 @@ class ServingConfig:
                     "pool-backed prefix store (KV_POOL_BLOCKS > 0 and "
                     "PREFIX_CACHE > 0): the content-keyed registry is "
                     "the prefill->decode block-handoff medium")
+        if self.auto_plan_continuous:
+            if (self.kv_pool_blocks <= 0 or self.max_batch <= 1
+                    or self.batch_mode != "iter"):
+                raise ValueError(
+                    "AUTO_PLAN_CONTINUOUS switches between the certified "
+                    "pooled plans (solo paged admission <-> iteration "
+                    "scheduling); it requires KV_POOL_BLOCKS > 0, "
+                    "MAX_BATCH > 1 and BATCH_MODE=iter")
+            if (self.spec_decode > 0 or self.prefix_cache > 0
+                    or self.prefill_chunk > 0 or self.pp_decode
+                    or self.tp_decode or self.ep_decode):
+                raise ValueError(
+                    "AUTO_PLAN_CONTINUOUS certifies exactly the "
+                    "solo-paged and pooled-iter program sets; "
+                    "SPEC_DECODE/PREFIX_CACHE/PREFILL_CHUNK/PP|TP|"
+                    "EP_DECODE own other compile spaces and would let "
+                    "a switch reach uncertified programs")
+        if self.auto_plan_journal and not self.auto_plan_continuous:
+            raise ValueError(
+                "AUTO_PLAN_JOURNAL calibrates the continuous planner's "
+                "byte weights; it needs AUTO_PLAN_CONTINUOUS=1 (a "
+                "silently ignored knob would misreport the serving "
+                "composition)")
         if self.kv_pool_blocks > 0 and self.max_seq % self.kv_block_size:
             raise ValueError(
                 f"MAX_SEQ={self.max_seq} must be a multiple of "
@@ -296,4 +337,6 @@ def from_env() -> ServingConfig:
         fleet_role=os.environ.get("FLEET_ROLE", ""),
         auto_plan=_env_bool("AUTO_PLAN"),
         auto_plan_traffic=os.environ.get("AUTO_PLAN_TRAFFIC", ""),
+        auto_plan_continuous=_env_bool("AUTO_PLAN_CONTINUOUS"),
+        auto_plan_journal=os.environ.get("AUTO_PLAN_JOURNAL", ""),
     )

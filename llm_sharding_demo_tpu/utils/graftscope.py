@@ -157,6 +157,36 @@ class ScopeState:
                 return 0.0
             return sum(v[1] for v in ring["programs"].values())
 
+    def _series_totals_locked(self) -> Dict[str, dict]:
+        # caller holds self._lock. Whole-ring reductions, independent
+        # of any ?n= window: a step-function series (hop_breaker_open
+        # samples only on HopPolicy TRANSITIONS) whose last point
+        # predates a windowed view would otherwise vanish while the
+        # breaker is still open — "last" is the series' CURRENT value
+        # by construction
+        out: Dict[str, dict] = {}
+        for (name, labels), pts in sorted(self._points.items()):
+            label = name + ("{%s}" % ",".join(
+                f"{k}={v}" for k, v in labels) if labels else "")
+            vals = [v for _, v in pts]
+            out[label] = {
+                "points": len(vals),
+                "last": vals[-1],
+                "max": max(vals),
+                "min": min(vals),
+            }
+        return out
+
+    def series_totals(self) -> Dict[str, dict]:
+        """The window-independent per-series reductions alone — walks
+        only the occupancy points, never the dispatch rings, so
+        consumers that want current values (the graftwatch signal
+        view, polled at /debug/plan) don't build the full per-scope
+        key tables under the lock every instrumented dispatch's
+        ``record`` contends on."""
+        with self._lock:
+            return self._series_totals_locked()
+
     def snapshot(self, n: int = 32) -> dict:
         """Bounded JSON view (the /debug/profile payload body): per-scope
         totals + the last ``n`` ring samples, per-series last ``n``
@@ -199,6 +229,7 @@ class ScopeState:
                 series[label] = [
                     [round((t - self.t0) * 1e3, 3), v]
                     for t, v in (list(pts)[-n:] if n else [])]
+            series_totals = self._series_totals_locked()
         return {
             "enabled": enabled(),
             "sync": sync_enabled(),
@@ -212,6 +243,7 @@ class ScopeState:
                       "truth, used by graftcheck scope attribution runs"),
             "dispatch": dispatch,
             "series": series,
+            "series_totals": series_totals,
         }
 
     # -- test isolation (tests/conftest.py) ----------------------------------
@@ -347,6 +379,10 @@ def program_keys(scope: str) -> Dict[tuple, Tuple[int, float]]:
 
 def scope_seconds(scope: str) -> float:
     return STATE.scope_seconds(scope)
+
+
+def series_totals() -> Dict[str, dict]:
+    return STATE.series_totals()
 
 
 def snapshot(n: int = 32) -> dict:

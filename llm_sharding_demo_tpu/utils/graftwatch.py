@@ -1,0 +1,781 @@
+"""graftwatch: telemetry-calibrated cost model + live re-planning.
+
+The dynamic half of the graftcheck watch pass (``tools/graftcheck/
+watch.py`` is the static half — the same static+dynamic split as
+graftsan/graftlock/graftfault/graftload). This module closes ROADMAP
+item 5's measure->model loop: the spine *measures* everything
+(graftscope occupancy series, graftload goodput, the fleet router's
+affinity/shed counters) and *plans* from an a-priori cost model
+(graftplan) — graftwatch is where the two halves meet, the "Learning
+to Shard" RL-co-optimization loop run inside the repo's own certifier
+as the safety envelope.
+
+Three pieces:
+
+**Telemetry watcher** (:class:`TelemetryWatcher`): folds the live
+signals into a windowed traffic-mix estimate. Every consumed signal is
+DECLARED in ``PLAN_SIGNALS`` — a mapping from the watcher's fixed
+``SIGNALS`` vocabulary to the ``METRIC_CATALOG`` series it is computed
+from (the mirror of loadgen's ``SLO_SOURCE_METRICS``); the watch pass
+verifies each mapped series exists and is really emitted, so the
+re-planner can never watch a number nobody measures. The DECISION
+inputs are deliberately narrower than the telemetry view: per-request
+observations ``(prompt_len, max_new, pending)`` recorded at admission,
+reduced order-independently (medians + window max), so the same
+admitted request set produces the same estimate regardless of thread
+interleaving — the replay-identity contract switch decisions inherit.
+
+**Calibration** (:func:`fit_cost_weights`): extends
+``costmodel.calibrate``'s single ICI byte weight to a fitted
+per-primitive pair. The journaled ``graftscope_attribution`` drift rows
+carry measured device s/token against modeled B/token per certified
+workload; a least-squares fit through the origin recovers
+``hbm_seconds_per_byte`` (what one streamed HBM byte costs this host)
+and, when any row moves ICI bytes, the RELATIVE ``ici_byte_weight`` the
+cost model's ranking uses (falling back to the journal's
+``ici_byte_weight_calibration`` row via ``costmodel.calibrate``).
+Present-but-unparsable rows raise ``costmodel.CalibrationError``
+(typed, like every other contract violation); genuinely skipped rows
+contribute nothing.
+
+**Live re-planning** (:class:`PlanSwitcher` + ``AUTO_PLAN_CONTINUOUS=1``
+in serving/app.py): a small plan set is PRE-CERTIFIED at startup — the
+front ends are built once, over ONE shared engine and ONE shared block
+pool, and each plan's compiled-program cost is proven by the
+``recompile`` certifier machinery (``certify_plan_set``). Between
+request waves (every ``wave`` admissions) the switcher scores the
+certified plans against the watcher's windowed estimate with the
+calibrated weights and installs the winner. The pinned invariant:
+**a plan switch causes zero recompiles beyond the certified set** —
+switching only re-routes admissions between pre-built front ends that
+share every compiled program population; the switcher can never
+construct a runner, and a switch target outside the certified set is a
+typed error (``UncertifiedPlanError``), statically excluded by the
+watch pass's ``uncertified-plan-switch`` rule. Every wave evaluation is
+journaled as a replay-identical event: the decision is a pure function
+of the windowed estimate + static plan costs + calibrated weights
+(:func:`decide_plan` — same purity contract as FaultPlan/GRAFTSCHED),
+and the event records exactly those inputs. The whole decision state is
+served at ``GET /debug/plan``; ``/healthz`` ``auto_plan`` reports the
+LIVE plan, not the startup choice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from . import graftsched, graftscope
+
+# Lock-discipline contract (tools/graftcheck locks pass): the watcher's
+# observation window and the switcher's active-plan/in-flight/event
+# state are touched from arbitrary handler threads; each lives under
+# its owning instance's ``_lock``. The two locks never nest (admission
+# takes them strictly in sequence), so no order relation is declared
+# beyond the single name.
+GUARDED_STATE = {"_window": "_lock", "_admitted": "_lock",
+                 "_active": "_lock", "_inflight": "_lock",
+                 "_events": "_lock", "_switches": "_lock"}
+LOCK_ORDER = ("_lock",)
+
+# -- declared signal provenance (the static watch pass reads these) ----------
+
+# The watcher's fixed consumed-signal vocabulary (the watch pass rejects
+# PLAN_SIGNALS keys outside it, and SIGNALS entries with no mapping).
+SIGNALS = ("queue_depth", "batch_occupancy", "pool_blocks", "live_rows",
+           "breaker_open", "prefix_hits", "prefix_misses",
+           "admission_sheds", "affinity_hits", "affinity_fallbacks",
+           "replica_sheds")
+
+# signal -> the METRIC_CATALOG series it is computed from (the mirror of
+# loadgen's SLO_SOURCE_METRICS; tools/graftcheck/watch.py verifies every
+# mapped series exists in the catalog and is emitted at a live call
+# site — a re-planner watching a series nobody emits would converge on
+# noise). Gauges are read off the graftscope occupancy rings (the
+# /debug/profile timeline), counters off the serving registry.
+PLAN_SIGNALS = {
+    "queue_depth": "queue_depth",
+    "batch_occupancy": "batch_occupancy",
+    "pool_blocks": "kv_cache_blocks_in_use",
+    "live_rows": "iter_live_rows",
+    "breaker_open": "hop_breaker_open",
+    "prefix_hits": "prefix_cache_hits_total",
+    "prefix_misses": "prefix_cache_misses_total",
+    "admission_sheds": "kv_pool_admission_rejections_total",
+    "affinity_hits": "fleet_affinity_hits_total",
+    "affinity_fallbacks": "fleet_affinity_fallbacks_total",
+    "replica_sheds": "fleet_sheds_total",
+}
+
+# The switchable plan set. Every label the switcher can ever install
+# must be declared here, and every label must be constructed (and
+# certified) by one of the PLAN_BUILDERS functions — the watch pass's
+# uncertified-plan-switch rule holds both directions, which is the
+# static half of the "no switch path can reach an uncertified program
+# key" invariant (PlanSwitcher enforces the dynamic half with typed
+# errors).
+PLAN_SET = ("solo", "batched")
+PLAN_BUILDERS = ("build_plan_set", "certify_plan_set", "plan_costs")
+
+
+def signal_series(signal: str) -> str:
+    """The METRIC_CATALOG series a consumed signal is computed from —
+    THE provenance choke point: every read of live telemetry by name
+    resolves through the declared mapping, never a bare string."""
+    try:
+        return PLAN_SIGNALS[signal]
+    except KeyError:
+        raise KeyError(
+            f"unknown plan signal {signal!r}; declared: {SIGNALS}"
+        ) from None
+
+
+class UncertifiedPlanError(ValueError):
+    """A switch path reached a plan label outside the certified set —
+    the dynamic half of the watch pass's uncertified-plan-switch rule."""
+
+
+# -- windowed traffic-mix estimate -------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficEstimate:
+    """The windowed mix the decision function consumes. All fields are
+    ORDER-INDEPENDENT reductions of the admission window (medians over
+    the multiset, max over pending), so any interleaving of the same
+    admitted requests yields the same estimate — which is what makes
+    the journaled switch events replay-identical."""
+
+    requests: int = 0
+    prompt_p50: int = 0
+    max_new_p50: int = 0
+    # 1 + the window's max in-flight count observed at admission: the
+    # effective batch the cost model's weight-stream amortization sees
+    concurrency: int = 1
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _median_int(values: List[int]) -> int:
+    if not values:
+        return 0
+    vs = sorted(values)
+    return int(vs[(len(vs) - 1) // 2])
+
+
+class TelemetryWatcher:
+    """Windowed traffic-mix estimator over per-request admission
+    observations, plus the declared-signal telemetry view
+    (:meth:`signals`) the /debug/plan payload serves."""
+
+    def __init__(self, window: int = 16, registry=None):
+        if window < 1:
+            raise ValueError("window must be >= 1")
+        from .metrics import REGISTRY
+        self.registry = registry if registry is not None else REGISTRY
+        self._lock = graftsched.lock("graftwatch.TelemetryWatcher._lock")
+        self._window: deque = deque(maxlen=window)
+        self._admitted = 0
+
+    def observe(self, prompt_len: int, max_new: int,
+                pending: int) -> int:
+        """Record one admission; returns the total admitted so far (the
+        switcher's wave counter). ``pending`` is the number of requests
+        already in flight when this one was admitted."""
+        with self._lock:
+            self._window.append((int(prompt_len), int(max_new),
+                                 int(pending)))
+            self._admitted += 1
+            return self._admitted
+
+    def admitted(self) -> int:
+        with self._lock:
+            return self._admitted
+
+    def estimate(self) -> TrafficEstimate:
+        with self._lock:
+            rows = list(self._window)
+        if not rows:
+            return TrafficEstimate()
+        return TrafficEstimate(
+            requests=len(rows),
+            prompt_p50=_median_int([r[0] for r in rows]),
+            max_new_p50=_median_int([r[1] for r in rows]),
+            concurrency=1 + max(r[2] for r in rows))
+
+    def signals(self, since_ms: Optional[float] = None) -> dict:
+        """The declared-signal telemetry view: per consumed signal, the
+        live reduction of its mapped series — gauge signals reduce the
+        graftscope occupancy ring (points/mean/max/last, optionally
+        windowed to ``since_ms`` on the snapshot timeline), counter
+        signals read the registry's current totals summed over label
+        sets. Purely observational (the decision function never reads
+        this — see the module docstring's purity contract); served at
+        /debug/plan so an operator can see what the watcher sees."""
+        from .metrics import METRIC_CATALOG
+        # the totals-only read: never builds the dispatch snapshot
+        # under the lock every instrumented jit dispatch contends on
+        series = graftscope.series_totals()
+        flat = self.registry.snapshot()
+        out: Dict[str, dict] = {}
+        for signal in SIGNALS:
+            name = signal_series(signal)
+            kind = METRIC_CATALOG.get(name)
+            if kind == "gauge":
+                rows = {label: dict(tot) for label, tot in series.items()
+                        if label == name or label.startswith(name + "{")}
+                out[signal] = {"series": name, "kind": "gauge",
+                               "points": rows}
+            else:
+                total = sum(v for key, v in flat.items()
+                            if key == name or key.startswith(name + "{"))
+                out[signal] = {"series": name, "kind": "counter",
+                               "total": total}
+        return out
+
+
+# -- calibrated cost weights -------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CostWeights:
+    """The calibrated per-primitive byte weights plan scoring uses.
+    ``hbm_seconds_per_byte`` converts a modeled byte cost into predicted
+    device seconds on THIS host (None until a journal is fitted);
+    ``ici_byte_weight`` is the cost model's RELATIVE ICI-vs-HBM weight
+    (None -> the a-priori ``costmodel.ICI_BYTE_WEIGHT``)."""
+
+    hbm_seconds_per_byte: Optional[float] = None
+    ici_byte_weight: Optional[float] = None
+    per_scope_seconds: Tuple[Tuple[str, float], ...] = ()
+    rows_used: int = 0
+    source: str = "a-priori"
+
+    @classmethod
+    def apriori(cls) -> "CostWeights":
+        return cls()
+
+    def to_dict(self) -> dict:
+        return {
+            "hbm_seconds_per_byte": self.hbm_seconds_per_byte,
+            "ici_byte_weight": self.ici_byte_weight,
+            "per_scope_seconds": {k: round(v, 6)
+                                  for k, v in self.per_scope_seconds},
+            "rows_used": self.rows_used,
+            "source": self.source,
+        }
+
+
+def _attribution_row(journal) -> Optional[dict]:
+    """The ``graftscope_attribution`` config row out of a bench journal
+    (raw payload, ``parsed`` driver wrapper, or the bare row itself) —
+    the same acceptance envelope as ``costmodel.calibrate``."""
+    doc = journal
+    if isinstance(doc, dict) and "parsed" in doc:
+        doc = doc.get("parsed")
+    if not isinstance(doc, dict):
+        return None
+    if doc.get("name") == "graftscope_attribution":
+        return doc
+    for cfg in doc.get("configs") or ():
+        if isinstance(cfg, dict) \
+                and cfg.get("name") == "graftscope_attribution":
+            return cfg
+    return None
+
+
+def fit_cost_weights(journal) -> CostWeights:
+    """Fit per-primitive byte weights from a bench journal's
+    ``graftscope_attribution`` drift rows (measured device s/token vs
+    modeled B/token per certified workload).
+
+    Each usable workload row contributes one equation
+    ``measured_s = w_hbm * hbm_bytes + w_ici_s * comm_bytes`` (the
+    modeled HBM term is the row's total byte cost with the a-priori ICI
+    weighting removed); the least-squares solution through the origin
+    gives ``hbm_seconds_per_byte`` and — when any row moved ICI bytes —
+    the relative ``ici_byte_weight`` as the ratio of the two fitted
+    rates. With zero ICI-moving rows (the CPU attribution set), the ICI
+    weight falls back to the journal's ``ici_byte_weight_calibration``
+    row via ``costmodel.calibrate``.
+
+    Returns the a-priori weights (``rows_used == 0``) when the journal
+    carries no attribution row or only skipped rows; raises
+    ``costmodel.CalibrationError`` when a row is PRESENT but
+    unparsable — a malformed measurement must fail loudly, never score
+    plans as if it had been read."""
+    from tools.graftcheck import costmodel as C
+    row = _attribution_row(journal)
+    # calibrate's CalibrationError propagates: a malformed ICI row must
+    # fail this fit too, never degrade it to a-priori weights
+    ici = C.calibrate(journal)
+    if row is None or row.get("skipped") or row.get("error"):
+        return CostWeights(ici_byte_weight=ici,
+                           source="a-priori" if ici is None
+                           else "ici-row-only")
+    workloads = row.get("workloads")
+    if not isinstance(workloads, list):
+        raise C.CalibrationError(
+            "graftscope_attribution row carries no 'workloads' list — "
+            "present but unparsable (malformed journal?)")
+    eqs: List[Tuple[float, float, float]] = []   # (hbm, comm, measured)
+    scope_secs: Dict[str, float] = {}
+    for wl in workloads:
+        if not isinstance(wl, dict):
+            raise C.CalibrationError(
+                f"graftscope_attribution workload row is not an object: "
+                f"{wl!r}")
+        m = wl.get("measured_decode_seconds_per_token")
+        if m is None:
+            continue                      # honestly unmeasured: skip
+        cost = wl.get("modeled_cost_bytes_per_token")
+        comm = wl.get("modeled_comm_bytes_per_token", 0)
+        if not isinstance(m, (int, float)) or isinstance(m, bool) \
+                or not isinstance(cost, (int, float)) \
+                or isinstance(cost, bool) or m <= 0 or cost <= 0 \
+                or not isinstance(comm, (int, float)) \
+                or isinstance(comm, bool) or comm < 0:
+            raise C.CalibrationError(
+                "graftscope_attribution workload "
+                f"{wl.get('workload')!r}: measured/modeled fields are "
+                "present but not positive numbers — refusing to fit "
+                "weights from an unparsable row")
+        # undo the a-priori ICI weighting baked into the scored total:
+        # the attribution run priced comm at ICI_BYTE_WEIGHT
+        hbm = float(cost) - C.ICI_BYTE_WEIGHT * float(comm)
+        if hbm <= 0:
+            raise C.CalibrationError(
+                f"graftscope_attribution workload {wl.get('workload')!r}"
+                ": modeled HBM term is non-positive after removing the "
+                "ICI weighting — the row's byte split is inconsistent")
+        eqs.append((hbm, float(comm), float(m)))
+        for name, ep in (wl.get("entry_points") or {}).items():
+            secs = (ep or {}).get("seconds_total")
+            if isinstance(secs, (int, float)) and not isinstance(
+                    secs, bool):
+                scope_secs[name] = scope_secs.get(name, 0.0) + float(secs)
+    if not eqs:
+        return CostWeights(ici_byte_weight=ici,
+                           source="ici-row-only" if ici is not None
+                           else "a-priori")
+    shh = sum(h * h for h, _, _ in eqs)
+    shc = sum(h * c for h, c, _ in eqs)
+    scc = sum(c * c for _, c, _ in eqs)
+    shm = sum(h * m for h, _, m in eqs)
+    scm = sum(c * m for _, c, m in eqs)
+    det = shh * scc - shc * shc
+    if scc > 0 and det > 0:
+        w_h = (shm * scc - scm * shc) / det
+        w_c = (scm * shh - shm * shc) / det
+        if w_h > 0 and w_c > 0:
+            ici = w_c / w_h
+        else:                 # degenerate fit: keep the 1-D projection
+            w_h = shm / shh
+    else:
+        w_h = shm / shh
+    return CostWeights(
+        hbm_seconds_per_byte=(w_h if w_h > 0 else None),
+        ici_byte_weight=ici,
+        per_scope_seconds=tuple(sorted(scope_secs.items())),
+        rows_used=len(eqs),
+        source="graftscope_attribution")
+
+
+# -- the certified plan set --------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanCost:
+    """The static per-plan cost terms (costmodel's decode-cost formula
+    with the traffic-dependent amortization factored out) — precomputed
+    at startup so wave-boundary scoring is a handful of float ops."""
+
+    label: str
+    batch_mode: str
+    max_batch: int
+    param_bytes: int
+    kv_bytes_per_row: int
+    paged_overhead: float
+    comm_bytes: int = 0
+
+    def simplicity(self) -> tuple:
+        # the tie-break mirror of costmodel.PlanRow.sort_key: admission
+        # before iter, narrower before wider
+        return (self.batch_mode != "admission", self.max_batch)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def plan_costs(config, max_seq: int,
+               max_batch: int) -> Dict[str, PlanCost]:
+    """The switchable plans' static cost terms, from THE cost model's
+    own byte math (``tools/graftcheck/costmodel``) — the planner that
+    scored candidates at startup and the watcher that re-scores them
+    live cannot use different arithmetic."""
+    from llm_sharding_demo_tpu.models import family_module
+    from tools.graftcheck import costmodel as C
+    module = family_module(config)
+    param_bytes = C.tree_bytes(C.param_avals(module, config))
+    kv_row = C.kv_cache_bytes(config, 1, max_seq)
+    paged_overhead = 2 * kv_row / C.PAGED_SEG_STEPS
+    return {
+        "solo": PlanCost(label="solo", batch_mode="admission",
+                         max_batch=1, param_bytes=param_bytes,
+                         kv_bytes_per_row=kv_row,
+                         paged_overhead=paged_overhead),
+        "batched": PlanCost(label="batched", batch_mode="iter",
+                            max_batch=max_batch, param_bytes=param_bytes,
+                            kv_bytes_per_row=kv_row,
+                            paged_overhead=paged_overhead),
+    }
+
+
+def certify_plan_set(config, max_seq: int, max_batch: int,
+                     pool_blocks: int, block_size: int,
+                     traffic=None) -> Dict[str, dict]:
+    """Prove the compiled-program cost of every switchable plan through
+    the EXISTING certifier machinery (``recompile`` via
+    ``costmodel.count_programs``) for the declared traffic classes.
+    The solo row is exact (certified == observed, the recompile.certify
+    guarantee); the iter row is the documented static bound over live
+    widths 1..max_batch. The switcher journals these and refuses any
+    label without an entry — no switch path can reach an uncertified
+    program key."""
+    from tools.graftcheck import costmodel as C
+    if isinstance(traffic, str):
+        traffic = C.parse_traffic(traffic)
+    traffic = tuple(traffic) if traffic else C.DEFAULT_TRAFFIC
+    cands = {
+        "solo": C.Candidate(topology="single", batch_mode="admission",
+                            max_batch=1, kv_pool_blocks=pool_blocks,
+                            kv_block_size=block_size),
+        "batched": C.Candidate(topology="single", batch_mode="iter",
+                               max_batch=max_batch,
+                               kv_pool_blocks=pool_blocks,
+                               kv_block_size=block_size),
+    }
+    out: Dict[str, dict] = {}
+    for label, cand in cands.items():
+        programs, exact = C.count_programs(cand, max_seq, traffic)
+        out[label] = {
+            "programs": dict(programs),
+            "program_total": sum(programs.values()),
+            "programs_exact": exact,
+            "candidate": dataclasses.asdict(cand),
+        }
+    return out
+
+
+def build_plan_set(engine, pool, config, max_seq: int, max_batch: int,
+                   traffic=None, batch_wait_ms: float = 5.0,
+                   ) -> Tuple[Dict[str, object], Dict[str, PlanCost],
+                              Dict[str, dict]]:
+    """Construct the switchable front ends over ONE shared engine and
+    ONE shared block pool — built once, at startup, which is the whole
+    recompile argument: a switch re-routes admissions between runners
+    whose compiled-program populations already exist; it can never
+    construct a runner (and therefore never mint a program population
+    the certifier did not price). Returns ``(plans, costs, certified)``
+    keyed by ``PLAN_SET``."""
+    from llm_sharding_demo_tpu.runtime.iterbatch import IterBatchingEngine
+    from llm_sharding_demo_tpu.runtime.kv_pool import PagedKVRunner
+    plans = {
+        "solo": PagedKVRunner(engine, pool),
+        "batched": IterBatchingEngine(engine, max_batch=max_batch,
+                                      max_wait_ms=batch_wait_ms,
+                                      pool=pool),
+    }
+    costs = plan_costs(config, max_seq, max_batch)
+    certified = certify_plan_set(config, max_seq, max_batch,
+                                 pool.allocator.num_blocks,
+                                 pool.block_size, traffic=traffic)
+    return plans, costs, certified
+
+
+# -- the pure decision function ----------------------------------------------
+
+
+def score_plans(estimate: TrafficEstimate,
+                costs: Dict[str, PlanCost],
+                weights: CostWeights) -> Dict[str, float]:
+    """Modeled decode byte-cost per token of each certified plan under
+    the estimated mix — costmodel.score_candidate's formula with the
+    calibrated ICI weight, restricted to the static terms the plan set
+    spans. Pure: same (estimate, costs, weights) -> same scores. (The
+    a-priori import only fires with an unresolved weight — the
+    switcher pre-resolves its weights at construction so the
+    wave-boundary path never pays import machinery under its hold.)"""
+    ici_w = weights.ici_byte_weight
+    if not ici_w:
+        from tools.graftcheck.costmodel import ICI_BYTE_WEIGHT as ici_w
+    out: Dict[str, float] = {}
+    for label, pc in costs.items():
+        eff = max(1, min(pc.max_batch, estimate.concurrency))
+        out[label] = (pc.param_bytes / eff + pc.kv_bytes_per_row
+                      + pc.paged_overhead + ici_w * pc.comm_bytes)
+    return out
+
+
+def decide_plan(estimate: TrafficEstimate, costs: Dict[str, PlanCost],
+                weights: CostWeights, current: str,
+                margin: float = 0.1) -> Tuple[str, Dict[str, float]]:
+    """The switch decision: best-scoring plan (simplicity tie-break, the
+    sort_key mirror), installed only past the hysteresis ``margin`` —
+    unless the best plan is no costlier AND simpler, which is the
+    traffic-drained switch-back (equal scores, narrower plan wins).
+    PURE (no clock, no RNG, no ambient state): the journaled event's
+    inputs replay to the journaled decision, the FaultPlan/GRAFTSCHED
+    replay-identity contract."""
+    scores = score_plans(estimate, costs, weights)
+    best = min(costs, key=lambda lb: (scores[lb],
+                                      costs[lb].simplicity(), lb))
+    return _pick(best, current, scores, costs, margin), scores
+
+
+def _pick(best: str, current: str, scores: Dict[str, float],
+          costs: Dict[str, PlanCost], margin: float) -> str:
+    """Hysteresis: install ``best`` only past ``margin``, or on an
+    equal score when it is strictly simpler (the switch-back path)."""
+    if best == current:
+        return current
+    cur = scores.get(current)
+    if cur is None:
+        return best
+    if scores[best] < cur * (1.0 - margin):
+        return best
+    if scores[best] <= cur and costs[best].simplicity() \
+            < costs[current].simplicity():
+        return best
+    return current
+
+
+# -- the switcher ------------------------------------------------------------
+
+
+class PlanSwitcher:
+    """Routes admissions to the active pre-certified plan and
+    re-evaluates between request waves. Every label it can install is
+    pinned to the certified set at construction (typed
+    ``UncertifiedPlanError`` otherwise); every wave evaluation is
+    journaled with its full decision inputs."""
+
+    HISTORY = 128       # bounded event journal (a ring, not a log)
+
+    def __init__(self, plans: Dict[str, object],
+                 costs: Dict[str, PlanCost],
+                 certified: Dict[str, dict],
+                 watcher: TelemetryWatcher,
+                 weights: Optional[CostWeights] = None,
+                 initial: Optional[str] = None, wave: int = 8,
+                 margin: float = 0.1, registry=None):
+        if not plans:
+            raise ValueError("empty plan set")
+        labels = set(plans)
+        if labels != set(costs) or labels != set(certified):
+            raise UncertifiedPlanError(
+                f"plan set {sorted(labels)} does not match costs "
+                f"{sorted(costs)} / certified {sorted(certified)} — "
+                "every switchable plan must be priced AND certified")
+        for label in labels:
+            if label not in PLAN_SET:
+                raise UncertifiedPlanError(
+                    f"plan label {label!r} is not in the declared "
+                    f"PLAN_SET {PLAN_SET}")
+        if wave < 1:
+            raise ValueError("wave must be >= 1")
+        from .metrics import REGISTRY
+        self.registry = registry if registry is not None else REGISTRY
+        self.plans = dict(plans)
+        self.costs = dict(costs)
+        self.certified = dict(certified)
+        self.watcher = watcher
+        self.weights = weights if weights is not None \
+            else CostWeights.apriori()
+        if not self.weights.ici_byte_weight:
+            # resolve the a-priori weight ONCE, here, so the
+            # wave-boundary decision path never imports under its hold
+            from tools.graftcheck.costmodel import ICI_BYTE_WEIGHT
+            self.weights = dataclasses.replace(
+                self.weights, ici_byte_weight=ICI_BYTE_WEIGHT)
+        self.wave = int(wave)
+        self.margin = float(margin)
+        self._lock = graftsched.lock("graftwatch.PlanSwitcher._lock")
+        # start on the simplest plan (the costmodel tie-break): under
+        # the default single-stream estimate every plan scores equal,
+        # and simplicity is the declared preference
+        start = initial if initial is not None else min(
+            self.costs, key=lambda lb: (self.costs[lb].simplicity(), lb))
+        if start not in self.plans:
+            raise UncertifiedPlanError(
+                f"initial plan {start!r} is not in the certified set "
+                f"{sorted(self.plans)}")
+        self._active = start
+        self._inflight = 0
+        self._switches = 0
+        self._events: deque = deque(maxlen=self.HISTORY)
+        self._announce(start)
+
+    # -- admission routing --
+
+    def peek(self):
+        """The active runner, without admitting work (serving's 429
+        gate reads this before committing the request)."""
+        with self._lock:
+            return self.plans[self._active]
+
+    def admit(self, prompt_len: int, max_new: int):
+        """Observe one admission, evaluate at wave boundaries, and
+        return ``(runner, label)`` for THIS request. Pair with
+        :meth:`release` (try/finally) so the in-flight estimate stays
+        conservation-true."""
+        with self._lock:
+            pending = self._inflight
+            self._inflight += 1
+        n = self.watcher.observe(prompt_len, max_new, pending)
+        if n % self.wave == 0:
+            self._evaluate(n)
+        with self._lock:
+            label = self._active
+            return self.plans[label], label
+
+    def release(self) -> None:
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+
+    # -- the wave evaluation --
+
+    def _evaluate(self, admitted: int) -> None:
+        est = self.watcher.estimate()
+        t_ms = round(graftscope.now_ms(), 3)
+        switched_from: Optional[str] = None
+        with self._lock:
+            current = self._active
+            # the decision runs pure float math over static inputs
+            # (ici weight resolved at construction — no import, no
+            # blocking call under this hold), and read+install is ONE
+            # atomic region: a peer wave cannot interleave between
+            # reading `current` and acting on it
+            decision, scores = decide_plan(est, self.costs, self.weights,
+                                           current, margin=self.margin)
+            if decision not in self.plans:
+                raise UncertifiedPlanError(
+                    f"switch decision {decision!r} outside the "
+                    f"certified set {sorted(self.plans)}")
+            if decision != current:
+                self._active = decision
+                self._switches += 1
+                switched_from = current
+            self._events.append({
+                "wave": admitted // self.wave,
+                "admitted": admitted,
+                "estimate": est.to_dict(),
+                "scores": {lb: round(s, 1) for lb, s in scores.items()},
+                "from": current,
+                "to": decision,
+                "switched": decision != current,
+                # wall-clock context only — replay identity is over
+                # the event MINUS this field (strip_time in events())
+                "t_ms": t_ms,
+            })
+        if switched_from is not None:
+            self._announce(decision, previous=switched_from)
+
+    def _announce(self, label: str, previous: Optional[str] = None):
+        # metric emission stays OUTSIDE every hold (graftlock's
+        # blocking-under-lock discipline)
+        reg = self.registry
+        if previous is not None:
+            reg.inc("plan_switches_total", **{"from": previous,
+                                              "to": label})
+            reg.gauge("auto_plan_active", 0.0, plan=previous)
+            graftscope.sample("auto_plan_active", 0.0, plan=previous)
+        reg.gauge("auto_plan_active", 1.0, plan=label)
+        graftscope.sample("auto_plan_active", 1.0, plan=label)
+
+    # -- observability --
+
+    def events(self, n: Optional[int] = None,
+               strip_time: bool = False) -> List[dict]:
+        """The journaled wave evaluations (oldest first, bounded).
+        ``strip_time=True`` drops the wall-clock context field — what
+        the replay-identity pins compare."""
+        with self._lock:
+            rows = list(self._events)
+        if n is not None:
+            rows = rows[-n:]
+        if strip_time:
+            rows = [{k: v for k, v in r.items() if k != "t_ms"}
+                    for r in rows]
+        return rows
+
+    def switch_history(self, n: Optional[int] = None) -> List[dict]:
+        return [e for e in self.events(n=None) if e["switched"]][
+            -(n or self.HISTORY):]
+
+    def health_view(self) -> dict:
+        """The live /healthz ``auto_plan`` block: continuous mode's
+        current state, not the startup choice."""
+        # the watcher's lock is taken OUTSIDE the switcher's hold (the
+        # declared contract: the two locks never nest)
+        admitted = self.watcher.admitted()
+        with self._lock:
+            return {"mode": "continuous", "active": self._active,
+                    "switches": self._switches,
+                    "admitted": admitted,
+                    "wave": self.wave,
+                    "plans": sorted(self.plans)}
+
+    def describe(self, n: int = 16) -> dict:
+        """The GET /debug/plan payload body: current plan, candidate
+        scores under the live estimate, calibrated weights, certified
+        program costs, switch history, and the declared signal map."""
+        est = self.watcher.estimate()
+        scores = score_plans(est, self.costs, self.weights)
+        with self._lock:
+            active = self._active
+            switches = self._switches
+        rows = []
+        for label in sorted(self.plans):
+            pc = self.costs[label]
+            cert = self.certified[label]
+            row = {"label": label, "active": label == active,
+                   "batch_mode": pc.batch_mode,
+                   "max_batch": pc.max_batch,
+                   "cost_terms": pc.to_dict(),
+                   "score_bytes_per_token": round(scores[label], 1),
+                   "certified": cert}
+            if self.weights.hbm_seconds_per_byte:
+                row["predicted_seconds_per_token"] = round(
+                    scores[label] * self.weights.hbm_seconds_per_byte, 8)
+            rows.append(row)
+        return {
+            "mode": "continuous",
+            "active": active,
+            "switches": switches,
+            "wave": self.wave,
+            "margin": self.margin,
+            "admitted": self.watcher.admitted(),
+            "estimate": est.to_dict(),
+            "calibrated_weights": self.weights.to_dict(),
+            "plans": rows,
+            "events": self.events(n=n),
+            "signals": dict(PLAN_SIGNALS),
+            "signal_values": self.watcher.signals(),
+        }
+
+
+# -- queue-depth ordering (the fleet router's prefill fanout) ----------------
+
+
+def order_by_queue_depth(candidates: List[str],
+                         depth_of: Dict[str, int]) -> List[str]:
+    """Order replica names by the watcher's per-replica queue-depth
+    estimate, ascending; the sort is STABLE, so callers pass candidates
+    in their deterministic fallback order (the consistent-hash ring
+    walk) and idle fleets keep the ring's warm-spread placement while a
+    backed-up replica demotes past its peers. Pure — the seeded
+    two-prefill-replica pin replays it exactly."""
+    return sorted(candidates, key=lambda name: depth_of.get(name, 0))

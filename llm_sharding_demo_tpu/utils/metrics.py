@@ -148,6 +148,14 @@ METRIC_CATALOG: Dict[str, str] = {
     "kv_cache_blocks_total": "gauge",
     "jit_program_cache_size": "gauge",      # compiled programs per component
     "spec_acceptance_rate": "gauge",        # emitted tokens per verify
+    # continuous planning (utils/graftwatch.py): one increment per live
+    # plan switch (labeled from/to — the certified set is tiny, so the
+    # label space is bounded by construction), and a per-plan 0/1 gauge
+    # naming the ACTIVE plan. The gauge doubles as a graftscope
+    # occupancy series, so a graftload run sees plan switches on the
+    # same timeline as queue depth and pool blocks.
+    "plan_switches_total": "counter",
+    "auto_plan_active": "gauge",
 }
 
 # Metric names that USED to exist and were replaced: a call site (or a

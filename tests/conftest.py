@@ -79,6 +79,7 @@ _QUICK_MODULES = {
     "test_graftscope",      # device-time attribution + bench_diff gate
     "test_graftload",       # open-loop load harness + declared SLOs
     "test_graftfleet",      # disaggregated fleet: router, handoff, pass
+    "test_graftwatch",      # continuous re-planning: watcher, switcher
 }
 
 

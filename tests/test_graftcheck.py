@@ -168,6 +168,20 @@ def test_repo_passes_graftcheck():
     assert fpol2.get("llm_sharding_demo_tpu/fleet/affinity.py", 0) >= 1, (
         "fleet/affinity.py: the affinity key is no longer derived from "
         "the declared AFFINITY_KEY_SOURCE")
+    assert payload["watch_checks"] >= 10, (
+        "graftwatch watch pass went vacuous — a new "
+        "plan-signal-without-source / uncertified-plan-switch finding "
+        "anywhere in the tree fails this strict run (rule fixtures in "
+        "tests/test_graftwatch.py)")
+    assert payload["watch_vacuous"] == [], (
+        "watch contract declarations resolving to nothing live (the "
+        "re-planner went blind or uncertified): "
+        f"{payload['watch_vacuous']}")
+    # every consumed signal resolves to a live emitted series
+    assert payload["watch_signals"].get(
+        "llm_sharding_demo_tpu/utils/graftwatch.py", 0) >= 10, (
+        "utils/graftwatch.py: PLAN_SIGNALS no longer resolves the "
+        "declared signal vocabulary to emitted METRIC_CATALOG series")
     assert payload["suppressed"] >= 1, (
         "the documented sync points should be baselined findings — an "
         "empty suppression set means the host-sync rule stopped seeing "

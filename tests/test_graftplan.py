@@ -499,6 +499,8 @@ def test_verifier_json_schema_shape():
                             "slo_policies", "slo_vacuous",
                             "fleet_checks", "fleet_policies",
                             "fleet_vacuous",
+                            "watch_checks", "watch_signals",
+                            "watch_vacuous",
                             "recompile_bounds"}
     assert isinstance(payload["ok"], bool)
     assert isinstance(payload["sanitize_checks"], int)
@@ -517,6 +519,9 @@ def test_verifier_json_schema_shape():
     assert isinstance(payload["fleet_checks"], int)
     assert isinstance(payload["fleet_policies"], dict)
     assert isinstance(payload["fleet_vacuous"], list)
+    assert isinstance(payload["watch_checks"], int)
+    assert isinstance(payload["watch_signals"], dict)
+    assert isinstance(payload["watch_vacuous"], list)
     assert isinstance(payload["strict"], bool)
     assert isinstance(payload["findings"], list)
     assert isinstance(payload["suppressed"], int)

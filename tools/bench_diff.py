@@ -66,7 +66,13 @@ _HIGHER_BETTER = ("tokens_per_sec", "tokens_per_second", "speedup",
 _LOWER_BETTER = ("_ms", "latency", "step_ms", "prefill_ms",
                  # traffic_mix occupancy join: deeper queues at the
                  # same offered rate = the serving stack fell behind
-                 "queue_depth")
+                 "queue_depth",
+                 # plan_switch row (graftwatch): compiled programs
+                 # minted past the pre-certified plan set — the pinned
+                 # invariant is ZERO, so any upward drift is a
+                 # certified-envelope leak, the worst kind of
+                 # regression a live re-planner can have
+                 "recompile")
 # environment properties, not code performance: the tunnel's RTT, the
 # reference CPU's own rate, and the attribution run's host-dependent
 # byte rates vary by machine/route — comparing them across rounds would

@@ -57,7 +57,8 @@ def run(root: str = None, lint_only: bool = False,
     if added:
         sys.path.insert(0, root)
     try:
-        from . import faults, fleet, lint, locks, sanitize, scope, slo
+        from . import faults, fleet, lint, locks, sanitize, scope, slo, \
+            watch
         findings = list(lint.run_lint(root))
         san, sanitize_checks = sanitize.run_sanitize(root)
         findings.extend(san)
@@ -71,6 +72,8 @@ def run(root: str = None, lint_only: bool = False,
         findings.extend(sl)
         ft, fleet_summary = fleet.run_fleet(root)
         findings.extend(ft)
+        wt, watch_summary = watch.run_watch(root)
+        findings.extend(wt)
         semantic_checks = 0
         bounds = {}
         if not lint_only:
@@ -116,12 +119,16 @@ def run(root: str = None, lint_only: bool = False,
         # and on a VACUOUS fleet contract (topology declarations —
         # HANDOFF_POLICY / HOP_SCOPES / HANDOFF_SCOPES /
         # AFFINITY_KEY_SOURCE — matching nothing live)
+        # and on a VACUOUS watch contract (PLAN_SIGNALS resolving to no
+        # live emitted series, or a PLAN_SET no builder constructs —
+        # the live re-planner went blind or uncertified)
         "ok": (not active and not (strict and stale)
                and not (strict and locks_summary["vacuous"])
                and not (strict and scope_summary["vacuous"])
                and not (strict and faults_summary["vacuous"])
                and not (strict and slo_summary["vacuous"])
-               and not (strict and fleet_summary["vacuous"])),
+               and not (strict and fleet_summary["vacuous"])
+               and not (strict and watch_summary["vacuous"])),
         "strict": strict,
         "findings": [f.to_dict() for f in active],
         "suppressed": len(suppressed),
@@ -144,6 +151,9 @@ def run(root: str = None, lint_only: bool = False,
         "fleet_checks": fleet_summary["fleet_checks"],
         "fleet_policies": fleet_summary["fleet_policies"],
         "fleet_vacuous": fleet_summary["vacuous"],
+        "watch_checks": watch_summary["watch_checks"],
+        "watch_signals": watch_summary["watch_signals"],
+        "watch_vacuous": watch_summary["vacuous"],
         "recompile_bounds": bounds,
     }
 
@@ -201,6 +211,11 @@ def run_plan(args) -> int:
             except (OSError, json.JSONDecodeError) as e:
                 print(f"cannot read --calibrate-journal "
                       f"{args.calibrate_journal}: {e}", file=sys.stderr)
+                return 2
+            except costmodel.CalibrationError as e:
+                # present-but-unparsable row: a typed refusal, not a
+                # silent fall-back to the a-priori weight
+                print(f"calibrate: {e}", file=sys.stderr)
                 return 2
             if ici_w is None:
                 print("calibrate: journal carries no usable "
@@ -353,7 +368,8 @@ def main(argv=None) -> int:
               f"{payload['fault_checks']} fault checks, "
               f"{payload['scope_checks']} scope checks, "
               f"{payload['slo_checks']} slo checks, "
-              f"{payload['fleet_checks']} fleet checks"
+              f"{payload['fleet_checks']} fleet checks, "
+              f"{payload['watch_checks']} watch checks"
               + ("" if args.lint_only else
                  f", recompile bounds for {len(payload['recompile_bounds'])}"
                  " workload(s)"))

@@ -856,6 +856,15 @@ def score_candidate(module, config, cand: Candidate,
 # -- the planner -------------------------------------------------------------
 
 
+class CalibrationError(ValueError):
+    """A calibration row is PRESENT in the journal but unparsable —
+    malformed fields, non-numeric ratios, inconsistent byte splits.
+    Distinct from a *skipped* row (tunnel down, off-chip), which is an
+    honest environment fact and calibrates nothing (``None``): a
+    malformed measurement silently falling back to the a-priori weight
+    is exactly how a broken journal writer would hide for rounds."""
+
+
 def calibrate(journal) -> Optional[float]:
     """Measured ICI byte weight from a bench journal's
     ``ici_byte_weight_calibration`` row (the measurement half of the
@@ -866,9 +875,12 @@ def calibrate(journal) -> Optional[float]:
     product, so a plan scored with it prices ICI traffic at what this
     host's XLA actually scheduled. Accepts a raw bench payload, a
     ``BENCH_rNN.json`` driver row (``parsed`` wrapper), or the config
-    row itself; returns None when the journal carries no usable
-    calibration (e.g. the row was skipped off-chip) — callers fall
-    back to the a-priori ``ICI_BYTE_WEIGHT``."""
+    row itself; returns None when the journal carries no calibration
+    row at all or a genuinely SKIPPED one (e.g. off-chip) — callers
+    fall back to the a-priori ``ICI_BYTE_WEIGHT``. A row that is
+    present but unparsable (malformed/partial fields) raises
+    :class:`CalibrationError` instead: silently scoring with the
+    a-priori weight would hide a broken journal writer forever."""
     doc = journal
     if isinstance(doc, dict) and "parsed" in doc:
         doc = doc.get("parsed")
@@ -886,11 +898,23 @@ def calibrate(journal) -> Optional[float]:
     if row is None or row.get("skipped") or row.get("error"):
         return None
     ratio = row.get("measured_over_modeled")
-    if not isinstance(ratio, (int, float)) or ratio <= 0:
-        return None
+    if not isinstance(ratio, (int, float)) or isinstance(ratio, bool) \
+            or ratio <= 0:
+        raise CalibrationError(
+            "ici_byte_weight_calibration row is present but its "
+            f"measured_over_modeled={ratio!r} is not a positive number "
+            "— refusing to fall back silently on a malformed row "
+            "(skipped rows calibrate nothing; malformed rows fail "
+            "loudly)")
     base = row.get("ici_byte_weight")
-    if not isinstance(base, (int, float)) or base <= 0:
-        base = ICI_BYTE_WEIGHT
+    if base is None:
+        base = ICI_BYTE_WEIGHT        # older rows omit the base weight
+    elif not isinstance(base, (int, float)) or isinstance(base, bool) \
+            or base <= 0:
+        raise CalibrationError(
+            "ici_byte_weight_calibration row is present but its "
+            f"ici_byte_weight={base!r} is not a positive number — "
+            "the row does not say what weight it was measured against")
     return float(base) * float(ratio)
 
 

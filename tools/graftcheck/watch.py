@@ -1,0 +1,303 @@
+"""graftcheck watch pass: declared re-planning contracts (compile-free).
+
+The static half of graftwatch (``llm_sharding_demo_tpu/utils/
+graftwatch.py`` is the dynamic half — the same split as
+sanitize/locks/faults/slo/fleet). The live re-planner makes control
+decisions from telemetry and installs plans at runtime; this pass holds
+the two things that make that safe to the declaration bar:
+
+**Signal provenance.** Every signal the watcher consumes is declared in
+``PLAN_SIGNALS`` — a mapping from the fixed ``SIGNALS`` vocabulary to
+the ``METRIC_CATALOG`` series it is computed from (the mirror of
+loadgen's ``SLO_SOURCE_METRICS``). A re-planner steering on a series
+nobody emits converges on noise, so the rule verifies each mapped
+series exists in the catalog AND is emitted at a live production call
+site (the same emission scan the slo pass uses).
+
+**Certified-set membership.** Every plan the switcher can install is
+declared in ``PLAN_SET``, and every ``PLAN_SET`` member must be
+constructed/priced/certified by the declared ``PLAN_BUILDERS``
+functions — both directions checked, so no switch path can reach an
+uncertified program key statically (the ``PlanSwitcher`` enforces the
+same invariant dynamically with typed errors). Explicit switch targets
+(``.switch_to("label")`` string literals anywhere in the scanned tree)
+must name ``PLAN_SET`` members.
+
+Rules (ids in brackets; suppressions ride the shared baseline):
+
+- [plan-signal-without-source]   malformed PLAN_SIGNALS/SIGNALS
+                                 declarations, a consumed signal with
+                                 no mapping, a stale mapping for an
+                                 undeclared signal, a mapped series
+                                 missing from METRIC_CATALOG, or one no
+                                 production call site emits.
+- [uncertified-plan-switch]      malformed PLAN_SET/PLAN_BUILDERS, a
+                                 builder constructing a label outside
+                                 PLAN_SET, a PLAN_SET member no builder
+                                 constructs, a missing builder
+                                 function, or an explicit switch-target
+                                 literal outside PLAN_SET.
+
+``--strict`` additionally fails a VACUOUS pass (a PLAN_SIGNALS
+declaration with zero fully-resolved entries, or an empty PLAN_SET);
+``cli.run --json`` carries ``watch_checks`` / ``watch_signals`` /
+``watch_vacuous``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import lint as L
+from .core import Finding
+from .locks import _module_assign
+from .slo import _emitted_metric_names, _str_dict_keys
+
+WATCH_RULE_IDS = ("plan-signal-without-source", "uncertified-plan-switch")
+
+# The fixed consumed-signal vocabulary (graftwatch.SIGNALS mirrors this
+# — tests pin the two stay equal, like the slo pass's SLO_METRICS).
+WATCH_SIGNALS = ("queue_depth", "batch_occupancy", "pool_blocks",
+                 "live_rows", "breaker_open", "prefix_hits",
+                 "prefix_misses", "admission_sheds", "affinity_hits",
+                 "affinity_fallbacks", "replica_sheds")
+
+
+def _str_tuple(node: ast.AST) -> Optional[List[str]]:
+    """Tuple/list literal of string constants -> the strings; None when
+    not that shape."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out = []
+    for e in node.elts:
+        if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+            return None
+        out.append(e.value)
+    return out
+
+
+def _function_defs(mod: L.ModuleInfo) -> Dict[str, ast.AST]:
+    """Top-level function defs by bare name (builders are module-level
+    functions by convention; the qualname map also covers methods)."""
+    out: Dict[str, ast.AST] = {}
+    for qual, node in mod.functions.items():
+        out.setdefault(qual.rpartition(".")[2], node)
+        out[qual] = node
+    return out
+
+
+def _dicts_str_keys_in(node: ast.AST) -> List[Set[str]]:
+    """Per dict literal inside ``node``, its string keys — the watch
+    pass identifies PLAN-SHAPED dicts (any key is a PLAN_SET label) and
+    holds all of THAT dict's keys to label discipline, so builders'
+    payload dicts (``{"programs": ...}``) never false-positive."""
+    out: List[Set[str]] = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Dict):
+            keys = {k.value for k in sub.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+            if keys:
+                out.append(keys)
+    return out
+
+
+def _switch_target_literals(tree: ast.AST) -> List[Tuple[int, str]]:
+    """(line, label) for every ``<x>.switch_to("label")`` call with a
+    string-literal first argument."""
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "switch_to" and node.args:
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                out.append((node.lineno, a0.value))
+    return out
+
+
+def run_watch(root: str, paths: Optional[List[str]] = None,
+              catalog: Optional[Dict[str, str]] = None,
+              emitted: Optional[Set[str]] = None,
+              ) -> Tuple[List[Finding], dict]:
+    """The whole static pass -> (findings, summary). ``summary``
+    carries ``watch_checks`` (declarations + per-signal resolutions —
+    the vacuity guard on the pass itself), ``watch_signals``
+    (per-module count of fully-resolved signal mappings) and
+    ``vacuous`` (modules whose declarations resolve to nothing live —
+    the strict driver fails these). ``catalog``/``emitted`` are
+    injectable for rule fixtures."""
+    if catalog is None:
+        from llm_sharding_demo_tpu.utils.metrics import METRIC_CATALOG
+        catalog = METRIC_CATALOG
+    if emitted is None:
+        emitted = _emitted_metric_names(root, paths=paths)
+
+    findings: List[Finding] = []
+    checks = 0
+    signals_resolved: Dict[str, int] = {}
+    vacuous: List[str] = []
+
+    for path in (paths if paths is not None else L.iter_sources(root)):
+        mod = L.index_module(path, root)
+        if mod is None:
+            continue
+        sig_stmt = _module_assign(mod, "PLAN_SIGNALS")
+        set_stmt = _module_assign(mod, "PLAN_SET")
+        if sig_stmt is None and set_stmt is None:
+            continue
+        checks += 1
+
+        # -- signal provenance ------------------------------------------------
+        if sig_stmt is not None:
+            vocab_stmt = _module_assign(mod, "SIGNALS")
+            vocab = (_str_tuple(vocab_stmt.value)
+                     if vocab_stmt is not None else None)
+            if vocab_stmt is not None and vocab is None:
+                findings.append(Finding(
+                    "plan-signal-without-source", mod.relpath,
+                    vocab_stmt.lineno, "<module>",
+                    "SIGNALS must be a tuple/list literal of string "
+                    "signal names (the watch pass reads the vocabulary "
+                    "statically)"))
+                vocab = []
+            entries = _str_dict_keys(sig_stmt.value)
+            line = sig_stmt.lineno
+            resolved = 0
+            if entries is None:
+                findings.append(Finding(
+                    "plan-signal-without-source", mod.relpath, line,
+                    "<module>",
+                    "PLAN_SIGNALS must be a dict literal mapping each "
+                    "consumed signal to its METRIC_CATALOG series"))
+            else:
+                declared = {k for k, _ in entries}
+                for name in sorted(set(vocab or ()) - declared):
+                    checks += 1
+                    findings.append(Finding(
+                        "plan-signal-without-source", mod.relpath,
+                        line, name,
+                        f"consumed signal {name!r} has no PLAN_SIGNALS "
+                        "mapping — which METRIC_CATALOG series is the "
+                        "re-planner watching for it?"))
+                for name, value in entries:
+                    checks += 1
+                    if vocab is not None and name not in vocab:
+                        findings.append(Finding(
+                            "plan-signal-without-source", mod.relpath,
+                            line, name,
+                            f"PLAN_SIGNALS declares {name!r} but it is "
+                            "not in the SIGNALS vocabulary (stale "
+                            "declaration)"))
+                        continue
+                    if not (isinstance(value, ast.Constant)
+                            and isinstance(value.value, str)):
+                        findings.append(Finding(
+                            "plan-signal-without-source", mod.relpath,
+                            line, name,
+                            f"signal {name!r}: the mapped series must "
+                            "be a string literal METRIC_CATALOG name"))
+                        continue
+                    series = value.value
+                    if series not in catalog:
+                        findings.append(Finding(
+                            "plan-signal-without-source", mod.relpath,
+                            line, name,
+                            f"signal {name!r} maps to {series!r}, which "
+                            "is not in METRIC_CATALOG — the re-planner "
+                            "would watch a series that does not exist"))
+                        continue
+                    if series not in emitted:
+                        findings.append(Finding(
+                            "plan-signal-without-source", mod.relpath,
+                            line, name,
+                            f"signal {name!r} maps to {series!r}, which "
+                            "no production call site emits — a "
+                            "re-planner steering on a series nobody "
+                            "measures converges on noise"))
+                        continue
+                    resolved += 1
+            signals_resolved[mod.relpath] = resolved
+            if resolved == 0:
+                vacuous.append(mod.relpath)
+
+        # -- certified-set membership -----------------------------------------
+        if set_stmt is not None:
+            plan_set = _str_tuple(set_stmt.value)
+            line = set_stmt.lineno
+            if plan_set is None or not plan_set:
+                findings.append(Finding(
+                    "uncertified-plan-switch", mod.relpath, line,
+                    "<module>",
+                    "PLAN_SET must be a non-empty tuple/list literal of "
+                    "string plan labels — the switchable set the "
+                    "certifier prices"))
+                if mod.relpath not in vacuous:
+                    vacuous.append(mod.relpath)
+                plan_set = []
+            builders_stmt = _module_assign(mod, "PLAN_BUILDERS")
+            builder_names = (_str_tuple(builders_stmt.value)
+                             if builders_stmt is not None else None)
+            if plan_set and builder_names is None:
+                findings.append(Finding(
+                    "uncertified-plan-switch", mod.relpath,
+                    (builders_stmt.lineno if builders_stmt is not None
+                     else line), "<module>",
+                    "a module declaring PLAN_SET must declare "
+                    "PLAN_BUILDERS (tuple literal of the functions that "
+                    "construct/price/certify the plan set) — otherwise "
+                    "certified-set membership is unreviewable"))
+            constructed: Set[str] = set()
+            defs = _function_defs(mod)
+            for bname in builder_names or ():
+                checks += 1
+                fn = defs.get(bname)
+                if fn is None:
+                    findings.append(Finding(
+                        "uncertified-plan-switch", mod.relpath,
+                        (builders_stmt.lineno
+                         if builders_stmt is not None else line), bname,
+                        f"PLAN_BUILDERS names {bname!r} but no such "
+                        "function exists in this module (stale "
+                        "declaration)"))
+                    continue
+                for keys in _dicts_str_keys_in(fn):
+                    if not keys & set(plan_set):
+                        continue          # payload dict, not plan-shaped
+                    for label in sorted(keys - set(plan_set)):
+                        checks += 1
+                        findings.append(Finding(
+                            "uncertified-plan-switch", mod.relpath,
+                            fn.lineno, bname,
+                            f"builder {bname!r} constructs plan label "
+                            f"{label!r} beside declared PLAN_SET "
+                            f"labels {tuple(plan_set)} — an "
+                            "uncertified label the switcher could "
+                            "reach"))
+                    constructed |= keys & set(plan_set)
+            for label in plan_set:
+                checks += 1
+                if builder_names and label not in constructed:
+                    findings.append(Finding(
+                        "uncertified-plan-switch", mod.relpath, line,
+                        label,
+                        f"PLAN_SET declares {label!r} but no "
+                        "PLAN_BUILDERS function constructs it — a "
+                        "switch target with no certified runner"))
+            for lineno, label in _switch_target_literals(mod.tree):
+                checks += 1
+                if label not in plan_set:
+                    findings.append(Finding(
+                        "uncertified-plan-switch", mod.relpath, lineno,
+                        label,
+                        f"explicit switch target {label!r} is outside "
+                        f"the declared PLAN_SET {tuple(plan_set)}"))
+
+    summary = {
+        "watch_checks": checks,
+        "watch_signals": signals_resolved,
+        "vacuous": sorted(set(vacuous)),
+    }
+    return (sorted(findings, key=lambda f: (f.path, f.line, f.rule)),
+            summary)

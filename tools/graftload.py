@@ -36,10 +36,20 @@ from typing import List, Optional
 
 def build_demo_app(max_seq: int = 256, max_batch: int = 4,
                    kv_pool_blocks: int = 0, kv_block_size: int = 16,
-                   recorder_capacity: int = 1024):
+                   recorder_capacity: int = 1024,
+                   continuous: bool = False,
+                   auto_plan_traffic: str = ""):
     """(client, recorder, registry) for a tiny in-process pooled
     serving app — the graftload CLI/bench target. ``kv_pool_blocks=0``
-    sizes the pool to hold ``max_batch`` full-length rows."""
+    sizes the pool to hold ``max_batch`` full-length rows.
+    ``max_batch=1`` serves the solo paged runner (admission mode);
+    ``continuous=True`` arms graftwatch's AUTO_PLAN_CONTINUOUS plan
+    switching over the same composition (the bench ``plan_switch``
+    row's target), and ``auto_plan_traffic`` (costmodel.parse_traffic
+    syntax, e.g. ``"16/8x3,24/8x3"``) declares the traffic classes the
+    plan set is certified against — pass the byte-lengths of the
+    schedule you are about to drive and the certified program bounds
+    cover the whole run."""
     from llm_sharding_demo_tpu.fleet.harness import demo_model
     from llm_sharding_demo_tpu.serving.app import create_app
     from llm_sharding_demo_tpu.serving.http import TestClient
@@ -50,13 +60,17 @@ def build_demo_app(max_seq: int = 256, max_batch: int = 4,
 
     cfg_model, params = demo_model(max_seq)
     if kv_pool_blocks <= 0:
-        kv_pool_blocks = max_batch * (-(-max_seq // kv_block_size))
+        kv_pool_blocks = max(max_batch, 2) * (-(-max_seq // kv_block_size))
     cfg = ServingConfig(model_id="graftload-demo",
                         shard_role="coordinator", max_seq=max_seq,
                         boundaries=(1,), max_batch=max_batch,
-                        batch_mode="iter", batch_wait_ms=10.0,
+                        batch_mode="iter" if max_batch > 1
+                        else "admission", batch_wait_ms=10.0,
                         kv_pool_blocks=kv_pool_blocks,
-                        kv_block_size=kv_block_size)
+                        kv_block_size=kv_block_size,
+                        auto_plan_continuous=continuous,
+                        auto_plan_traffic=auto_plan_traffic
+                        if continuous else "")
     recorder = FlightRecorder(capacity=recorder_capacity)
     registry = MetricsRegistry()
     app = create_app(cfg, model=(cfg_model, params),
