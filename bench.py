@@ -45,6 +45,13 @@ FAULT_POLICY = {
                        "row records an error on child timeout"),
 }
 
+# Timeline contract (tools/graftcheck timeline pass): the
+# timeline_overhead row's emit-throughput micro-bench publishes
+# occupancy points onto the bus it is measuring.
+TIMELINE_EVENTS = {
+    "occupancy": "cfg_timeline_overhead micro-bench",
+}
+
 PROMPT_LEN = 16
 # Two-point decode windows: the bench chip sits behind a network tunnel
 # where each host<->device transfer costs ~10-15 ms (measured and reported
@@ -2214,6 +2221,68 @@ def main() -> None:
     # above are already journaled
     safe("cfg12_megakernel_batch_crossover", cfg12)
 
+    def cfg_timeline_overhead():
+        """grafttime event-bus cost row (ISSUE 14): emit throughput
+        into the bounded ring (events/sec) plus the bus-armed vs
+        bus-off wall ratio on a tiny decode workload — min-of-3 each
+        side, mirroring graftscope's pinned OVERHEAD_FACTOR pattern
+        (tests/test_grafttime.py pins the bound; this row journals the
+        trajectory bench_diff gates: events_per_sec higher-better,
+        overhead_factor lower-better). CPU-safe, no tunnel."""
+        import time as _time
+
+        from llm_sharding_demo_tpu.fleet.harness import demo_model
+        from llm_sharding_demo_tpu.runtime.engine import DecodeEngine
+        from llm_sharding_demo_tpu.utils import grafttime
+
+        n = 20_000
+        # force the bus ON for the throughput half: with GRAFTTIME=0 in
+        # the environment the emits would time the disabled early
+        # return and journal an inflated (and later "regressing")
+        # events_per_sec
+        prev = grafttime.set_enabled(True)
+        try:
+            t0 = _time.perf_counter()
+            for i in range(n):
+                grafttime.emit("occupancy", name="queue_depth",
+                               value=float(i & 7))
+            eps = n / (_time.perf_counter() - t0)
+        finally:
+            grafttime.set_enabled(prev)
+
+        cfg_model, params = demo_model(64)
+        eng = DecodeEngine(params, cfg_model, max_seq=64)
+        prompt = np.full((1, 8), 5, dtype=np.int32)
+        eng.generate(prompt, 16)          # warm-up: compiles
+
+        def best_of(k: int) -> float:
+            best = float("inf")
+            for _ in range(k):
+                t = _time.perf_counter()
+                eng.generate(prompt, 16)
+                best = min(best, _time.perf_counter() - t)
+            return best
+
+        prev = grafttime.set_enabled(False)
+        try:
+            off = best_of(3)
+        finally:
+            grafttime.set_enabled(prev)
+        grafttime.set_enabled(True)
+        try:
+            on = best_of(3)
+        finally:
+            grafttime.set_enabled(prev)
+        return {
+            "events_per_sec": round(eps, 1),
+            "overhead_factor": round(on / off, 4),
+            "overhead_bound": grafttime.OVERHEAD_FACTOR,
+            "ring_capacity": grafttime.BUS.capacity,
+            "within_bound": bool(on <= off * grafttime.OVERHEAD_FACTOR),
+        }
+
+    safe("timeline_overhead", cfg_timeline_overhead)
+
     def cfg_bench_diff():
         """Perf-regression verdict (ISSUE 9, tools/bench_diff.py): THIS
         run's rows so far compared against the committed BENCH_r*.json
@@ -2252,6 +2321,11 @@ def main() -> None:
             # (tools/bench_diff.py --no-skips turns these into a
             # nonzero exit for CI)
             "ungated_rows": verdict["ungated_rows"],
+            # the --no-skips verdict as journaled DATA: false whenever
+            # any row skipped (e.g. the TPU tunnel is down, see
+            # BENCH_r05.json) — the blind spot is loud in the row
+            # itself, not only behind the opt-in flag
+            "no_skips_ok": verdict["no_skips_ok"],
             "history_runs": verdict["history_runs"],
             # full per-metric rows only when something regressed — the
             # OK case stays one compact journal line

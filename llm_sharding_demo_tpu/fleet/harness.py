@@ -110,9 +110,12 @@ def build_fleet(n_decode: int = 2, n_prefill: int = 1,
             fleet_role=role)
         registry = MetricsRegistry()
         recorder = FlightRecorder(capacity=recorder_capacity)
+        # ``replica=name`` labels the app's timeline events (grafttime
+        # replica correlator), so a fleet run's unified stream shows
+        # WHICH replica each request-scoped event happened on
         app = create_app(cfg, model=(cfg_model, params),
                          tokenizer=tokenizer, registry=registry,
-                         recorder=recorder, kv_pool=pool)
+                         recorder=recorder, kv_pool=pool, replica=name)
         registries[name] = registry
         replicas.append(ReplicaHandle(name=name, role=role,
                                       client=TestClient(app),

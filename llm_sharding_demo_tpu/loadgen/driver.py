@@ -38,7 +38,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from ..utils import graftscope
+from ..utils import graftscope, grafttime
 from .profiles import SLO_POLICY, WorkloadProfile
 from .schedule import Arrival, schedule
 
@@ -49,6 +49,15 @@ from .schedule import Arrival, schedule
 OCCUPANCY_SERIES = ("queue_depth", "batch_occupancy",
                     "kv_cache_blocks_in_use", "iter_live_rows",
                     "hop_breaker_open", "auto_plan_active")
+
+# Timeline contract (tools/graftcheck timeline pass): every fired
+# arrival lands on the unified causal stream (utils/grafttime) — the
+# open-loop schedule is the demand side of every queue/occupancy/shed
+# trajectory, and without it on the same clock "the pool filled up"
+# has no visible cause.
+TIMELINE_EVENTS = {
+    "arrival": "_post",
+}
 
 # Fault contract (tools/graftcheck faults pass): the driver's one
 # blocking boundary is the in-process client hop it measures through.
@@ -90,6 +99,8 @@ def _post(client, profile: WorkloadProfile, a: Arrival,
         headers["X-Deadline-Ms"] = str(a.deadline_ms)
     t0 = time.perf_counter()
     out = Outcome(k=a.k, request_id=rid, abandoned=a.abandoned)
+    grafttime.emit("arrival", rid=rid, k=a.k, profile=profile.name,
+                   sched_t=round(a.t, 6), t=t0)
     try:
         r = client.post("/generate", json=body, headers=headers)
         out.status = r.status_code

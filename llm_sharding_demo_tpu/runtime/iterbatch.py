@@ -113,7 +113,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.attention import KVCache
-from ..utils import graftfault, graftsched, graftscope, tracing
+from ..utils import graftfault, graftsched, graftscope, grafttime, \
+    tracing
 from ..utils.metrics import REGISTRY, kv_block_gauges
 from .batcher import _round_up
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
@@ -170,6 +171,21 @@ FAULT_POLICY = {
 # forever.
 FAULT_PARK_BUDGET = 3
 
+# Timeline contract (tools/graftcheck timeline pass): the scheduler's
+# lifecycle decisions land on the unified causal stream
+# (utils/grafttime), rid-correlated — admission (seed/join), park
+# (with its reason), preemption victim choice, recompute-resume, and
+# the per-row fault-park-budget breaker state. Shared batched
+# dispatches carry the live rid set via ``grafttime.correlate`` around
+# the segment/seed dispatch regions (the fanout-span analog).
+TIMELINE_EVENTS = {
+    "admission": "_seed_batch / _admit_one_inner",
+    "park": "_park_slot",
+    "preempt": "_preempt_lowest",
+    "resume": "_seed_batch / _admit_one_inner",
+    "breaker": "_fault_park_all (per-row park-budget state)",
+}
+
 # Lock-discipline contract (tools/graftcheck locks pass): the scheduler
 # counters AND the cross-thread scheduling state (``_parked`` parked
 # rows, ``_pending`` held queue head) live under ``_stats_lock`` —
@@ -193,6 +209,12 @@ GUARDED_STATE = {
 # _SegOut fetch lock never nests inside them; the declared order keeps
 # it that way.
 LOCK_ORDER = ("_stats_lock", "_lock")
+
+
+def _rid_of(req) -> Optional[str]:
+    """The request's timeline correlator (its trace's X-Request-ID);
+    None for untraced engine-level calls."""
+    return getattr(req.trace, "request_id", None)
 
 
 def _next_pow2(n: int) -> int:
@@ -391,7 +413,8 @@ class IterBatchingEngine:
     def __init__(self, engine: DecodeEngine, max_batch: int = 8,
                  seg_steps: int = 32, max_wait_ms: float = 2.0,
                  prompt_bucket: int = 16, spec=None, prefix=None,
-                 pool=None, queue_limit: Optional[int] = None):
+                 pool=None, queue_limit: Optional[int] = None,
+                 replica: Optional[str] = None):
         """``spec`` (optional ``SpecDecodeEngine`` wrapping THIS engine)
         enables speculative segments: batches whose policy carries
         ``SamplingConfig.spec`` advance by draft-verify forwards instead
@@ -406,7 +429,13 @@ class IterBatchingEngine:
         ``queue_limit`` feeds ``admission_load`` (the serving 429
         decision): with the pool unable to host a request AND at least
         this many requests already waiting/parked, serving sheds load
-        instead of queueing unboundedly. Defaults to ``max_batch``."""
+        instead of queueing unboundedly. Defaults to ``max_batch``.
+
+        ``replica`` labels the worker thread's timeline events
+        (grafttime's replica correlator): the serving handler's
+        ambient label is a contextvar on ITS thread, so without this
+        the scheduler-side events (admission/park/resume/dispatch)
+        would carry no replica in a fleet's unified stream."""
         from ..models import is_window_independent
         if not is_window_independent(engine.config):
             raise NotImplementedError(
@@ -438,6 +467,7 @@ class IterBatchingEngine:
         self.prefix = prefix
         self.pool = pool
         self.queue_limit = max_batch if queue_limit is None else queue_limit
+        self.replica = replica
         self.max_batch = max_batch
         self.seg_steps = seg_steps
         self.max_wait_s = max_wait_ms / 1e3
@@ -636,6 +666,11 @@ class IterBatchingEngine:
         return False
 
     def _loop(self):
+        if self.replica is not None:
+            # the worker thread's OWN context: every timeline event it
+            # emits carries this app's replica label (the handler
+            # thread's ambient label does not propagate here)
+            grafttime.set_thread_replica(self.replica)
         while True:
             # parked rows outrank every queued request (they were
             # admitted first — FIFO priority): with any parked, the next
@@ -680,7 +715,13 @@ class IterBatchingEngine:
                 if not state.closed:
                     self._admit(state)
                 try:
-                    self._advance(state)
+                    # the segment dispatch serves every live row: its
+                    # instrumented dispatches (and any fault injected
+                    # inside) carry the live rid set on the timeline
+                    with grafttime.correlate(
+                            [_rid_of(s.req) for s in state.slots
+                             if s is not None]):
+                        self._advance(state)
                 except graftfault.TransientFault as e:
                     # degraded mode: a transient decode fault parks
                     # every live row through the PR 5 recompute-resume
@@ -782,6 +823,16 @@ class IterBatchingEngine:
         # _admit grows it on demand. Width set = {1, 2, 4, ..,
         # max_batch} — a bounded extra-program inventory.
         b = min(_next_pow2(len(seed)), self.max_batch)
+        # timeline: the admission/resume DECISION happens here, at
+        # gather time — before the seed prefill dispatch it causes
+        for e in seed:
+            r = self._ent_req(e)
+            if isinstance(e, _Parked):
+                grafttime.emit("resume", rid=_rid_of(r),
+                               emitted=e.emitted, mode="seed", width=b)
+            else:
+                grafttime.emit("admission", rid=_rid_of(r), mode="seed",
+                               width=b, prompt_len=len(r.prompt))
         ids = np.zeros((b, s_max), dtype=np.int32)
         pad = np.zeros((b,), dtype=np.int32)
         for i in range(b):
@@ -794,7 +845,11 @@ class IterBatchingEngine:
         t0 = time.monotonic()
         sp0 = time.perf_counter()
         run_params = eng._run_params()
-        last_logits, cache = eng._prefill(run_params, ids_j, pad_j)
+        # the shared seed prefill serves every gathered request: its
+        # instrumented dispatches carry the whole rid set (grafttime)
+        with grafttime.correlate([_rid_of(self._ent_req(e))
+                                  for e in seed]):
+            last_logits, cache = eng._prefill(run_params, ids_j, pad_j)
         first, pks, dks = self._first_tokens(
             last_logits, sampling, [self._ent_req(e).key for e in seed], b)
         # Resumed rows: the "first" token is the parked row's last
@@ -1110,6 +1165,15 @@ class IterBatchingEngine:
         eng = self.engine
         stream = self._ent_ids(resume) if resume is not None else req.prompt
         plen_eff = len(stream)            # tokens the prefill forwards
+        # timeline: the join/resume DECISION happens here — before the
+        # admit prefill dispatch it causes
+        if resume is not None:
+            grafttime.emit("resume", rid=_rid_of(req),
+                           emitted=resume.emitted, mode="join",
+                           depth=state.depth)
+        else:
+            grafttime.emit("admission", rid=_rid_of(req), mode="join",
+                           depth=state.depth, prompt_len=plen_eff)
         plen = resume.plen if resume is not None else plen_eff
         t0 = resume.t0 if resume is not None else time.monotonic()
         p0 = time.perf_counter()
@@ -1137,9 +1201,10 @@ class IterBatchingEngine:
                 sp = plen_eff  # exact length (rare; one extra program)
             ids = np.zeros((1, sp), dtype=np.int32)
             ids[0, sp - plen_eff:] = stream
-            logits, solo = eng._prefill(
-                eng._run_params(), jnp.asarray(ids),
-                jnp.asarray([sp - plen_eff], jnp.int32))
+            with grafttime.correlate([_rid_of(req)]):
+                logits, solo = eng._prefill(
+                    eng._run_params(), jnp.asarray(ids),
+                    jnp.asarray([sp - plen_eff], jnp.int32))
             if req.trace is not None:
                 req.trace.add_span(
                     "prefill", p0, time.perf_counter(),
@@ -1326,12 +1391,14 @@ class IterBatchingEngine:
                 s.blk_lo = new_lo
 
     def _park_slot(self, state: _BatchState, s: _Slot,
-                   fault_budget_used: int = 0) -> None:
+                   fault_budget_used: int = 0,
+                   reason: str = "preempt") -> None:
         """Park one live row for recompute-resume: fetch its emitted
         tokens (host sync — parking is the slow path by design), free
         its blocks, queue it oldest-first. Shared by pool-pressure
-        preemption and transient-fault recovery — both replay the row
-        byte-identically through the same resume machinery."""
+        preemption (``reason="preempt"``) and transient-fault recovery
+        (``reason="fault"``) — both replay the row byte-identically
+        through the same resume machinery."""
         tokens = np.asarray(self._row_tokens(s), dtype=np.int32)
         spec_key = None
         if state.spec_mode and state.sampling.mode != "greedy":
@@ -1345,6 +1412,8 @@ class IterBatchingEngine:
         self._release_blocks(state, s.row)
         state.slots[s.row] = None
         self._park(parked)
+        grafttime.emit("park", rid=_rid_of(s.req), reason=reason,
+                       emitted=parked.emitted)
 
     def _preempt_lowest(self, state: _BatchState) -> bool:
         """Park the lowest-priority live row (latest admission order).
@@ -1355,6 +1424,8 @@ class IterBatchingEngine:
         if not live:
             return False
         victim = max(live, key=lambda s: s.order)
+        grafttime.emit("preempt", rid=_rid_of(victim.req),
+                       order=victim.order)
         self._park_slot(state, victim,
                         fault_budget_used=victim.fault_budget_used)
         if victim.req.trace is not None:
@@ -1382,6 +1453,13 @@ class IterBatchingEngine:
                     s.req.trace.add_span("fault_budget_exhausted", t, t,
                                          scheduler="iter",
                                          parks=s.fault_budget_used)
+                # the row's park-budget breaker OPENS: no more recovery
+                # attempts — the degraded-mode decision, on the timeline
+                grafttime.emit("breaker", state="open",
+                               rid=_rid_of(s.req),
+                               scope="iterbatch.fault_park_budget",
+                               used=s.fault_budget_used,
+                               budget=FAULT_PARK_BUDGET)
                 s.req.fail(graftfault.FaultBudgetError(
                     f"row exhausted its transient-fault park budget "
                     f"({FAULT_PARK_BUDGET}); last fault: {fault}"))
@@ -1391,7 +1469,14 @@ class IterBatchingEngine:
             if s.req.trace is not None:
                 s.req.trace.labels["fault_parks"] = (
                     s.req.trace.labels.get("fault_parks", 0) + 1)
-            self._park_slot(state, s,
+            # budget still absorbs this fault: the breaker stays CLOSED
+            # with its remaining headroom recorded
+            grafttime.emit("breaker", state="closed",
+                           rid=_rid_of(s.req),
+                           scope="iterbatch.fault_park_budget",
+                           used=s.fault_budget_used + 1,
+                           budget=FAULT_PARK_BUDGET)
+            self._park_slot(state, s, reason="fault",
                             fault_budget_used=s.fault_budget_used + 1)
         with self._stats_lock:
             self.fault_parks += 1

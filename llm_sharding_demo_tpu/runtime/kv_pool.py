@@ -70,7 +70,8 @@ import numpy as np
 
 from ..ops import paged_attention as PA
 from ..ops.attention import KVCache
-from ..utils import graftfault, graftsched, graftscope, tracing
+from ..utils import graftfault, graftsched, graftscope, grafttime, \
+    tracing
 from ..utils.metrics import DEFAULT_KV_BLOCK_SIZE, REGISTRY, CompileWatch
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
                      _eos_capped_segments, _split_keys, _step_keys,
@@ -90,6 +91,16 @@ JIT_ENTRY_POINTS = ("_gather", "_scatter", "_scatter_row", "_copy",
 # free-block poisoner, a sanitizer hook off every serving path —
 # baselined in tools/graftcheck/baseline.txt with that justification.
 PROFILED_SCOPES = ("_gather", "_scatter", "_scatter_row", "_copy")
+
+# Timeline contract (tools/graftcheck timeline pass): the allocator's
+# LRU evictions land on the unified causal stream (utils/grafttime) —
+# an eviction storm is only diagnosable when it sits on the same clock
+# as the admissions/preemptions that provoked it. (Admission events are
+# the SCHEDULERS' story — iterbatch emits them with the rid; the
+# allocator's view is the block economy.)
+TIMELINE_EVENTS = {
+    "eviction": "BlockAllocator._evict_lru_locked",
+}
 
 
 # graftscope program-key derivations (the certifier's model: gather/
@@ -597,6 +608,11 @@ class BlockAllocator:
         freed = self._deref_prefix_locked(ids)
         self.evictions += 1
         REGISTRY.inc("kv_pool_evictions_total")
+        # one bounded ring append under the hold (the _sample_breaker
+        # precedent): the eviction joins the causal timeline at the
+        # instant the block economy changed
+        grafttime.emit("eviction", blocks=len(ids), freed=len(freed),
+                       prefix_entries=len(self._prefix))
         if self.sanitize:
             self._san_check_locked("eviction")
         return freed

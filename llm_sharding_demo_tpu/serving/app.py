@@ -42,7 +42,7 @@ from ..models import gpt2
 from ..parallel import partition as P_
 from ..parallel.pipeline import PipelineRunner
 from ..runtime.engine import REF_TEMPERATURE, REF_TOP_K, SamplingConfig
-from ..utils import graftfault, tracing
+from ..utils import graftfault, grafttime, tracing
 from ..utils.config import ServingConfig, from_env
 from ..utils.metrics import REGISTRY
 from ..utils.tracing import timed
@@ -169,7 +169,8 @@ def parse_deadline_header(headers: dict):
 
 def create_app(cfg: Optional[ServingConfig] = None,
                model=None, tokenizer=None,
-               registry=None, recorder=None, kv_pool=None) -> JSONApp:
+               registry=None, recorder=None, kv_pool=None,
+               replica: Optional[str] = None) -> JSONApp:
     """Build the app. ``model=(config, params)`` / ``tokenizer`` injectable
     for tests; by default resolved via ``serving.loader`` / HF-or-byte
     tokenizer. ``registry`` (utils.metrics.MetricsRegistry) and
@@ -179,8 +180,12 @@ def create_app(cfg: Optional[ServingConfig] = None,
     KVBlockPool`` matching this app's engine geometry) makes this
     replica serve off a SHARED pool instead of building its own — the
     graftfleet process-local form, where prefill and decode replicas
-    hand blocks off through one allocator's content-keyed registry."""
+    hand blocks off through one allocator's content-keyed registry.
+    ``replica`` labels this app's request-scoped timeline events
+    (grafttime's replica correlator — the fleet harness passes the
+    replica name); defaults to the fleet role, or "solo"."""
     cfg = cfg or from_env()
+    replica_label = replica or cfg.fleet_role or "solo"
     reg = registry if registry is not None else REGISTRY
     rec = recorder if recorder is not None else tracing.RECORDER
     # multi-host glue sits HERE, where every entry path converges (CLI,
@@ -671,7 +676,8 @@ def create_app(cfg: Optional[ServingConfig] = None,
                                             max_wait_ms=cfg.batch_wait_ms,
                                             spec=spec_runner,
                                             prefix=prefix_runner,
-                                            pool=kv_pool)
+                                            pool=kv_pool,
+                                            replica=replica_label)
             else:
                 from ..runtime.batcher import BatchingEngine
                 runner = BatchingEngine(base, max_batch=cfg.max_batch,
@@ -851,8 +857,51 @@ def create_app(cfg: Optional[ServingConfig] = None,
             return 422, {"detail": "n must be an integer"}
         return {"serving": _topology(), **switcher.describe(n=n)}
 
+    @app.get("/debug")
+    def debug_index():
+        """The debug-surface index: every /debug/* endpoint with a
+        one-line description, under the SAME topology header as
+        /healthz (pinned equal by tests) — operators stop guessing
+        URLs and stop wondering which composition a surface reflects."""
+        return {
+            "serving": _topology(),
+            "surfaces": {
+                "/debug/requests": (
+                    "flight recorder: span trees of the last N "
+                    "requests (?n, ?slowest=1, ?errors=1, ?profile=)"),
+                "/debug/profile": (
+                    "graftscope attribution: per-program dispatch "
+                    "rings + occupancy time series (?n)"),
+                "/debug/plan": (
+                    "graftwatch continuous-planning decision state: "
+                    "active plan, scores, switch journal (?n)"),
+                "/debug/timeline": (
+                    "grafttime unified causal event stream, one clock "
+                    "over spans/dispatches/faults/plan switches "
+                    "(?rid=, ?since=, ?kinds=, ?n=)"),
+            },
+        }
+
+    @app.get("/debug/timeline")
+    def debug_timeline(query: dict):
+        """The unified causal timeline (utils/grafttime): every
+        producer's typed events on one monotonic clock. ``?rid=``
+        keeps one request's causal stream (shared batched phases
+        included — they carry the rid set), ``?since=`` is an
+        exclusive ms lower bound on the bus clock, ``?kinds=`` a
+        comma-separated vocabulary filter, ``?n=`` caps to the newest
+        n. Export the payload with ``python -m tools.grafttime
+        export`` for chrome://tracing / Perfetto."""
+        return grafttime.debug_timeline_payload(query, _topology())
+
     @app.post("/prefill")
     def prefill(req: PrefillReq, headers: dict):
+        # thin wrapper: the replica label rides every timeline event
+        # this request emits (grafttime's ambient replica correlator)
+        with grafttime.use_replica(replica_label):
+            return _prefill(req, headers)
+
+    def _prefill(req: PrefillReq, headers: dict):
         """graftfleet prefill-replica endpoint: run the prompt's
         chunk-aligned prefill and FILL shared pool blocks — the walk
         lands every full-chunk prefix state in the pool's content-keyed
@@ -954,10 +1003,17 @@ def create_app(cfg: Optional[ServingConfig] = None,
                 reg.inc("deadline_misses_total")
             trace.labels.update(error=e.code)
             rec.record(trace)
+            # post-mortem black box (grafttime): the events that led
+            # to the typed failure, journaled before the ring rotates
+            grafttime.blackbox(e.code, rid=rid)
             return out({"error": e.code, "detail": str(e)}, status=503)
         except Exception as e:  # noqa: BLE001 — flight-record + echo id
             trace.labels.update(error=f"{type(e).__name__}: {e}")
             rec.record(trace)
+            from ..runtime.kv_pool import GraftsanError
+            if isinstance(e, GraftsanError):
+                grafttime.blackbox(f"graftsan:{type(e).__name__}",
+                                   rid=rid)
             return out({"detail": f"{type(e).__name__}: {e}"}, status=500)
         trace.labels.update(registered_tokens=depth)
         rec.record(trace)
@@ -1184,6 +1240,12 @@ def create_app(cfg: Optional[ServingConfig] = None,
 
     @app.post("/generate")
     def generate(req: GenerateReq, headers: dict):
+        # thin wrapper: the replica label rides every timeline event
+        # this request emits (grafttime's ambient replica correlator)
+        with grafttime.use_replica(replica_label):
+            return _generate(req, headers)
+
+    def _generate(req: GenerateReq, headers: dict):
         # Request identity: every response (errors included) echoes the
         # X-Request-ID as a response header — the BODY stays wire-parity
         # with the reference ({"generated": ...}, server.py:210). The
@@ -1380,6 +1442,10 @@ def create_app(cfg: Optional[ServingConfig] = None,
                 reg.inc("deadline_misses_total")
             trace.labels.update(error=e.code)
             rec.record(trace)
+            # post-mortem black box (grafttime): a typed Unavailable is
+            # exactly the moment the causal stream must outlive the
+            # ring — journal it (bounded; $GRAFTTIME_DIR adds a file)
+            grafttime.blackbox(e.code, rid=rid)
             return out({"error": e.code, "detail": str(e)}, status=503)
         except Exception as e:  # noqa: BLE001 — a failed (e.g. timed-out)
             # generation is exactly the request the flight recorder must
@@ -1387,6 +1453,13 @@ def create_app(cfg: Optional[ServingConfig] = None,
             # body shape matches http.py's uncaught-500 {"detail": ...}
             trace.labels.update(error=f"{type(e).__name__}: {e}")
             rec.record(trace)
+            from ..runtime.kv_pool import GraftsanError
+            if isinstance(e, GraftsanError):
+                # a sanitizer trap firing on the serving path is THE
+                # black-box case: provenance + the event stream that
+                # led to it, journaled at the instant it surfaced
+                grafttime.blackbox(f"graftsan:{type(e).__name__}",
+                                   rid=rid)
             return out({"detail": f"{type(e).__name__}: {e}"}, status=500)
         body = {"generated": text}
         if eos_id is not None:

@@ -47,7 +47,8 @@ from typing import Dict, List, Optional
 
 from ..fleet.affinity import HashRing, affinity_key
 from ..fleet.topology import FleetTopology, ReplicaHandle
-from ..utils import graftfault, graftsched, graftwatch, tracing
+from ..utils import graftfault, graftsched, grafttime, graftwatch, \
+    tracing
 from ..utils.metrics import REGISTRY
 from .app import GenerateReq, parse_deadline_header, parse_request_identity
 from .http import JSONApp
@@ -327,8 +328,47 @@ def create_router_app(topology: FleetTopology, tokenizer,
             rec, query, {"role": "router",
                          "replicas": topology.describe()})
 
+    @app.get("/debug")
+    def debug_index():
+        """The router's debug-surface index (the replica app's /debug
+        sibling): the surfaces this app serves, under its identity."""
+        return {
+            "serving": {"role": "router",
+                        "replicas": topology.describe()},
+            "surfaces": {
+                "/debug/requests": (
+                    "joined router+replica span trees per request "
+                    "(?n, ?slowest=1, ?errors=1, ?profile=)"),
+                "/debug/timeline": (
+                    "grafttime unified causal event stream "
+                    "(?rid=, ?since=, ?kinds=, ?n=)"),
+            },
+        }
+
+    @app.get("/debug/timeline")
+    def debug_timeline(query: dict):
+        """The unified causal timeline at the router. Clock model: the
+        in-process harness shares ONE bus (and therefore one clock)
+        with every replica, so router and replica events are aligned
+        by construction and ``clock_alignment`` reports offset 0. A
+        wire deployment fetches each replica's /debug/timeline and
+        rebases it by the hop start on the router's clock
+        (``grafttime.rebase`` — the RequestTrace.graft stitching
+        offset) before merging."""
+        payload = grafttime.debug_timeline_payload(
+            query, {"role": "router", "replicas": topology.describe()})
+        if isinstance(payload, dict):
+            payload["clock_alignment"] = {
+                "mode": "shared-process-clock", "offset_ms": 0.0}
+        return payload
+
     @app.post("/generate")
     def generate(req: GenerateReq, headers: dict):
+        # the router's replica label on every event this request emits
+        with grafttime.use_replica("router"):
+            return _generate(req, headers)
+
+    def _generate(req: GenerateReq, headers: dict):
         rid, profile_label = parse_request_identity(headers)
         fwd = {"X-Request-ID": rid}
         if profile_label is not None:
@@ -535,6 +575,9 @@ def create_router_app(topology: FleetTopology, tokenizer,
                 reg.inc("deadline_misses_total")
             trace.labels.update(error=e.code)
             rec.record(trace)
+            # post-mortem black box (grafttime): the fleet-level
+            # failure with the causal stream that led to it
+            grafttime.blackbox(e.code, rid=rid)
             return out({"error": e.code, "detail": str(e)}, status=503)
 
         trace.labels.update(target=target.name,

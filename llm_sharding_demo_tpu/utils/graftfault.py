@@ -82,6 +82,16 @@ GUARDED_STATE = {"_inj_counts": "_lock", "_inj_log": "_lock",
                  "_PLAN": "_PLAN_LOCK", "_ENV_PLAN": "_PLAN_LOCK"}
 LOCK_ORDER = ("_PLAN_LOCK", "_lock")
 
+# Timeline contract (tools/graftcheck timeline pass): every fired
+# injection and every breaker state TRANSITION lands on the unified
+# causal stream (utils/grafttime) — a re-planning or degraded-mode
+# decision is only auditable if the fault that provoked it sits on the
+# same clock as the recovery it triggered.
+TIMELINE_EVENTS = {
+    "fault_inject": "FaultPlan.fire",
+    "breaker": "_sample_breaker (HopPolicy transitions)",
+}
+
 
 def enabled() -> bool:
     return os.environ.get("GRAFTFAULT", "") not in ("", "0")
@@ -294,6 +304,12 @@ class FaultPlan:
                     and len(self._inj_log) >= self.max_injections):
                 return None
             self._inj_log.append(inj)
+        # the fired fault on the causal timeline (rid rides the ambient
+        # correlation: the scheduler's live-row set, or the request
+        # trace); lazy import — measurement apparatus bootstraps first
+        from . import grafttime
+        grafttime.emit("fault_inject", site=site, fault=kind, seq=n,
+                       where=inj.where)
         return kind
 
     @property
@@ -384,11 +400,16 @@ def _sample_breaker(target: str, value: float, registry=None) -> None:
     graftscope occupancy point (the /debug/profile timeline a
     graftload run reduces). Lazy imports: this module must stay
     importable mid-bootstrap without the measurement apparatus."""
-    from . import graftscope
+    from . import graftscope, grafttime
     from .metrics import REGISTRY
     (REGISTRY if registry is None else registry).gauge(
         "hop_breaker_open", value, target=target)
     graftscope.sample("hop_breaker_open", value, target=target)
+    # the breaker TRANSITION as a typed timeline event (beyond the
+    # occupancy point the sample above mirrors): state + target on the
+    # same clock as the hop spans and fault injections around it
+    grafttime.emit("breaker", state="open" if value else "closed",
+                   target=target)
 
 
 @dataclasses.dataclass

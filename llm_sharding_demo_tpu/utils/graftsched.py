@@ -68,6 +68,19 @@ __all__ = [
     "mode", "rlock", "seed", "trace_admission",
 ]
 
+# Timeline contract (tools/graftcheck timeline pass): with GRAFTSCHED
+# armed (sched/trace), every instrumented acquisition publishes onto
+# the unified causal stream (utils/grafttime) — and contended ones
+# (>1ms wait) separately — so lock waits sit on the same clock as the
+# dispatches and spans they delay. Both kinds are schedule
+# OBSERVATIONS and therefore replay-exempt (grafttime
+# REPLAY_EXEMPT_KINDS). grafttime's own lock is a plain
+# threading.Lock precisely so this emission cannot recurse.
+TIMELINE_EVENTS = {
+    "lock_acquire": "TracedLock.acquire",
+    "lock_contend": "TracedLock.acquire",
+}
+
 
 def mode() -> str:
     """"" (off) | "sched" (seeded jitter yields) | "trace" (accounting
@@ -307,6 +320,16 @@ class TracedLock:
             w[1] += 1
             if wait > 1e-3:
                 w[2] += 1
+        if ok:
+            # lazy import: the bus must stay constructible before this
+            # module finishes bootstrapping (and never instruments it)
+            from . import grafttime
+            wait_ms = round(wait * 1e3, 3)
+            grafttime.emit("lock_acquire", name=self.name,
+                           wait_ms=wait_ms)
+            if wait > 1e-3:
+                grafttime.emit("lock_contend", name=self.name,
+                               wait_ms=wait_ms)
         if not ok and blocking:
             self._report_deadlock(budget, site)
             raise DeadlockError(

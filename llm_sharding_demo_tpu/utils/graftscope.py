@@ -47,7 +47,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, Optional, Tuple
 
-from . import graftsched, tracing
+from . import graftsched, grafttime, tracing
 
 # Lock-discipline contract (tools/graftcheck locks pass): the dispatch
 # rings and the time-series points are written by scheduler/handler
@@ -55,6 +55,17 @@ from . import graftsched, tracing
 # live under the state instance's ``_lock``.
 GUARDED_STATE = {"_rings": "_lock", "_points": "_lock"}
 LOCK_ORDER = ("_lock",)
+
+# Timeline contract (tools/graftcheck timeline pass): every
+# instrumented dispatch publishes begin/end onto the unified causal
+# stream (utils/grafttime) with the certifier's program key, and every
+# occupancy sample mirrors onto it — the same points /debug/profile
+# serves, now join-able against spans/faults/switches on one clock.
+TIMELINE_EVENTS = {
+    "dispatch_begin": "ProfiledFn.__call__",
+    "dispatch_end": "ProfiledFn.__call__",
+    "occupancy": "sample",
+}
 
 # bounded-ring capacities: per-scope dispatch samples and per-series
 # occupancy points kept (oldest dropped — a ring, not a log)
@@ -319,16 +330,20 @@ class ProfiledFn:
     def __call__(self, *args, **kwargs):
         if not _enabled[0]:
             return self._fn(*args, **kwargs)
-        with tracing.timed("dispatch_seconds", sync=_sync[0],
-                           scope=self._scope) as h:
-            out = h.sync(self._fn(*args, **kwargs))
         try:
             key = (self._key_fn(*args, **kwargs)
                    if self._key_fn is not None
                    else _default_key(args, kwargs))
         except Exception:  # noqa: BLE001 — a key-model slip must never
             key = ("<unkeyed>",)  # cost the dispatch its result
+        krepr = repr(key)
+        grafttime.emit("dispatch_begin", scope=self._scope, key=krepr)
+        with tracing.timed("dispatch_seconds", sync=_sync[0],
+                           scope=self._scope) as h:
+            out = h.sync(self._fn(*args, **kwargs))
         STATE.record(self._scope, key, h.seconds)
+        grafttime.emit("dispatch_end", scope=self._scope, key=krepr,
+                       dur_ms=round(h.seconds * 1e3, 4))
         return out
 
     def __getattr__(self, name):
@@ -359,9 +374,13 @@ def sample(name: str, value: float, **labels) -> None:
     """Append one occupancy point to the bounded time-series ring.
     ``name`` must be a METRIC_CATALOG gauge (the metric-catalog rule
     scans these call sites too) — the series is the trajectory behind
-    the same-named /metrics gauge."""
+    the same-named /metrics gauge. Each point also mirrors onto the
+    unified timeline (grafttime kind ``occupancy``), so live-state
+    trajectories sit on the same clock as spans and dispatches."""
     if _enabled[0]:
         STATE.sample(name, value, **labels)
+        grafttime.emit("occupancy", name=name, value=float(value),
+                       **labels)
 
 
 def now_ms() -> float:

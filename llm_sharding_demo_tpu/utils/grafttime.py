@@ -1,0 +1,690 @@
+"""grafttime: the unified causal timeline — one clock, every producer.
+
+The spine emits rich telemetry in silos: ``RequestTrace`` span trees
+(``/debug/requests``), graftscope dispatch rings and occupancy series
+(``/debug/profile``), graftsched lock accounting, graftfault injections
+and breaker transitions, iterbatch park/preempt/resume and pool
+admission/eviction, graftwatch plan evaluations (``/debug/plan``), and
+loadgen arrival schedules. None of them share a clock, so "what
+happened during this p99 request" means hand-joining five JSON payloads
+by X-Request-ID. This module is the dynamic half of the graftcheck
+``timeline`` pass (``tools/graftcheck/timeline.py`` is the static half
+— the same static+dynamic split as graftsan/graftlock/graftfault):
+
+- **one bounded event bus** (:class:`TimelineBus`): every producer
+  publishes typed events onto one monotonic clock (``perf_counter``
+  relative to the bus epoch, the same clock family graftscope's
+  ``t_ms`` uses). The ring is BOUNDED (oldest dropped, never unbounded
+  growth) and lock-light: one plain-lock deque append per event. The
+  bus's own lock is a plain ``threading.Lock`` — deliberately NOT a
+  ``graftsched.lock`` — because graftsched's instrumented locks
+  themselves publish ``lock_acquire`` events here, and the apparatus
+  must not observe (or recurse into) itself;
+- **a fixed event vocabulary** (:data:`EVENT_KINDS`): emission is a
+  DECLARED contract — every producing module declares
+  ``TIMELINE_EVENTS = {kind: source}`` and the timeline pass verifies
+  every declared kind is emitted, every emitted kind is declared and
+  in-vocabulary, and required correlator fields are present at each
+  emit site;
+- **correlators**: events join by ``rid`` (X-Request-ID — a shared
+  batched dispatch carries ``rids``, the fanout-span analog),
+  ``key`` (the certifier's program key, stringified), and ``replica``
+  (the serving app's fleet label, ambient per request);
+- **serving**: ``GET /debug/timeline`` (``?rid=``, ``?since=``,
+  ``?kinds=``, ``?n=``) serves the raw stream; ``python -m
+  tools.grafttime export`` converts a captured stream (or a black-box
+  dump) to Chrome-trace/Perfetto JSON;
+- **black-box dumps**: when a typed ``Unavailable`` or a
+  ``GraftsanError`` surfaces at a serving boundary, the current ring is
+  journaled (:func:`blackbox`) into a bounded in-process dump ring —
+  and to ``$GRAFTTIME_DIR/grafttime_blackbox_*.json`` when that env var
+  names a directory — so the events that LED to the failure survive it.
+
+Clock model: all in-process producers (the fleet harness's replicas
+included) share ONE bus and therefore one clock, so cross-replica
+events are aligned by construction. Across real processes each side
+has its own epoch; :func:`rebase` shifts a downstream replica's events
+onto the caller's clock by the hop offset — exactly the trace-stitching
+offset ``RequestTrace.graft`` uses (the skew is the hop's queueing,
+which is precisely what the offset shows).
+
+Replay contract (the FaultPlan/GRAFTSCHED discipline): a request's
+event stream is replay-identical under a pinned seed MODULO the
+declared wall-clock fields (:data:`REPLAY_EXEMPT_FIELDS`) and the
+declared schedule-observation kinds (:data:`REPLAY_EXEMPT_KINDS` —
+lock and occupancy events observe the interleaving itself and are
+exempt by design). :func:`replay_view` is THE canonical projection the
+determinism pins compare byte-for-byte.
+
+Overhead: one enabled-flag check, one ``perf_counter`` read, and one
+plain-lock deque append per event. The pinned bound
+(tests/test_grafttime.py, the graftscope pattern): a quick-tier decode
+run with the bus armed stays within :data:`OVERHEAD_FACTOR` of bus-off
+wall time, min-of-3. ``GRAFTTIME=0`` disables recording entirely.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Lock-discipline contract (tools/graftcheck locks pass): the event
+# ring, the sequence counter, and the black-box dump ring are written
+# by every producer thread and read by /debug/timeline handlers; all
+# live under the plain module/bus ``_lock`` (see the module docstring
+# for why these locks are deliberately never graftsched-instrumented).
+GUARDED_STATE = {"_events": "_lock", "_seq": "_lock",
+                 "_DUMPS": "_DUMPS_LOCK", "_DUMP_SEQ": "_DUMPS_LOCK"}
+LOCK_ORDER = ("_lock",)
+
+# Fault contract (tools/graftcheck faults pass): the bus owns no
+# blocking boundaries — emission is a bounded in-memory append and the
+# black-box file write is best-effort fire-and-forget. Declared empty
+# so a blocking call added here must declare its policy.
+FAULT_POLICY = {}
+
+# -- the declared vocabulary --------------------------------------------------
+
+# kind -> one-line meaning. THE fixed vocabulary: the timeline pass
+# (tools/graftcheck/timeline.py) rejects any emitted or declared kind
+# outside it, so a new event class is a reviewed vocabulary change, not
+# an ad-hoc string.
+EVENT_KINDS = {
+    "arrival":        "loadgen fired a scheduled request at the app",
+    "span_open":      "a request-trace span opened (tracing)",
+    "span_close":     "a request-trace span closed, duration attached",
+    "dispatch_begin": "an instrumented jit entry point began dispatch",
+    "dispatch_end":   "an instrumented dispatch closed (program key + "
+                      "window)",
+    "occupancy":      "a live-state gauge sample (graftscope series)",
+    "lock_acquire":   "an instrumented lock was acquired (GRAFTSCHED)",
+    "lock_contend":   "an instrumented lock acquisition waited >1ms",
+    "fault_inject":   "a seeded fault plan fired at a production site",
+    "breaker":        "a circuit/park-budget breaker state observation",
+    "admission":      "a scheduler admitted a request (seed/join)",
+    "eviction":       "the pool LRU-evicted a prefix entry's blocks",
+    "park":           "a live row parked (preemption or fault recovery)",
+    "preempt":        "pool pressure chose a victim row to park",
+    "resume":         "a parked row resumed by recompute",
+    "plan_eval":      "graftwatch evaluated the plan set at a wave "
+                      "boundary",
+    "plan_switch":    "graftwatch installed a different certified plan",
+}
+
+# kind -> keyword arguments an emit SITE must spell out (values may be
+# None at runtime — the contract is that the call site MENTIONS the
+# correlator/payload, statically reviewable by the timeline pass).
+KIND_FIELDS = {
+    "arrival":        ("rid",),
+    "span_open":      ("name",),
+    "span_close":     ("name",),
+    "dispatch_begin": ("scope", "key"),
+    "dispatch_end":   ("scope", "key"),
+    "occupancy":      ("name", "value"),
+    "lock_acquire":   ("name",),
+    "lock_contend":   ("name", "wait_ms"),
+    "fault_inject":   ("site", "fault"),
+    "breaker":        ("state",),
+    "admission":      ("rid",),
+    "eviction":       ("blocks",),
+    "park":           ("rid", "reason"),
+    "preempt":        ("rid",),
+    "resume":         ("rid",),
+    "plan_eval":      ("to_plan",),
+    "plan_switch":    ("to_plan",),
+}
+
+# Replay contract: fields that carry wall-clock/interleaving truth and
+# are therefore EXEMPT from byte-identity under a pinned seed...
+REPLAY_EXEMPT_FIELDS = ("seq", "ts", "tid", "dur_ms", "wait_ms")
+# ...and kinds that OBSERVE the schedule itself (lock events record the
+# interleaving; occupancy values depend on when the sampler ran
+# relative to other threads) — exempt as whole events.
+REPLAY_EXEMPT_KINDS = ("lock_acquire", "lock_contend", "occupancy")
+
+# The declared overhead bound tests/test_grafttime.py pins (the
+# graftscope pattern): a decode run with the bus armed must finish
+# within this factor of the same run with the bus off, min-of-3.
+OVERHEAD_FACTOR = 2.0
+
+# bounded ring: oldest events drop — a ring, never a log
+RING_CAPACITY = 4096
+# bounded black-box dump ring (each dump snapshots the event ring)
+BLACKBOX_CAPACITY = 8
+
+_enabled = [os.environ.get("GRAFTTIME", "1") != "0"]
+
+
+def enabled() -> bool:
+    return _enabled[0]
+
+
+def set_enabled(value: bool) -> bool:
+    """Toggle recording (returns the previous value). The overhead test
+    uses this for its bus-off baseline; production leaves it on."""
+    prev = _enabled[0]
+    _enabled[0] = bool(value)
+    return prev
+
+
+# -- ambient correlation ------------------------------------------------------
+
+# A shared batched dispatch serves MANY requests (the fanout-span
+# analog): the scheduler sets the live rid set around the dispatch so
+# every event emitted inside carries them.
+_RIDS: "contextvars.ContextVar[Tuple[str, ...]]" = contextvars.ContextVar(
+    "grafttime_rids", default=())
+# the serving app's fleet label, set per request by the handler
+_REPLICA: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
+    "grafttime_replica", default=None)
+
+
+@contextlib.contextmanager
+def correlate(rids: Sequence[str]):
+    """Attach this rid set to every event emitted in the block (the
+    scheduler wraps shared dispatches; None entries are dropped)."""
+    token = _RIDS.set(tuple(r for r in rids if r))
+    try:
+        yield
+    finally:
+        _RIDS.reset(token)
+
+
+def current_rids() -> Tuple[str, ...]:
+    return _RIDS.get()
+
+
+@contextlib.contextmanager
+def use_replica(name: Optional[str]):
+    """Attach a replica label to every event emitted in the block (the
+    serving handler's per-request scope)."""
+    token = _REPLICA.set(name)
+    try:
+        yield
+    finally:
+        _REPLICA.reset(token)
+
+
+def set_thread_replica(name: Optional[str]) -> None:
+    """Pin the replica label for the CURRENT thread's whole lifetime —
+    what a scheduler worker calls at loop start, because the serving
+    handler's per-request ``use_replica`` contextvar never propagates
+    to a thread started at construction time."""
+    _REPLICA.set(name)
+
+
+def _ambient_rid() -> Tuple[Optional[str], Optional[Tuple[str, ...]]]:
+    """(rid, rids) from the ambient correlation: the explicit
+    ``correlate`` set first, else the ambient request trace."""
+    rids = _RIDS.get()
+    if rids:
+        return (rids[0], None) if len(rids) == 1 else (None, rids)
+    # lazy import: tracing imports THIS module at top level
+    from . import tracing
+    tr = tracing.current_trace()
+    rid = getattr(tr, "request_id", None)
+    return (rid, None) if rid else (None, None)
+
+
+# -- the bus ------------------------------------------------------------------
+
+
+class TimelineBus:
+    """The process-wide causal event stream: a bounded ring of typed
+    events on one monotonic clock."""
+
+    def __init__(self, capacity: int = RING_CAPACITY):
+        # plain lock by design — see the module docstring (the bus must
+        # not recurse into graftsched's lock_acquire events)
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=capacity)
+        self._seq = 0
+        self.capacity = capacity
+        self.t0 = time.perf_counter()
+        self.epoch_unix = time.time()
+
+    # -- clock --
+
+    def to_ms(self, perf_t: float) -> float:
+        """A ``perf_counter`` instant on the bus clock (ms since the
+        bus epoch — the same family as graftscope's ``t_ms``)."""
+        return round((perf_t - self.t0) * 1e3, 3)
+
+    def now_ms(self) -> float:
+        return self.to_ms(time.perf_counter())
+
+    # -- recording --
+
+    def emit(self, kind: str, *, rid=None, key: Optional[str] = None,
+             replica: Optional[str] = None, t: Optional[float] = None,
+             **fields) -> None:
+        """Publish one typed event. ``rid`` may be a string, a sequence
+        of strings (a shared batched phase), or None — None resolves
+        from the ambient correlation (``correlate`` set, else the
+        ambient request trace). ``t`` backdates the event to an
+        already-measured ``perf_counter`` instant (schedulers stamping
+        a window they timed themselves)."""
+        if not _enabled[0]:
+            return
+        rids = None
+        if rid is None:
+            rid, rids = _ambient_rid()
+        elif not isinstance(rid, str):
+            seq_rids = tuple(r for r in rid if r)
+            rid, rids = ((seq_rids[0], None) if len(seq_rids) == 1
+                         else (None, seq_rids or None))
+        if replica is None:
+            replica = _REPLICA.get()
+        ts = self.to_ms(time.perf_counter() if t is None else t)
+        ev = {"kind": kind, "ts": ts,
+              "tid": threading.get_ident()}
+        if rid is not None:
+            ev["rid"] = rid
+        if rids:
+            ev["rids"] = list(rids)
+        if key is not None:
+            ev["key"] = key
+        if replica is not None:
+            ev["replica"] = replica
+        ev.update(fields)
+        with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
+            self._events.append(ev)
+
+    # -- reading --
+
+    def events(self, rid: Optional[str] = None,
+               since: Optional[float] = None,
+               kinds: Optional[Iterable[str]] = None,
+               n: Optional[int] = None) -> List[dict]:
+        """Filtered copy of the stream in CLOCK order (ts, seq-broken
+        ties), oldest first — producers may backdate an event to an
+        already-measured instant (a scheduler stamping a window it
+        timed itself), so append order alone is not the causal order;
+        the one clock is. ``rid`` matches the event's ``rid`` or
+        membership in its ``rids``; ``since`` is an exclusive ``ts``
+        lower bound (ms on the bus clock); ``kinds`` keeps only those
+        kinds; ``n`` caps to the NEWEST n after filtering."""
+        with self._lock:
+            evs = list(self._events)
+        # sort OUTSIDE the hold: every hot-path emit contends on this
+        # lock, and an O(n log n) pass over a full ring inside the
+        # critical section would stall producers on every debug poll
+        evs.sort(key=lambda e: (e["ts"], e["seq"]))
+        if rid is not None:
+            evs = [e for e in evs
+                   if e.get("rid") == rid or rid in e.get("rids", ())]
+        if since is not None:
+            evs = [e for e in evs if e["ts"] > since]
+        if kinds is not None:
+            keep = set(kinds)
+            evs = [e for e in evs if e["kind"] in keep]
+        if n is not None:
+            n = int(n)
+            evs = evs[-n:] if n > 0 else []   # n=0 means none, not all
+        return [dict(e) for e in evs]
+
+    def snapshot(self, rid: Optional[str] = None,
+                 since: Optional[float] = None,
+                 kinds: Optional[Iterable[str]] = None,
+                 n: Optional[int] = None) -> dict:
+        """The ``/debug/timeline`` payload body: the filtered stream
+        plus the clock header a consumer needs to join or rebase it."""
+        evs = self.events(rid=rid, since=since, kinds=kinds, n=n)
+        with self._lock:
+            emitted = self._seq
+            held = len(self._events)
+        return {
+            "enabled": enabled(),
+            "capacity": self.capacity,
+            "emitted_total": emitted,
+            "dropped": max(emitted - held, 0),
+            "clock": {
+                "epoch_unix": round(self.epoch_unix, 6),
+                "now_ms": self.now_ms(),
+                "model": ("perf_counter ms since bus epoch; one shared "
+                          "clock in-process, rebase() across processes"),
+            },
+            "kinds": dict(EVENT_KINDS),
+            "events": evs,
+        }
+
+    # -- test isolation (tests/conftest.py) --
+
+    def dump_state(self) -> tuple:
+        with self._lock:
+            return (list(self._events), self._seq, self.t0,
+                    self.epoch_unix)
+
+    def restore_state(self, state: tuple) -> None:
+        events, seq, t0, epoch = state
+        with self._lock:
+            self._events = deque(events, maxlen=self.capacity)
+            self._seq = seq
+            self.t0 = t0
+            self.epoch_unix = epoch
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._seq = 0
+            self.t0 = time.perf_counter()
+            self.epoch_unix = time.time()
+
+
+def _env_capacity() -> int:
+    try:
+        n = int(os.environ.get("GRAFTTIME_CAP", ""))
+    except ValueError:
+        return RING_CAPACITY
+    return n if n >= 1 else RING_CAPACITY
+
+
+# process-wide default bus (what every producer publishes to; tests
+# snapshot/restore it via the conftest fixture)
+BUS = TimelineBus(_env_capacity())
+
+
+# -- module-level conveniences (the call-site API) ---------------------------
+
+
+def emit(kind: str, **kw) -> None:
+    """The production hook — the form the timeline pass recognizes:
+    ``grafttime.emit("<kind>", <required fields>, ...)`` with a literal
+    kind from :data:`EVENT_KINDS`."""
+    BUS.emit(kind, **kw)
+
+
+def events(**kw) -> List[dict]:
+    return BUS.events(**kw)
+
+
+def snapshot(**kw) -> dict:
+    return BUS.snapshot(**kw)
+
+
+def to_ms(perf_t: float) -> float:
+    return BUS.to_ms(perf_t)
+
+
+def now_ms() -> float:
+    return BUS.now_ms()
+
+
+def dump_state() -> tuple:
+    return BUS.dump_state()
+
+
+def restore_state(state: tuple) -> None:
+    BUS.restore_state(state)
+
+
+def clear() -> None:
+    BUS.clear()
+
+
+# -- replay projection --------------------------------------------------------
+
+
+def replay_view(evs: List[dict]) -> Dict[str, List[dict]]:
+    """THE canonical determinism projection (module docstring "Replay
+    contract"): per-rid substreams (shared ``rids`` events land in
+    every member's substream), schedule-observation kinds dropped,
+    wall-clock fields stripped. Two runs of the same seeded schedule
+    must serialize this byte-identically (``json.dumps``, sorted
+    rids); uncorrelated events are excluded — they belong to no
+    request's causal story."""
+    out: Dict[str, List[dict]] = {}
+    for e in evs:
+        if e["kind"] in REPLAY_EXEMPT_KINDS:
+            continue
+        targets = ([e["rid"]] if "rid" in e else list(e.get("rids", ())))
+        if not targets:
+            continue
+        core = {k: v for k, v in e.items()
+                if k not in REPLAY_EXEMPT_FIELDS}
+        for r in targets:
+            out.setdefault(r, []).append(core)
+    return {r: out[r] for r in sorted(out)}
+
+
+def rebase(evs: List[dict], offset_ms: float) -> List[dict]:
+    """Shift a downstream process's events onto the caller's clock:
+    ``ts += offset_ms`` where the offset is the hop start on the
+    caller's clock (the ``RequestTrace.graft`` stitching rule — the
+    skew IS the hop's queueing). In-process fleets share one bus and
+    never need this; a wire deployment rebases each replica's fetched
+    stream before merging."""
+    out = []
+    for e in evs:
+        e2 = dict(e)
+        e2["ts"] = round(e["ts"] + offset_ms, 3)
+        out.append(e2)
+    return out
+
+
+# -- Chrome-trace / Perfetto export -------------------------------------------
+
+# event phases (Chrome Trace Event Format): X = complete (ts + dur),
+# i = instant, C = counter
+_WINDOW_KINDS = {"span_close": "span", "dispatch_end": "dispatch"}
+
+
+def _pid_of(replica: Optional[str], pids: Dict[str, int]) -> int:
+    """Stable small pid per replica label (Chrome wants numeric pids);
+    unlabeled events ride pid 1."""
+    if not replica:
+        return 1
+    if replica not in pids:
+        pids[replica] = 2 + len(pids)
+    return pids[replica]
+
+
+def export_chrome(evs: List[dict], meta: Optional[dict] = None) -> dict:
+    """Convert a timeline stream to Chrome-trace JSON (load it in
+    ``chrome://tracing`` or ui.perfetto.dev). Mapping: ``span_close`` /
+    ``dispatch_end`` become complete ("X") slices over their measured
+    window; ``occupancy`` becomes a counter ("C") series; everything
+    else becomes an instant ("i") marker. Correlators ride ``args``;
+    replicas map to pids, emitting threads to tids. ``ts`` is
+    microseconds, per the format."""
+    trace_events: List[dict] = []
+    pids: Dict[str, int] = {}
+    for e in evs:
+        kind = e["kind"]
+        args = {k: v for k, v in e.items()
+                if k not in ("kind", "ts", "tid", "seq")}
+        pid = _pid_of(e.get("replica"), pids)
+        tid = int(e.get("tid", 0)) % 2 ** 31
+        ts_us = max(e["ts"], 0.0) * 1e3
+        if kind in _WINDOW_KINDS:
+            dur_us = max(float(e.get("dur_ms", 0.0)), 0.0) * 1e3
+            trace_events.append({
+                "name": str(e.get("name") or e.get("scope") or kind),
+                "cat": _WINDOW_KINDS[kind],
+                "ph": "X",
+                "ts": max(ts_us - dur_us, 0.0),
+                "dur": dur_us,
+                "pid": pid, "tid": tid, "args": args,
+            })
+        elif kind == "occupancy":
+            trace_events.append({
+                "name": str(e.get("name", "occupancy")),
+                "cat": "occupancy",
+                "ph": "C",
+                "ts": ts_us,
+                "pid": pid, "tid": tid,
+                "args": {"value": float(e.get("value", 0.0))},
+            })
+        else:
+            trace_events.append({
+                "name": (f"{kind}:{e['name']}" if "name" in e
+                         else (f"{kind}:{e['scope']}" if "scope" in e
+                               else kind)),
+                "cat": kind,
+                "ph": "i",
+                "s": "t",
+                "ts": ts_us,
+                "pid": pid, "tid": tid, "args": args,
+            })
+    return {
+        "displayTimeUnit": "ms",
+        "traceEvents": trace_events,
+        "otherData": {"producer": "grafttime",
+                      "kinds": sorted({e["kind"] for e in evs}),
+                      **(meta or {})},
+    }
+
+
+_VALID_PH = {"X", "i", "C", "B", "E", "I"}
+
+
+def validate_chrome(payload: dict) -> List[str]:
+    """Structural schema check on an export (empty list = valid): the
+    timeline pass runs this over a synthetic event per vocabulary kind,
+    and the export tests run it over real streams, so a mapping bug
+    fails statically before it fails a trace viewer."""
+    problems: List[str] = []
+    if not isinstance(payload, dict):
+        return ["payload is not an object"]
+    tes = payload.get("traceEvents")
+    if not isinstance(tes, list):
+        return ["traceEvents missing or not a list"]
+    for i, te in enumerate(tes):
+        where = f"traceEvents[{i}]"
+        if not isinstance(te, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        if not isinstance(te.get("name"), str) or not te.get("name"):
+            problems.append(f"{where}: name must be a non-empty string")
+        if te.get("ph") not in _VALID_PH:
+            problems.append(f"{where}: ph {te.get('ph')!r} invalid")
+        if not isinstance(te.get("ts"), (int, float)) or te["ts"] < 0:
+            problems.append(f"{where}: ts must be a number >= 0")
+        for fld in ("pid", "tid"):
+            if not isinstance(te.get(fld), int):
+                problems.append(f"{where}: {fld} must be an int")
+        if te.get("ph") == "X" and (
+                not isinstance(te.get("dur"), (int, float))
+                or te["dur"] < 0):
+            problems.append(f"{where}: X event needs dur >= 0")
+        if te.get("ph") == "i" and te.get("s") not in ("g", "p", "t"):
+            problems.append(f"{where}: instant needs s in g/p/t")
+    return problems
+
+
+def sample_event(kind: str) -> dict:
+    """A schema-complete synthetic event for one vocabulary kind — what
+    the timeline pass feeds ``export_chrome``/``validate_chrome`` so
+    export validity is checked per kind, compile-free."""
+    if kind not in EVENT_KINDS:
+        raise KeyError(f"unknown timeline kind {kind!r}")
+    ev = {"kind": kind, "ts": 1.0, "tid": 1, "seq": 1, "rid": "r0",
+          "replica": "solo"}
+    fills = {"rid": "r0", "name": "x", "scope": "mod._fn", "key": "('k',)",
+             "value": 1.0, "wait_ms": 0.1, "site": "mod.site",
+             "fault": "kindname", "state": "closed", "blocks": 1,
+             "reason": "preempt", "to_plan": "solo", "dur_ms": 0.5}
+    for f in KIND_FIELDS.get(kind, ()):
+        ev[f] = fills[f]
+    if kind in _WINDOW_KINDS:
+        ev["dur_ms"] = 0.5
+    return ev
+
+
+# -- the /debug/timeline payload ----------------------------------------------
+
+
+def debug_timeline_payload(query: dict, serving: dict):
+    """The ``GET /debug/timeline`` response body (``?rid=``,
+    ``?since=``, ``?kinds=``, ``?n=``) — ONE implementation shared by
+    the replica surface (serving/app.py) and the fleet router
+    (serving/router.py), the ``tracing.debug_requests_payload``
+    discipline: a new filter cannot land on one debug surface and
+    silently desynchronize the other. ``serving`` is the per-app
+    identity block. Returns ``(422, detail)`` on an unparseable or
+    out-of-vocabulary filter."""
+    since = query.get("since")
+    if since is not None:
+        try:
+            since = float(since)
+        except ValueError:
+            return 422, {"detail": "since must be a number (ms on the "
+                                   "bus clock)"}
+    kinds = None
+    if query.get("kinds"):
+        kinds = [k.strip() for k in query["kinds"].split(",")
+                 if k.strip()]
+        unknown = sorted(set(kinds) - set(EVENT_KINDS))
+        if unknown:
+            return 422, {"detail": f"unknown kinds {unknown}; "
+                         f"vocabulary: {sorted(EVENT_KINDS)}"}
+    n = query.get("n")
+    if n is not None:
+        try:
+            n = int(n)
+        except ValueError:
+            return 422, {"detail": "n must be an integer"}
+    return {
+        "serving": serving,
+        **BUS.snapshot(rid=query.get("rid") or None, since=since,
+                       kinds=kinds, n=n),
+    }
+
+
+# -- black-box dumps ----------------------------------------------------------
+
+_DUMPS_LOCK = threading.Lock()
+_DUMPS: deque = deque(maxlen=BLACKBOX_CAPACITY)
+_DUMP_SEQ = [0]   # monotonic file index (never reuses a name even
+                  # after the bounded in-process ring rotates)
+
+
+def blackbox(reason: str, rid: Optional[str] = None) -> dict:
+    """Journal the current ring as a post-mortem dump: called by the
+    serving layer when a typed ``Unavailable`` or a ``GraftsanError``
+    surfaces, so the events that LED to the failure outlive the ring's
+    rotation. Kept in a bounded in-process ring
+    (:func:`blackbox_dumps`); additionally written to
+    ``$GRAFTTIME_DIR/grafttime_blackbox_<n>_<reason>.json`` when that
+    env var names a directory (best-effort — a failed write never
+    masks the original failure)."""
+    dump = {
+        "reason": reason,
+        "rid": rid,
+        "t_wall": time.time(),
+        **BUS.snapshot(),
+    }
+    with _DUMPS_LOCK:
+        _DUMPS.append(dump)
+        _DUMP_SEQ[0] += 1
+        n = _DUMP_SEQ[0]   # monotonic: dump 9 must not clobber dump 8's
+        # file just because the in-process ring holds only 8
+    out_dir = os.environ.get("GRAFTTIME_DIR", "")
+    if out_dir:
+        safe = "".join(c if c.isalnum() or c in "._-" else "_"
+                       for c in reason)[:48]
+        path = os.path.join(out_dir, f"grafttime_blackbox_{n}_{safe}.json")
+        try:
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(dump, f, default=str)
+        except OSError:
+            pass  # post-mortem best-effort: never mask the failure
+    return dump
+
+
+def blackbox_dumps() -> List[dict]:
+    with _DUMPS_LOCK:
+        return list(_DUMPS)
+
+
+def clear_blackbox() -> None:
+    with _DUMPS_LOCK:
+        _DUMPS.clear()

@@ -66,7 +66,7 @@ import dataclasses
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
-from . import graftsched, graftscope
+from . import graftsched, graftscope, grafttime
 
 # Lock-discipline contract (tools/graftcheck locks pass): the watcher's
 # observation window and the switcher's active-plan/in-flight/event
@@ -78,6 +78,16 @@ GUARDED_STATE = {"_window": "_lock", "_admitted": "_lock",
                  "_active": "_lock", "_inflight": "_lock",
                  "_events": "_lock", "_switches": "_lock"}
 LOCK_ORDER = ("_lock",)
+
+# Timeline contract (tools/graftcheck timeline pass): every wave
+# evaluation — and every actual switch — lands on the unified causal
+# stream (utils/grafttime), so the signals that provoked a plan change
+# are visible on the same clock as the change itself ("Learning to
+# Shard" decisions become auditable, not just journaled).
+TIMELINE_EVENTS = {
+    "plan_eval": "PlanSwitcher._evaluate",
+    "plan_switch": "PlanSwitcher._evaluate",
+}
 
 # -- declared signal provenance (the static watch pass reads these) ----------
 
@@ -680,6 +690,18 @@ class PlanSwitcher:
                 # the event MINUS this field (strip_time in events())
                 "t_ms": t_ms,
             })
+            # timeline emission UNDER the hold (the _sample_breaker
+            # precedent: a cheap bounded ring append, never a blocking
+            # call) — two racing wave evaluations must not publish
+            # their eval/switch events in inverted order
+            grafttime.emit("plan_eval", to_plan=decision,
+                           from_plan=current,
+                           wave=admitted // self.wave,
+                           switched=switched_from is not None)
+            if switched_from is not None:
+                grafttime.emit("plan_switch", to_plan=decision,
+                               from_plan=switched_from,
+                               wave=admitted // self.wave)
         if switched_from is not None:
             self._announce(decision, previous=switched_from)
 

@@ -50,7 +50,7 @@ import uuid
 from collections import deque
 from typing import Iterator, List, Optional
 
-from . import graftsched
+from . import graftsched, grafttime
 
 # Lock-discipline contract (tools/graftcheck locks pass): a trace's
 # committed root spans and the flight recorder's ring are the only
@@ -60,6 +60,15 @@ from . import graftsched
 # target's own lock.
 GUARDED_STATE = {"spans": "_lock", "_traces": "_lock"}
 LOCK_ORDER = ("_lock",)
+
+# Timeline contract (tools/graftcheck timeline pass): every span lands
+# on the unified causal stream (utils/grafttime) — open at entry, close
+# with its measured window — correlated by the owning trace's
+# X-Request-ID (fanout spans carry every participating rid).
+TIMELINE_EVENTS = {
+    "span_open": "_TraceSink.span",
+    "span_close": "_TraceSink.span / add_span / RequestTrace.finish",
+}
 
 
 @contextlib.contextmanager
@@ -188,6 +197,12 @@ class _TraceSink:
             st = self._tls.stack = []
         return st
 
+    def _rid(self):
+        """This sink's timeline correlation: the owning request's id
+        (a fanout returns every target's — the shared-phase analog);
+        the bare sink has none."""
+        return getattr(self, "request_id", None)
+
     def _commit(self, span: Span) -> None:
         stack = self._stack()
         if stack:
@@ -201,18 +216,23 @@ class _TraceSink:
         s = Span(name, time.perf_counter(), labels=labels)
         stack = self._stack()
         stack.append(s)
+        grafttime.emit("span_open", name=name, rid=self._rid(), t=s.t0)
         try:
             yield s
         finally:
             s.t1 = time.perf_counter()
             stack.pop()
             self._commit(s)
+            grafttime.emit("span_close", name=name, rid=self._rid(),
+                           t=s.t1, dur_ms=round(s.duration * 1e3, 3))
 
     def add_span(self, name: str, t0: float, t1: float, **labels) -> Span:
         """Record an already-timed span (schedulers time phases once and
         attach them to every participating request)."""
         s = Span(name, t0, t1, labels=labels)
         self._commit(s)
+        grafttime.emit("span_close", name=name, rid=self._rid(), t=t1,
+                       dur_ms=round(s.duration * 1e3, 3))
         return s
 
     def event(self, name: str, **labels) -> Span:
@@ -234,6 +254,12 @@ class RequestTrace(_TraceSink):
     def finish(self) -> "RequestTrace":
         if self.t1 is None:
             self.t1 = time.perf_counter()
+            # the request's terminal timeline event: the whole-request
+            # window closing (the "final span close" a /debug/timeline
+            # ?rid= stream ends on)
+            grafttime.emit("span_close", name="request",
+                           rid=self.request_id, t=self.t1,
+                           dur_ms=round(self.duration * 1e3, 3))
         return self
 
     @property
@@ -323,6 +349,10 @@ class _FanoutTrace(_TraceSink):
     def __init__(self, traces: List[RequestTrace]):
         super().__init__()
         self._targets = [t for t in traces if t is not None]
+
+    def _rid(self):
+        # one shared phase, every participating request's stream
+        return tuple(t.request_id for t in self._targets)
 
     def _commit(self, span: Span) -> None:
         stack = self._stack()

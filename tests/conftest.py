@@ -80,6 +80,7 @@ _QUICK_MODULES = {
     "test_graftload",       # open-loop load harness + declared SLOs
     "test_graftfleet",      # disaggregated fleet: router, handoff, pass
     "test_graftwatch",      # continuous re-planning: watcher, switcher
+    "test_grafttime",       # unified causal timeline: bus, export, pass
 }
 
 
@@ -106,10 +107,14 @@ def _metrics_isolation():
     test's generate calls must not inflate another's counters or
     dispatch rings). ``create_app`` additionally accepts an injected
     registry/recorder for tests that want full isolation."""
-    from llm_sharding_demo_tpu.utils import graftscope, metrics, tracing
+    from llm_sharding_demo_tpu.utils import (graftscope, grafttime,
+                                             metrics, tracing)
     state = metrics.REGISTRY.dump_state()
     scope_state = graftscope.dump_state()
     scope_flags = (graftscope.enabled(), graftscope.sync_enabled())
+    time_state = grafttime.dump_state()
+    time_enabled = grafttime.enabled()
+    blackbox_saved = grafttime.blackbox_dumps()
     with tracing.RECORDER._lock:
         saved = list(tracing.RECORDER._traces)
     yield
@@ -117,6 +122,11 @@ def _metrics_isolation():
     graftscope.restore_state(scope_state)
     graftscope.set_enabled(scope_flags[0])
     graftscope.set_sync(scope_flags[1])
+    grafttime.restore_state(time_state)
+    grafttime.set_enabled(time_enabled)
+    grafttime.clear_blackbox()
+    with grafttime._DUMPS_LOCK:
+        grafttime._DUMPS.extend(blackbox_saved)
     with tracing.RECORDER._lock:
         tracing.RECORDER._traces.clear()
         tracing.RECORDER._traces.extend(saved)

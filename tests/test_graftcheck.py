@@ -182,6 +182,26 @@ def test_repo_passes_graftcheck():
         "llm_sharding_demo_tpu/utils/graftwatch.py", 0) >= 10, (
         "utils/graftwatch.py: PLAN_SIGNALS no longer resolves the "
         "declared signal vocabulary to emitted METRIC_CATALOG series")
+    assert payload["timeline_checks"] >= 10, (
+        "grafttime timeline pass went vacuous — a new "
+        "undeclared-timeline-event / timeline-event-not-emitted "
+        "finding anywhere in the tree fails this strict run (rule "
+        "fixtures in tests/test_grafttime.py)")
+    assert payload["timeline_vacuous"] == [], (
+        "TIMELINE_EVENTS declarations with no live emission (a "
+        "timeline producer went dark): "
+        f"{payload['timeline_vacuous']}")
+    # the spine's producers each publish at least one live kind
+    tl = payload["timeline_kinds"]
+    for mod, floor in (("llm_sharding_demo_tpu/utils/tracing.py", 2),
+                       ("llm_sharding_demo_tpu/utils/graftscope.py", 3),
+                       ("llm_sharding_demo_tpu/runtime/iterbatch.py", 5),
+                       ("llm_sharding_demo_tpu/utils/graftfault.py", 2),
+                       ("llm_sharding_demo_tpu/utils/graftwatch.py", 2),
+                       ("llm_sharding_demo_tpu/loadgen/driver.py", 1)):
+        assert tl.get(mod, 0) >= floor, (
+            f"{mod}: fewer than {floor} live timeline kind(s) — a "
+            "declared producer stopped publishing")
     assert payload["suppressed"] >= 1, (
         "the documented sync points should be baselined findings — an "
         "empty suppression set means the host-sync rule stopped seeing "

@@ -501,6 +501,8 @@ def test_verifier_json_schema_shape():
                             "fleet_vacuous",
                             "watch_checks", "watch_signals",
                             "watch_vacuous",
+                            "timeline_checks", "timeline_kinds",
+                            "timeline_vacuous",
                             "recompile_bounds"}
     assert isinstance(payload["ok"], bool)
     assert isinstance(payload["sanitize_checks"], int)
@@ -522,6 +524,9 @@ def test_verifier_json_schema_shape():
     assert isinstance(payload["watch_checks"], int)
     assert isinstance(payload["watch_signals"], dict)
     assert isinstance(payload["watch_vacuous"], list)
+    assert isinstance(payload["timeline_checks"], int)
+    assert isinstance(payload["timeline_kinds"], dict)
+    assert isinstance(payload["timeline_vacuous"], list)
     assert isinstance(payload["strict"], bool)
     assert isinstance(payload["findings"], list)
     assert isinstance(payload["suppressed"], int)

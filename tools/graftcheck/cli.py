@@ -58,7 +58,7 @@ def run(root: str = None, lint_only: bool = False,
         sys.path.insert(0, root)
     try:
         from . import faults, fleet, lint, locks, sanitize, scope, slo, \
-            watch
+            timeline, watch
         findings = list(lint.run_lint(root))
         san, sanitize_checks = sanitize.run_sanitize(root)
         findings.extend(san)
@@ -74,6 +74,8 @@ def run(root: str = None, lint_only: bool = False,
         findings.extend(ft)
         wt, watch_summary = watch.run_watch(root)
         findings.extend(wt)
+        tl, timeline_summary = timeline.run_timeline(root)
+        findings.extend(tl)
         semantic_checks = 0
         bounds = {}
         if not lint_only:
@@ -122,13 +124,17 @@ def run(root: str = None, lint_only: bool = False,
         # and on a VACUOUS watch contract (PLAN_SIGNALS resolving to no
         # live emitted series, or a PLAN_SET no builder constructs —
         # the live re-planner went blind or uncertified)
+        # and on a VACUOUS timeline contract (a TIMELINE_EVENTS
+        # declaration none of whose kinds are emitted — a producer on
+        # the unified causal stream went dark)
         "ok": (not active and not (strict and stale)
                and not (strict and locks_summary["vacuous"])
                and not (strict and scope_summary["vacuous"])
                and not (strict and faults_summary["vacuous"])
                and not (strict and slo_summary["vacuous"])
                and not (strict and fleet_summary["vacuous"])
-               and not (strict and watch_summary["vacuous"])),
+               and not (strict and watch_summary["vacuous"])
+               and not (strict and timeline_summary["vacuous"])),
         "strict": strict,
         "findings": [f.to_dict() for f in active],
         "suppressed": len(suppressed),
@@ -154,6 +160,9 @@ def run(root: str = None, lint_only: bool = False,
         "watch_checks": watch_summary["watch_checks"],
         "watch_signals": watch_summary["watch_signals"],
         "watch_vacuous": watch_summary["vacuous"],
+        "timeline_checks": timeline_summary["timeline_checks"],
+        "timeline_kinds": timeline_summary["timeline_kinds"],
+        "timeline_vacuous": timeline_summary["vacuous"],
         "recompile_bounds": bounds,
     }
 
@@ -369,7 +378,8 @@ def main(argv=None) -> int:
               f"{payload['scope_checks']} scope checks, "
               f"{payload['slo_checks']} slo checks, "
               f"{payload['fleet_checks']} fleet checks, "
-              f"{payload['watch_checks']} watch checks"
+              f"{payload['watch_checks']} watch checks, "
+              f"{payload['timeline_checks']} timeline checks"
               + ("" if args.lint_only else
                  f", recompile bounds for {len(payload['recompile_bounds'])}"
                  " workload(s)"))

@@ -1,0 +1,284 @@
+"""graftcheck timeline pass: declared-event static analysis (compile-free).
+
+The grafttime bus (``llm_sharding_demo_tpu/utils/grafttime.py``) only
+earns the name "unified causal timeline" if every producer actually
+publishes what it claims and nothing publishes off-vocabulary — a
+timeline with silent gaps is worse than silos, because it LOOKS
+complete. This pass (the static half of grafttime, riding ``python -m
+tools.graftcheck`` and the strict in-suite driver, mirroring the
+slo/watch emission-scan split) holds the declarations to that bar:
+
+In-file declarations (the registration-annotation idiom of
+``FAULT_POLICY`` / ``SLO_POLICY`` / ``PLAN_SIGNALS``):
+
+- ``TIMELINE_EVENTS``: ``{kind: source}`` — which vocabulary kinds this
+  module publishes and from where (source is reviewable provenance
+  prose; the kind set is what the pass verifies).
+
+The fixed vocabulary and the per-kind required fields live in
+``grafttime.EVENT_KINDS`` / ``grafttime.KIND_FIELDS`` (injectable here
+for fixtures).
+
+Rules (ids in brackets; suppressions ride the shared baseline):
+
+- [undeclared-timeline-event]   an ``grafttime.emit(...)`` call whose
+                                kind is not a string literal (a dynamic
+                                kind is unreviewable), is outside the
+                                fixed vocabulary, or is not declared in
+                                the module's TIMELINE_EVENTS; a
+                                malformed declaration (non-literal
+                                dict, non-string source); an emit site
+                                missing a required correlator/payload
+                                keyword for its kind
+                                (``grafttime.KIND_FIELDS`` — the value
+                                may be None at runtime, but the site
+                                must SPELL the field).
+- [timeline-event-not-emitted]  a declared kind with no emit site in
+                                the module (stale declaration — the
+                                producer stopped publishing and the
+                                timeline silently lost a signal), or a
+                                declared kind outside the vocabulary.
+
+Export schema: the pass additionally builds one schema-complete
+synthetic event per vocabulary kind (``grafttime.sample_event``), runs
+it through ``export_chrome`` + ``validate_chrome``, and fails on any
+schema problem — the Chrome-trace export cannot drift invalid without
+failing CI, compile-free.
+
+``--strict`` additionally fails a VACUOUS pass (a module declaring
+TIMELINE_EVENTS none of whose kinds are emitted — the producer went
+dark); ``cli.run --json`` carries ``timeline_checks`` /
+``timeline_kinds`` / ``timeline_vacuous``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from . import lint as L
+from .core import Finding
+from .locks import _module_assign
+
+TIMELINE_RULE_IDS = ("undeclared-timeline-event",
+                     "timeline-event-not-emitted")
+
+
+class _EmitSite:
+    __slots__ = ("kind", "line", "scope", "kwargs", "literal")
+
+    def __init__(self, kind, line, scope, kwargs, literal):
+        self.kind = kind          # str or None (non-literal)
+        self.line = line
+        self.scope = scope
+        self.kwargs = kwargs      # keyword names spelled at the site
+        self.literal = literal
+
+
+class _EmitScanner(ast.NodeVisitor):
+    """Collect ``grafttime.emit("<kind>", ...)`` call sites with their
+    enclosing scope and spelled keyword names."""
+
+    def __init__(self):
+        self.sites: List[_EmitSite] = []
+        self._scope = ["<module>"]
+
+    def _visit_func(self, node):
+        self._scope.append(node.name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_ClassDef(self, node):
+        self._scope.append(node.name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    def visit_Call(self, node):
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr == "emit"
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "grafttime"):
+            kind = None
+            literal = False
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                kind = node.args[0].value
+                literal = True
+            kwargs = {kw.arg for kw in node.keywords
+                      if kw.arg is not None}
+            self.sites.append(_EmitSite(kind, node.lineno,
+                                        self._scope[-1], kwargs,
+                                        literal))
+        self.generic_visit(node)
+
+
+def _declared_events(stmt: ast.Assign
+                     ) -> Optional[List[Tuple[str, int]]]:
+    """TIMELINE_EVENTS dict literal -> [(kind, line)]; None when the
+    declaration is not a statically readable string->string dict."""
+    node = stmt.value
+    if not isinstance(node, ast.Dict):
+        return None
+    out = []
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                and isinstance(v, ast.Constant)
+                and isinstance(v.value, str)):
+            return None
+        out.append((k.value, k.lineno))
+    return out
+
+
+def run_timeline(root: str, paths: Optional[List[str]] = None,
+                 vocabulary: Optional[Dict[str, str]] = None,
+                 kind_fields: Optional[Dict[str, tuple]] = None,
+                 check_export: bool = True,
+                 ) -> Tuple[List[Finding], dict]:
+    """The whole static pass -> (findings, summary). ``summary``
+    carries ``timeline_checks`` (declarations + emit sites + export
+    kinds validated — the vacuity guard on the pass itself),
+    ``timeline_kinds`` (per-module count of declared kinds with a live
+    emit site) and ``vacuous`` (modules whose TIMELINE_EVENTS matches
+    no emission — the strict driver fails these).
+    ``vocabulary``/``kind_fields`` are injectable for rule fixtures; by
+    default the real ``grafttime.EVENT_KINDS`` / ``KIND_FIELDS``."""
+    if vocabulary is None or kind_fields is None:
+        from llm_sharding_demo_tpu.utils import grafttime as GT
+        vocabulary = vocabulary if vocabulary is not None \
+            else GT.EVENT_KINDS
+        kind_fields = kind_fields if kind_fields is not None \
+            else GT.KIND_FIELDS
+
+    findings: List[Finding] = []
+    checks = 0
+    kinds_live: Dict[str, int] = {}
+    vacuous: List[str] = []
+
+    for path in (paths if paths is not None else L.iter_sources(root)):
+        mod = L.index_module(path, root)
+        if mod is None:
+            continue
+        if mod.relpath == "llm_sharding_demo_tpu/utils/grafttime.py":
+            # the bus itself is the apparatus, not a producer (the
+            # graftsched-exemption precedent)
+            continue
+        decl_stmt = _module_assign(mod, "TIMELINE_EVENTS")
+        scanner = _EmitScanner()
+        scanner.visit(mod.tree)
+        sites = scanner.sites
+        if decl_stmt is None and not sites:
+            continue
+        checks += 1
+
+        declared: Dict[str, int] = {}
+        if decl_stmt is not None:
+            entries = _declared_events(decl_stmt)
+            if entries is None:
+                findings.append(Finding(
+                    "undeclared-timeline-event", mod.relpath,
+                    decl_stmt.lineno, "<module>",
+                    "TIMELINE_EVENTS must be a dict literal of string "
+                    "kind -> string source (the timeline pass reads it "
+                    "statically)"))
+            else:
+                declared = dict(entries)
+        elif sites:
+            findings.append(Finding(
+                "undeclared-timeline-event", mod.relpath,
+                sites[0].line, sites[0].scope,
+                f"module emits {len(sites)} timeline event(s) but "
+                "declares no TIMELINE_EVENTS — declare {kind: source} "
+                "so the producer set is reviewable"))
+
+        emitted_kinds = set()
+        for s in sites:
+            checks += 1
+            if not s.literal:
+                findings.append(Finding(
+                    "undeclared-timeline-event", mod.relpath, s.line,
+                    s.scope,
+                    "grafttime.emit kind must be a string literal from "
+                    "the fixed vocabulary (a computed kind is "
+                    "unreviewable and unjoinable)"))
+                continue
+            emitted_kinds.add(s.kind)
+            if s.kind not in vocabulary:
+                findings.append(Finding(
+                    "undeclared-timeline-event", mod.relpath, s.line,
+                    s.scope,
+                    f"timeline kind {s.kind!r} is outside the fixed "
+                    f"vocabulary ({sorted(vocabulary)}) — a new event "
+                    "class is a reviewed grafttime.EVENT_KINDS change"))
+                continue
+            if declared and s.kind not in declared:
+                findings.append(Finding(
+                    "undeclared-timeline-event", mod.relpath, s.line,
+                    s.scope,
+                    f"timeline kind {s.kind!r} is emitted here but not "
+                    "declared in this module's TIMELINE_EVENTS"))
+            missing = [f for f in kind_fields.get(s.kind, ())
+                       if f not in s.kwargs]
+            if missing:
+                findings.append(Finding(
+                    "undeclared-timeline-event", mod.relpath, s.line,
+                    s.scope,
+                    f"timeline kind {s.kind!r} emit site does not "
+                    f"spell required field(s) {missing} — the schema "
+                    "(grafttime.KIND_FIELDS) makes correlators "
+                    "reviewable at every site"))
+
+        live = 0
+        for kind, line in declared.items():
+            checks += 1
+            if kind not in vocabulary:
+                findings.append(Finding(
+                    "timeline-event-not-emitted", mod.relpath, line,
+                    "<module>",
+                    f"TIMELINE_EVENTS declares {kind!r}, which is "
+                    f"outside the fixed vocabulary "
+                    f"({sorted(vocabulary)})"))
+                continue
+            if kind in emitted_kinds:
+                live += 1
+            else:
+                findings.append(Finding(
+                    "timeline-event-not-emitted", mod.relpath, line,
+                    "<module>",
+                    f"TIMELINE_EVENTS declares {kind!r} but no "
+                    "grafttime.emit site in this module publishes it — "
+                    "the timeline silently lost a declared signal "
+                    "(stale declaration?)"))
+        if declared:
+            kinds_live[mod.relpath] = live
+            if live == 0:
+                vacuous.append(mod.relpath)
+
+    if check_export:
+        # export schema validity, one synthetic event per kind: the
+        # Chrome-trace mapping cannot drift invalid without a finding
+        from llm_sharding_demo_tpu.utils import grafttime as GT
+        for kind in sorted(vocabulary):
+            checks += 1
+            try:
+                payload = GT.export_chrome([GT.sample_event(kind)])
+                problems = GT.validate_chrome(payload)
+            except Exception as e:  # noqa: BLE001 — a crash IS a finding
+                problems = [f"{type(e).__name__}: {e}"]
+            for p in problems:
+                findings.append(Finding(
+                    "undeclared-timeline-event",
+                    "llm_sharding_demo_tpu/utils/grafttime.py", 1,
+                    kind,
+                    f"Chrome-trace export of kind {kind!r} is "
+                    f"schema-invalid: {p}"))
+
+    summary = {
+        "timeline_checks": checks,
+        "timeline_kinds": kinds_live,
+        "vacuous": sorted(vacuous),
+    }
+    return (sorted(findings, key=lambda f: (f.path, f.line, f.rule)),
+            summary)

@@ -1,0 +1,103 @@
+"""grafttime export CLI: captured timeline streams -> Chrome trace JSON.
+
+Usage:
+
+    python -m tools.grafttime export --input timeline.json [-o out.json]
+    curl .../debug/timeline?rid=abc > timeline.json \\
+        && python -m tools.grafttime export --input timeline.json
+
+Accepted input shapes (all produced by the runtime itself):
+
+- a ``GET /debug/timeline`` payload (``{"events": [...], "clock": ...}``),
+- a black-box dump (``grafttime.blackbox`` — the same payload plus
+  ``reason``/``rid``; ``$GRAFTTIME_DIR/grafttime_blackbox_*.json``),
+- a bare event list (``[...]``).
+
+The export is validated against the Chrome Trace Event Format schema
+(``grafttime.validate_chrome``) before it is written: exit 0 on a valid
+trace, 1 when validation fails (the problems print to stderr), 2 on
+unreadable/unrecognized input. ``--input -`` reads stdin. Load the
+output in ``chrome://tracing`` or https://ui.perfetto.dev.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _load_events(doc) -> list:
+    """Pull the event list out of any accepted input shape; raises
+    ValueError on anything else (a typed refusal, not a guess)."""
+    if isinstance(doc, list):
+        events = doc
+    elif isinstance(doc, dict) and isinstance(doc.get("events"), list):
+        events = doc["events"]
+    else:
+        raise ValueError(
+            "unrecognized input: want a /debug/timeline payload, a "
+            "grafttime black-box dump, or a bare event list")
+    for e in events:
+        if not isinstance(e, dict) or "kind" not in e or "ts" not in e:
+            raise ValueError(
+                "event stream entries must be objects with at least "
+                f"'kind' and 'ts'; got {e!r}"[:160])
+    return events
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.grafttime",
+        description="unified-timeline tooling (utils/grafttime.py is "
+                    "the runtime bus; this converts captured streams "
+                    "to Chrome-trace/Perfetto JSON)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    ex = sub.add_parser("export", help="timeline stream -> Chrome trace")
+    ex.add_argument("--input", "-i", required=True,
+                    help="a /debug/timeline payload, black-box dump, or "
+                    "bare event list; '-' reads stdin")
+    ex.add_argument("--output", "-o", default="-",
+                    help="output path ('-' = stdout, the default)")
+    args = ap.parse_args(argv)
+
+    from llm_sharding_demo_tpu.utils import grafttime
+
+    try:
+        if args.input == "-":
+            doc = json.load(sys.stdin)
+        else:
+            with open(args.input, encoding="utf-8") as f:
+                doc = json.load(f)
+        events = _load_events(doc)
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        print(f"grafttime export: cannot read {args.input}: {e}",
+              file=sys.stderr)
+        return 2
+
+    meta = {}
+    if isinstance(doc, dict):
+        for k in ("reason", "rid", "clock"):
+            if doc.get(k) is not None:
+                meta[k] = doc[k]
+    payload = grafttime.export_chrome(events, meta=meta)
+    problems = grafttime.validate_chrome(payload)
+    if problems:
+        for p in problems:
+            print(f"grafttime export: invalid trace: {p}",
+                  file=sys.stderr)
+        return 1
+    text = json.dumps(payload, default=str)
+    if args.output == "-":
+        print(text)
+    else:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"grafttime export: {len(events)} event(s) -> "
+              f"{args.output}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
