@@ -1699,6 +1699,8 @@ def main() -> None:
             "locks_vacuous": payload["locks_vacuous"],
             "slo_checks": payload["slo_checks"],
             "slo_vacuous": payload["slo_vacuous"],
+            "numerics_checks": payload["numerics_checks"],
+            "numerics_vacuous": payload["numerics_vacuous"],
             "recompile_bounds": payload["recompile_bounds"],
         }
 
@@ -1828,8 +1830,37 @@ def main() -> None:
             "note": payload["note"],
         }
 
+    def cfg_numerics_oracle():
+        """graftnum tolerance-oracle row (ISSUE 15): every declared
+        TOLERANCE_POLICY path (int8 weight-only, bf16 decode) measured
+        against the f32 parity engine on the PINNED seed — per-path
+        logit MSE (lower-better) and greedy top-1 agreement
+        (higher-better), gated by tools/bench_diff.py so a quantizer or
+        mixed-precision regression lands in the trajectory as a
+        numerics drift, not a mystery token flip. Seeded and
+        replay-identical (tests/test_graftnum.py pins byte-identical
+        reports across fresh runs); CPU-safe, no tunnel dependency —
+        the oracle RAISES on a declared-budget breach, so this row
+        erroring is itself the signal."""
+        from llm_sharding_demo_tpu.utils import graftnum
+
+        rows = graftnum.oracle_rows(seed=0)
+        flat = {"seed": 0, "paths": len(rows)}
+        for r in rows:
+            # flatten per-path metrics so bench_diff gates them:
+            # decode_int8_logit_mse / decode_int8_top1_agreement / ...
+            # — the FULL path keys the row, so two policy paths sharing
+            # a suffix (decode.int8 vs a future kv.int8) can never
+            # silently shadow each other's gated metrics
+            tag = r["path"].replace(".", "_")
+            flat[f"{tag}_logit_mse"] = r["logit_mse"]
+            flat[f"{tag}_top1_agreement"] = r["top1_agreement"]
+            flat[f"{tag}_positions"] = r["n_positions"]
+        return flat
+
     safe("graftcheck_static_analysis", cfg_graftcheck)
     safe("graftcheck_chosen_plan", cfg_graftplan)
+    safe("numerics_oracle", cfg_numerics_oracle)
     safe("graftscope_attribution", cfg_graftscope_attribution)
     safe("ici_byte_weight_calibration", cfg_ici_calibration)
     safe("cfg1_tiny_gpt2_2shard_20tok", cfg1)

@@ -63,6 +63,45 @@ _LANE = 128
 MAX_BATCH = 16
 
 
+# Numerics contract (tools/graftcheck numerics pass): the megakernel's
+# in-register precision discipline, declared. Every tile op upcasts to
+# f32 (weights dequantize, LN stats, rope, online-softmax accumulators
+# all f32 — preferred_element_type on every dot) and the value stream
+# returns to the carried activation dtype exactly once per op. The
+# kernels engage only for non-fp32 regimes and their online softmax is
+# allclose-not-bitwise vs the XLA path, so the public entries are
+# ``exact: False`` routed to graftnum's ``decode.bf16`` budget (int8
+# engines additionally ride ops/quant.py's ``decode.int8`` entries).
+PRECISION_CONTRACT = {
+    "decode_layers": {"regime": "carried", "exact": False,
+                      "oracle": "decode.bf16", "casts": ()},
+    "decode_layers_llama": {"regime": "carried", "exact": False,
+                            "oracle": "decode.bf16",
+                            "casts": ("f32",)},  # rope cos/sin upcast
+    "_ln": {"regime": "carried", "exact": True,
+            "casts": ("f32", "carried")},
+    "_rms": {"regime": "carried", "exact": True,
+             "casts": ("f32", "carried")},
+    "_gelu_new": {"regime": "carried", "exact": True, "casts": ()},
+    "_matmul": {"regime": "carried", "exact": True, "accumulate": "f32",
+                "casts": ("f32", "carried")},
+    "_split_rows": {"regime": "f32", "exact": True, "accumulate": "f32",
+                    "casts": ("f32",)},
+    "_merge_rows": {"regime": "f32", "exact": True, "accumulate": "f32",
+                    "casts": ("f32",)},
+    "_rope_rows": {"regime": "f32", "exact": True, "accumulate": "f32",
+                   "casts": ("f32",)},
+    "_attention": {"regime": "f32", "exact": False,
+                   "oracle": "decode.bf16", "accumulate": "f32",
+                   "casts": ("f32", "carried")},
+    "_kernel": {"regime": "carried", "exact": False,
+                "oracle": "decode.bf16", "casts": ("f32", "carried")},
+    "_llama_kernel": {"regime": "carried", "exact": False,
+                      "oracle": "decode.bf16",
+                      "casts": ("f32", "carried")},
+}
+
+
 def mega_requested(decode_kernel, seq_len: int) -> bool:
     """Shared dispatch predicate for every megakernel call site (model
     forwards and the stage runner)."""

@@ -15,6 +15,24 @@ import jax.numpy as jnp
 
 from .quant import QuantizedTensor, quant_matmul
 
+# Numerics contract (tools/graftcheck numerics pass): these primitives
+# ARE the repo's mixed-precision discipline — statistics in f32, value
+# stream in the carried activation dtype. The traced-jaxpr half of the
+# pass verifies the declaration at bf16 avals: the f32 upcast and the
+# cast back to the input dtype are the only sanctioned boundaries, and
+# the output never narrows below the carried dtype. All exact: the
+# bf16 REGIME is approximate (gated by graftnum's decode.bf16 budget at
+# the engine level), but these functions are deterministic and
+# byte-stable per regime.
+PRECISION_CONTRACT = {
+    "layer_norm": {"regime": "carried", "exact": True,
+                   "casts": ("f32", "carried")},
+    "rms_norm": {"regime": "carried", "exact": True,
+                 "casts": ("f32", "carried")},
+    "gelu_new": {"regime": "carried", "exact": True, "casts": ()},
+    "linear": {"regime": "carried", "exact": True, "casts": ()},
+}
+
 
 def layer_norm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
                eps: float = 1e-5) -> jnp.ndarray:

@@ -40,6 +40,43 @@ import numpy as np
 
 Params = Dict[str, Any]
 
+# Numerics contract (tools/graftcheck numerics pass — the static half
+# of graftnum): per-entry-point dtype regime, sanctioned cast
+# boundaries, f32-accumulator discipline, and exactness. This is the
+# module whose PROSE ("LN stats, softmax and logits stay f32") the
+# unstable-reduction rule turned into a checked property: every
+# low-precision dot must establish f32 accumulation in the traced
+# program (preferred_element_type or an f32 output), and every cast
+# must land on a declared boundary. The whole int8 path is
+# ``exact: False`` — it routes to the seeded ``decode.int8`` tolerance
+# budget in utils/graftnum.py TOLERANCE_POLICY rather than claiming
+# byte-equality it cannot have.
+PRECISION_CONTRACT = {
+    "quantize_array": {"regime": "int8", "exact": False,
+                       "oracle": "decode.int8",
+                       "casts": ("f32", "bf16", "int8", "carried")},
+    "dequantize_array": {"regime": "carried", "exact": False,
+                         "oracle": "decode.int8",
+                         "casts": ("carried",)},
+    "quantize_params": {"regime": "int8", "exact": False,
+                        "oracle": "decode.int8",
+                        "casts": ("carried",)},
+    "quant_matmul": {"regime": "carried", "exact": False,
+                     "oracle": "decode.int8", "accumulate": "f32",
+                     "casts": ("f32", "carried")},
+    "embed_rows": {"regime": "carried", "exact": False,
+                   "oracle": "decode.int8", "casts": ("carried",)},
+    "head_logits": {"regime": "f32", "exact": False,
+                    "oracle": "decode.int8", "accumulate": "f32",
+                    "casts": ("f32", "carried")},
+    "_linear_kernel": {"regime": "carried", "exact": False,
+                       "oracle": "decode.int8", "accumulate": "f32",
+                       "casts": ("f32", "carried")},
+    "_head_kernel": {"regime": "f32", "exact": False,
+                     "oracle": "decode.int8", "accumulate": "f32",
+                     "casts": ("f32",)},
+}
+
 # Pallas decode-matmul dispatch bounds: the kernel wins when the weight
 # stream dominates (few activation rows); larger row counts amortize
 # weights across the MXU and the plain XLA matmul is the right tool.
@@ -151,9 +188,19 @@ def quant_matmul(x: jnp.ndarray, qleaf: QuantizedTensor,
         y = _pallas_linear(x2, qleaf.q, qleaf.scale,
                            interpret=force_pallas)
         return y.reshape(x.shape[:-1] + (out,))
+    # f32 accumulation + one final rounding to the activation dtype —
+    # the same discipline the Pallas kernels establish in-register
+    # (preferred_element_type=f32). The bf16-operand form previously
+    # accumulated at the output dtype with a second rounding through
+    # the scale multiply; the numerics pass's unstable-reduction rule
+    # (tools/graftcheck/numerics.py) flagged it as the one dot in this
+    # module whose declared f32-accumulator contract was not
+    # established in the traced program. f32 activations are unchanged
+    # bit-for-bit (the cast and preferred type are no-ops there).
     y = jax.lax.dot_general(x, qleaf.q.astype(x.dtype),
-                            (((x.ndim - 1,), (0,)), ((), ())))
-    return y * qleaf.scale.astype(x.dtype)
+                            (((x.ndim - 1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    return (y * qleaf.scale.astype(jnp.float32)).astype(x.dtype)
 
 
 def _pick_out_block(out: int, d: int, cap_bytes: int = 2 << 20) -> int:

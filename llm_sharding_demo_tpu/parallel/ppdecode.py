@@ -125,7 +125,13 @@ class PipelinedDecoder:
                 f"PipelinedDecoder covers the dense GPT-2 and llama "
                 f"families; {type(config).__name__} decodes unstaged")
         self._llama = isinstance(config, LlamaConfig)
-        if dtype == "int8" or dtype == jnp.int8:
+        # dtype validates against the DECLARED regime vocabulary
+        # (graftnum.REGIMES) with a typed error, the same gate as
+        # DecodeEngine — every engine-building path shares the one
+        # mechanism, so an off-vocabulary dtype can't slip into a
+        # sibling constructor's astype
+        from ..utils.graftnum import regime_of
+        if regime_of(dtype) == "int8":
             # same weight-only scheme as the single-device engine:
             # int8 kernels/embedding with per-channel scales, bf16
             # activations + KV cache (ops.quant)

@@ -83,6 +83,27 @@ DONATED_ARGS = {"_decode_seg": (2,)}
 # tools/graftcheck/baseline.txt with a justification.
 GRAFTCHECK_HOT_LOOPS = ("DecodeEngine._decode_and_pack",)
 
+# Numerics contract (tools/graftcheck numerics pass — the static half
+# of graftnum): the engine's value-stream discipline. The compiled
+# entry points carry the construction regime end to end (``carried``:
+# params/cache/activations share ``self.dtype``, validated against
+# graftnum.REGIMES in ``__init__`` with a typed error), and token
+# selection runs f32 regardless of regime (``sampler_pmf`` upcasts the
+# logits once — the "softmax and logits stay f32" half of the bf16/
+# int8 prose, now traced). All entries exact: the f32 regime is the
+# byte-pinned parity mode; approximate REGIMES are declared at their
+# source modules (ops/quant.py -> decode.int8, ops/decode_layer.py ->
+# decode.bf16) and measured by graftnum's oracle at the engine level.
+PRECISION_CONTRACT = {
+    "_prefill_impl": {"regime": "carried", "exact": True, "casts": ()},
+    "_prefill_chunked_impl": {"regime": "carried", "exact": True,
+                              "casts": ()},
+    "_decode_seg_impl": {"regime": "carried", "exact": True,
+                         "casts": ()},
+    "sampler_pmf": {"regime": "f32", "exact": True, "casts": ("f32",)},
+    "select_token": {"regime": "f32", "exact": True, "casts": ()},
+}
+
 
 # EOS check-cap doubling ceiling: checks land at 32, 64, 128, 256, 256...
 # steps, so a long armed decode pays O(log) + steps/256 syncs instead of
@@ -492,7 +513,14 @@ class DecodeEngine:
                 raise NotImplementedError(
                     "prefill_chunk requires window-independent routing; "
                     "MoE models prefill monolithically")
-        quantize = dtype == "int8" or dtype == jnp.int8
+        # dtype is validated against the DECLARED regime vocabulary
+        # (graftnum.REGIMES) with a typed error: an off-vocabulary
+        # dtype ("float16", "fp8", a typo) used to flow straight into
+        # astype and run a precision no PRECISION_CONTRACT covers and
+        # no TOLERANCE_POLICY budgets.
+        from ..utils.graftnum import regime_of
+        self.regime = regime_of(dtype)
+        quantize = self.regime == "int8"
         if quantize and mesh is not None and not hasattr(config, "n_experts"):
             # refuse BEFORE any weight work (quantizing a real checkpoint
             # takes seconds — same convention as the prefill_chunk guard)

@@ -1,0 +1,287 @@
+"""graftnum: the tolerance oracle for APPROXIMATE compute paths.
+
+The repo's exactness discipline is byte-equality: every exact path
+(paged ≡ contiguous, chunked ≡ monolithic, spec ≡ plain, fleet ≡
+single) is pinned token-for-token. Approximate paths — weight-only int8
+(``ops.quant``) and bf16 decode — deliberately break that contract, and
+until now their quality claims lived in prose ("logits stay f32",
+"within quantization error") that nothing measured on a pinned seed.
+This module is the dynamic half of **graftnum** (the static half is
+``tools/graftcheck/numerics.py``, the same split as graftsan/graftlock/
+graftfault): a seeded, replay-identical oracle that runs an approximate
+engine against its f32/exact sibling and holds the divergence to a
+DECLARED budget.
+
+Declarations (read statically by the numerics pass):
+
+- ``REGIMES``: the dtype-regime vocabulary. ``DecodeEngine(dtype=...)``
+  validates against it via :func:`regime_of` — an off-vocabulary dtype
+  is a typed :class:`GraftnumError` at construction, not a silent
+  ``astype`` to something no contract covers.
+- ``TOLERANCE_POLICY``: ``{path: {"logit_mse": cap,
+  "top1_agreement": floor}}`` — the declared quality budget per
+  approximate path. Every ``PRECISION_CONTRACT`` entry with
+  ``exact: False`` must name one of these paths (rule
+  ``approx-without-oracle``), so an approximate path without a measured
+  budget cannot ship.
+
+Oracle methodology (:class:`ToleranceOracle`):
+
+- Workloads are seeded and replay-identical: the k-th prompt for a path
+  is a pure function of ``(seed, path, k)`` via
+  ``random.Random(f"{seed}/{path}/{k}")`` — the FaultPlan/GRAFTSCHED/
+  loadgen contract, so a breach reproduces from its report.
+- Comparison is TEACHER-FORCED along the exact engine's greedy
+  trajectory: at each step both engines score the SAME prefix (prompt +
+  the exact stream's tokens), so per-position logit MSE and greedy
+  top-1 agreement are position-aligned instead of measuring the chaos
+  of diverged contexts (one flipped argmax rewrites all later context —
+  stream distance measures conditioning, not quantization quality).
+- Logits come from each engine's OWN compiled prefill entry point
+  (``_prefill``), i.e. the production quantized/bf16 compute path, not
+  a re-implementation.
+- A breach raises a typed :class:`GraftnumError` carrying per-position
+  provenance (prompt index, step, per-position MSE, both argmaxes), so
+  the failing position is debuggable, not just the aggregate.
+
+First consumers: the int8 weight-only path (``decode.int8``) and
+bf16-vs-f32 decode (``decode.bf16``) — the landing pad for quantized KV
+blocks (ROADMAP item 4): per-block int8/fp8 KV storage lands as a new
+policy path measured by this same oracle.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# The dtype-regime vocabulary. tools/graftcheck/numerics.py mirrors
+# this as NUM_REGIMES (tests pin the two stay equal, like the slo
+# pass's SLO_METRICS); DecodeEngine(dtype=...) admits exactly these.
+REGIMES = ("f32", "bf16", "int8")
+
+# Accepted spellings per regime (engine callers pass jnp dtypes, numpy
+# dtypes, or serving-config strings; all collapse to one regime token).
+_REGIME_ALIASES = {
+    "float32": "f32", "f32": "f32",
+    "bfloat16": "bf16", "bf16": "bf16",
+    "int8": "int8",
+}
+
+# Declared quality budgets per approximate path — the oracle's gate and
+# the approx-without-oracle rule's registry. ``logit_mse`` is a CAP on
+# the mean per-position MSE over the vocab (f32 logits, teacher-forced
+# positions); ``top1_agreement`` is a FLOOR on the fraction of positions
+# whose greedy argmax matches the exact path. Bounds carry ~100x
+# headroom over values measured on the pinned bench seed (seed 0, demo
+# model: 3.0e-7 bf16 / 1.7e-6 int8, agreement 1.0 both) so the gate
+# catches step-function regressions (a lost f32 accumulator, a scale
+# folded on the wrong axis — those move MSE by orders of magnitude),
+# never round-off drift across hosts/BLAS builds.
+TOLERANCE_POLICY = {
+    # weight-only int8 decode (ops.quant) vs the f32 parity engine
+    "decode.int8": {"logit_mse": 2e-4, "top1_agreement": 0.90},
+    # bf16 decode (matmul operand rounding only; LN stats/softmax/
+    # logits stay f32) vs the f32 parity engine
+    "decode.bf16": {"logit_mse": 5e-5, "top1_agreement": 0.95},
+}
+
+
+class GraftnumError(Exception):
+    """Typed numerics-contract violation.
+
+    Raised by :func:`regime_of` on an off-vocabulary dtype and by
+    :class:`ToleranceOracle` on a tolerance breach; a breach carries
+    ``path`` / ``metric`` / ``limit`` / ``observed`` plus ``positions``
+    — the per-position provenance rows (prompt index, step, per-position
+    logit MSE, exact vs approx argmax) sorted worst-first.
+    """
+
+    def __init__(self, message: str, path: Optional[str] = None,
+                 metric: Optional[str] = None,
+                 limit: Optional[float] = None,
+                 observed: Optional[float] = None,
+                 positions: Sequence[dict] = ()):
+        super().__init__(message)
+        self.path = path
+        self.metric = metric
+        self.limit = limit
+        self.observed = observed
+        self.positions = tuple(positions)
+
+
+def regime_of(dtype) -> str:
+    """Collapse a dtype spelling to its declared regime token.
+
+    Accepts the three regimes in any spelling (``jnp.float32`` /
+    ``"bfloat16"`` / ``"int8"`` / numpy dtypes); anything else —
+    ``"float16"``, ``"fp8"``, a typo — raises a typed
+    :class:`GraftnumError` instead of flowing into ``astype`` and
+    silently running a precision nothing declared.
+    """
+    name = dtype if isinstance(dtype, str) else None
+    if name is None:
+        try:
+            import jax.numpy as jnp
+            name = jnp.dtype(dtype).name
+        except TypeError:
+            name = repr(dtype)
+    regime = _REGIME_ALIASES.get(name)
+    if regime is None:
+        raise GraftnumError(
+            f"dtype {dtype!r} is outside the declared regime vocabulary "
+            f"{REGIMES} (spellings: float32/bfloat16/int8 and their jnp "
+            "dtypes). Low-precision regimes are a declared contract "
+            "(PRECISION_CONTRACT + TOLERANCE_POLICY, see "
+            "docs/ARCHITECTURE.md 'Numerics discipline'); an undeclared "
+            "dtype has no cast boundaries and no tolerance budget.")
+    return regime
+
+
+def _seeded_prompt(seed: int, path: str, k: int, vocab: int,
+                   length: int) -> List[int]:
+    """The k-th workload prompt: a pure function of (seed, path, k) —
+    replay-identical like FaultPlan firings and loadgen arrivals."""
+    rng = random.Random(f"{seed}/{path}/{k}")
+    return [rng.randrange(vocab) for _ in range(length)]
+
+
+class ToleranceOracle:
+    """Seeded approximate-vs-exact comparison against declared budgets.
+
+    One oracle instance fixes the workload schedule (``seed``,
+    ``n_prompts``, ``prompt_len``, ``steps``); :meth:`compare` runs one
+    approximate engine against its exact sibling and returns the
+    JSON-able report (byte-identical across fresh runs with the same
+    seed — pinned by tests), raising :class:`GraftnumError` with
+    per-position provenance when the path's declared policy is
+    breached. ``policy`` is injectable for fixtures; the default is the
+    declared :data:`TOLERANCE_POLICY`.
+    """
+
+    def __init__(self, seed: int, policy: Optional[Dict] = None,
+                 n_prompts: int = 3, prompt_len: int = 5, steps: int = 6):
+        self.seed = seed
+        self.policy = TOLERANCE_POLICY if policy is None else policy
+        self.n_prompts = n_prompts
+        self.prompt_len = prompt_len
+        self.steps = steps
+
+    def workloads(self, path: str, vocab: int) -> List[List[int]]:
+        return [_seeded_prompt(self.seed, path, k, vocab, self.prompt_len)
+                for k in range(self.n_prompts)]
+
+    @staticmethod
+    def _last_logits(engine, ids):
+        """[1, S] ids -> [V] f32 last-position logits through the
+        engine's OWN compiled prefill (the production quantized/bf16
+        compute path — never a re-implementation)."""
+        import jax.numpy as jnp
+        import numpy as np
+        logits, _cache = engine._prefill(engine._run_params(),
+                                         jnp.asarray(ids, jnp.int32), None)
+        return np.asarray(logits, dtype=np.float32)[0]
+
+    def compare(self, path: str, approx_engine, exact_engine) -> dict:
+        """Run ``path``'s seeded workloads through both engines and gate
+        the divergence against the declared policy. Returns the report;
+        raises :class:`GraftnumError` on breach."""
+        import numpy as np
+
+        if path not in self.policy:
+            raise GraftnumError(
+                f"approximate path {path!r} has no TOLERANCE_POLICY "
+                f"entry (declared paths: {sorted(self.policy)}) — an "
+                "approximate path without a declared budget cannot be "
+                "gated", path=path)
+        policy = self.policy[path]
+        vocab = exact_engine.config.vocab_size
+        positions: List[dict] = []
+        for k, prompt in enumerate(self.workloads(path, vocab)):
+            arr = np.asarray([prompt], dtype=np.int32)
+            # teacher forcing: the exact engine's greedy stream is the
+            # shared trajectory both sides score position-by-position
+            forced = exact_engine.generate(arr, self.steps).tokens[
+                0, len(prompt):].tolist()
+            for t in range(self.steps):
+                ids = [prompt + forced[:t]]
+                le = self._last_logits(exact_engine, ids)
+                la = self._last_logits(approx_engine, ids)
+                mse = float(np.mean((la - le) ** 2))
+                e_top, a_top = int(le.argmax()), int(la.argmax())
+                positions.append({
+                    "prompt": k, "step": t,
+                    "logit_mse": round(mse, 12),
+                    "exact_top1": e_top, "approx_top1": a_top,
+                    "agree": e_top == a_top,
+                })
+        mse_mean = float(np.mean([p["logit_mse"] for p in positions]))
+        agreement = float(np.mean([p["agree"] for p in positions]))
+        report = {
+            "path": path,
+            "seed": self.seed,
+            "n_prompts": self.n_prompts,
+            "prompt_len": self.prompt_len,
+            "steps": self.steps,
+            "n_positions": len(positions),
+            "logit_mse": round(mse_mean, 12),
+            "top1_agreement": round(agreement, 6),
+            "policy": dict(policy),
+            "positions": positions,
+        }
+        if mse_mean > policy["logit_mse"]:
+            worst = sorted(positions, key=lambda p: -p["logit_mse"])[:5]
+            raise GraftnumError(
+                f"path {path!r}: logit_mse {mse_mean:.3e} exceeds the "
+                f"declared cap {policy['logit_mse']:.3e} (seed "
+                f"{self.seed}; worst positions {worst})",
+                path=path, metric="logit_mse",
+                limit=policy["logit_mse"], observed=mse_mean,
+                positions=worst)
+        if agreement < policy["top1_agreement"]:
+            worst = [p for p in positions if not p["agree"]][:5]
+            raise GraftnumError(
+                f"path {path!r}: top1_agreement {agreement:.4f} below "
+                f"the declared floor {policy['top1_agreement']:.4f} "
+                f"(seed {self.seed}; disagreeing positions {worst})",
+                path=path, metric="top1_agreement",
+                limit=policy["top1_agreement"], observed=agreement,
+                positions=worst)
+        return report
+
+
+def oracle_rows(seed: int = 0, max_seq: int = 64) -> List[dict]:
+    """The bench/CI consumer: run every declared TOLERANCE_POLICY path
+    on the pinned demo model (fleet.harness.demo_model — the same
+    geometry every harness serves) and return one compact report row
+    per path (positions dropped; the oracle raises on breach, so a row
+    existing means the path is inside its declared budget)."""
+    import jax.numpy as jnp
+
+    from ..fleet.harness import demo_model
+    from ..runtime.engine import DecodeEngine
+
+    cfg, params = demo_model(max_seq)
+    exact = DecodeEngine(params, cfg, max_seq=max_seq)
+    engines = {
+        "decode.int8": DecodeEngine(params, cfg, max_seq=max_seq,
+                                    dtype="int8"),
+        "decode.bf16": DecodeEngine(params, cfg, max_seq=max_seq,
+                                    dtype=jnp.bfloat16),
+    }
+    oracle = ToleranceOracle(seed)
+    rows = []
+    for path in sorted(TOLERANCE_POLICY):
+        if path not in engines:
+            # a declared budget with no measuring engine here is a
+            # WIRING gap, not a tolerance breach — keep the two
+            # distinguishable in the bench journal (the row's error
+            # names the unmapped path instead of a bare KeyError)
+            raise GraftnumError(
+                f"TOLERANCE_POLICY declares {path!r} but oracle_rows "
+                f"builds no engine for it (covered: {sorted(engines)})"
+                " — wire the new path's approximate engine in before "
+                "declaring its budget", path=path)
+        report = oracle.compare(path, engines[path], exact)
+        rows.append({k: v for k, v in report.items() if k != "positions"})
+    return rows

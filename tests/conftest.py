@@ -81,6 +81,7 @@ _QUICK_MODULES = {
     "test_graftfleet",      # disaggregated fleet: router, handoff, pass
     "test_graftwatch",      # continuous re-planning: watcher, switcher
     "test_grafttime",       # unified causal timeline: bus, export, pass
+    "test_graftnum",        # numerics discipline: contracts + oracle
 }
 
 

@@ -202,6 +202,23 @@ def test_repo_passes_graftcheck():
         assert tl.get(mod, 0) >= floor, (
             f"{mod}: fewer than {floor} live timeline kind(s) — a "
             "declared producer stopped publishing")
+    assert payload["numerics_checks"] >= 10, (
+        "graftnum numerics pass went vacuous — a new undeclared-cast / "
+        "unstable-reduction / silent-downcast / approx-without-oracle "
+        "finding anywhere in the tree fails this strict run (rule "
+        "fixtures in tests/test_graftnum.py)")
+    assert payload["numerics_vacuous"] == [], (
+        "PRECISION_CONTRACT declarations resolving to zero live "
+        f"entries: {payload['numerics_vacuous']}")
+    # every low-precision module declares a LIVE precision contract
+    npc = payload["numerics_contracts"]
+    for rel in ("llm_sharding_demo_tpu/ops/quant.py",
+                "llm_sharding_demo_tpu/ops/layers.py",
+                "llm_sharding_demo_tpu/ops/decode_layer.py",
+                "llm_sharding_demo_tpu/runtime/engine.py"):
+        assert npc.get(rel, 0) >= 1, (
+            f"{rel}: no live PRECISION_CONTRACT entry — the numerics "
+            "discipline stopped seeing its low-precision paths")
     assert payload["suppressed"] >= 1, (
         "the documented sync points should be baselined findings — an "
         "empty suppression set means the host-sync rule stopped seeing "

@@ -503,6 +503,8 @@ def test_verifier_json_schema_shape():
                             "watch_vacuous",
                             "timeline_checks", "timeline_kinds",
                             "timeline_vacuous",
+                            "numerics_checks", "numerics_contracts",
+                            "numerics_vacuous",
                             "recompile_bounds"}
     assert isinstance(payload["ok"], bool)
     assert isinstance(payload["sanitize_checks"], int)
@@ -525,6 +527,9 @@ def test_verifier_json_schema_shape():
     assert isinstance(payload["watch_signals"], dict)
     assert isinstance(payload["watch_vacuous"], list)
     assert isinstance(payload["timeline_checks"], int)
+    assert isinstance(payload["numerics_checks"], int)
+    assert isinstance(payload["numerics_contracts"], dict)
+    assert isinstance(payload["numerics_vacuous"], list)
     assert isinstance(payload["timeline_kinds"], dict)
     assert isinstance(payload["timeline_vacuous"], list)
     assert isinstance(payload["strict"], bool)

@@ -65,7 +65,13 @@ _HIGHER_BETTER = ("tokens_per_sec", "tokens_per_second", "speedup",
                   # prefixes (affinity hit rate drops) and an emptier
                   # batch at the same offered load means admission or
                   # scheduling got worse, not better
-                  "affinity_hit_rate", "batch_occupancy")
+                  "affinity_hit_rate", "batch_occupancy",
+                  # numerics_oracle row (graftnum): greedy argmax
+                  # agreement of an approximate path with its f32
+                  # sibling regresses DOWNWARD (checked before the
+                  # lower-better list so the metric never falls through
+                  # to a latency-ish suffix match)
+                  "top1_agreement")
 _LOWER_BETTER = ("_ms", "latency", "step_ms", "prefill_ms",
                  # traffic_mix occupancy join: deeper queues at the
                  # same offered rate = the serving stack fell behind
@@ -79,7 +85,13 @@ _LOWER_BETTER = ("_ms", "latency", "step_ms", "prefill_ms",
                  # timeline_overhead row (grafttime): the bus-armed vs
                  # bus-off wall ratio drifting up means the always-on
                  # timeline started taxing the decode path
-                 "overhead_factor")
+                 "overhead_factor",
+                 # numerics_oracle row (graftnum): per-position logit
+                 # MSE of an approximate path vs its f32 sibling —
+                 # upward drift means the quantizer/bf16 discipline
+                 # lost precision (also caught by the "_ms" suffix,
+                 # but the explicit name documents the intent)
+                 "logit_mse")
 # environment properties, not code performance: the tunnel's RTT, the
 # reference CPU's own rate, and the attribution run's host-dependent
 # byte rates vary by machine/route — comparing them across rounds would

@@ -1,0 +1,740 @@
+"""graftcheck numerics pass: declared precision contracts (compile-free).
+
+The static half of **graftnum** (``llm_sharding_demo_tpu/utils/
+graftnum.py`` is the dynamic half — the same split as sanitize/locks/
+faults/slo/fleet/watch/timeline). Every exact path in this repo is
+pinned byte-for-byte; the approximate paths (weight-only int8, bf16
+decode) until now carried their precision discipline as PROSE — "LN
+stats, softmax and logits stay f32" — that no pass checked. This pass
+makes precision a DECLARED contract:
+
+Every ops/ and runtime/ module with low-precision arithmetic declares
+``PRECISION_CONTRACT`` beside ``JIT_ENTRY_POINTS``::
+
+    PRECISION_CONTRACT = {
+        "<entry point>": {
+            "regime": "f32" | "bf16" | "int8" | "carried",
+            "casts": ("f32", "bf16", "int8", "carried", ...),
+            "accumulate": "f32",          # required when low-precision
+                                          # dots/reductions exist
+            "exact": True | False,
+            "oracle": "decode.int8",      # required when exact: False
+        },
+    }
+
+``regime`` is the dtype regime of the entry's value stream at its
+boundary (``carried`` = output follows the input's dtype); ``casts``
+are the SANCTIONED cast boundaries (dtype tokens the body may convert
+to; ``carried`` sanctions dynamic ``x.astype(other.dtype)`` casts and,
+in traced jaxprs, converts back to an input operand's dtype);
+``accumulate: "f32"`` declares the f32-accumulator discipline for
+low-precision dots; ``exact: False`` routes the path to a declared
+``graftnum.TOLERANCE_POLICY`` budget.
+
+Two analysis halves feed four rules:
+
+- **AST half** (always on): contract shape/vocabulary validation, the
+  module-level low-precision trigger, and a cast scan over each
+  contracted entry's body (``.astype`` / ``lax.convert_element_type``
+  sites resolved to dtype tokens; integer index casts are control flow,
+  not value precision, and are ignored).
+- **Jaxpr half** (skipped under ``--lint-only``): the semantic-pass
+  pattern — :func:`traced_entry_points` builds ``jax.make_jaxpr``
+  programs of the REAL production entry points at representative
+  low-precision avals and walks the equations: ``convert_element_type``
+  destinations against the declared boundaries, ``dot_general``/
+  accumulating reductions over sub-f32 operands against the declared
+  f32-accumulator discipline, and output avals against the declared
+  regime. Compile-free (tracing only), injectable for fixtures.
+
+Rules (ids in brackets; suppressions ride the shared baseline):
+
+- [undeclared-cast]      a low-precision ops/ or runtime/ module with
+                         no PRECISION_CONTRACT, a malformed/stale
+                         declaration, or a cast site (AST or traced
+                         jaxpr) whose destination token is not a
+                         declared boundary of its entry.
+- [unstable-reduction]   a traced dot_general/reduce/cumsum over
+                         bf16/f16/int8 avals without f32 accumulation
+                         (``preferred_element_type`` or ≥f32 output) —
+                         or with one but no declared ``accumulate:
+                         "f32"`` — the rule that makes ops/quant.py's
+                         prose checkable.
+- [silent-downcast]      a traced entry whose output narrows below its
+                         declared regime (or below the carried input
+                         dtype) — an f32 value quietly leaving a jit
+                         boundary as bf16 that nothing declared.
+- [approx-without-oracle] an ``exact: False`` entry with no ``oracle``
+                         mapping or one naming no TOLERANCE_POLICY
+                         path; an ``exact: True`` entry CLAIMING an
+                         oracle path (a byte-equality pin cannot claim
+                         an approx-declared path); a TOLERANCE_POLICY
+                         path no contract references (stale); or a
+                         malformed policy (the slo-without-source-
+                         metric shape).
+
+``--strict`` additionally fails a VACUOUS pass (a PRECISION_CONTRACT
+whose entries resolve to zero live functions); ``cli.run --json``
+carries ``numerics_checks`` / ``numerics_contracts`` /
+``numerics_vacuous``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from . import lint as L
+from .core import Finding
+from .locks import _module_assign
+
+NUMERICS_RULE_IDS = ("undeclared-cast", "unstable-reduction",
+                     "silent-downcast", "approx-without-oracle")
+
+# The dtype-regime vocabulary (graftnum.REGIMES mirrors this — tests
+# pin the two stay equal, like the slo pass's SLO_METRICS).
+NUM_REGIMES = ("f32", "bf16", "int8")
+# contract regimes add "carried": output dtype follows the input's
+CONTRACT_REGIMES = NUM_REGIMES + ("carried",)
+# sanctioned-cast vocabulary: value-precision dtype tokens + "carried"
+CAST_TOKENS = ("f32", "bf16", "f16", "f64", "int8", "carried")
+# the two oracle metrics every TOLERANCE_POLICY path must declare
+ORACLE_METRICS = ("logit_mse", "top1_agreement")
+
+GRAFTNUM_RELPATH = "llm_sharding_demo_tpu/utils/graftnum.py"
+
+# dtype-name -> token; names outside this map and outside _IGNORED are
+# still value dtypes (conservative: an unknown float spelling flags)
+_DTYPE_TOKENS = {
+    "float32": "f32", "f32": "f32",
+    "bfloat16": "bf16", "bf16": "bf16",
+    "float16": "f16", "f16": "f16", "fp16": "f16", "half": "f16",
+    "float64": "f64", "f64": "f64", "double": "f64",
+    "int8": "int8",
+    # fp8 spellings map to one token the traced rules can width-check;
+    # "fp8" is deliberately OUTSIDE CAST_TOKENS/NUM_REGIMES today, so
+    # any fp8 cast/dot is an unsanctionable finding until a future PR
+    # declares the regime (+ its TOLERANCE_POLICY path)
+    "float8_e4m3fn": "fp8", "float8_e5m2": "fp8", "fp8": "fp8",
+}
+# integer/bool/index casts are control flow, not value precision
+_IGNORED_DTYPES = {
+    "int32", "int64", "int16", "uint8", "uint16", "uint32", "uint64",
+    "bool", "bool_", "i32", "i64",
+}
+_TOKEN_WIDTH = {"f64": 64, "f32": 32, "bf16": 16, "f16": 16, "int8": 8,
+                "fp8": 8}
+
+_LOW_PRECISION_NAMES = {"bfloat16", "float16", "int8", "float8_e4m3fn",
+                        "float8_e5m2"}
+
+
+# -- contract model ----------------------------------------------------------
+
+
+class _Entry:
+    """One parsed PRECISION_CONTRACT entry."""
+
+    def __init__(self, name: str, line: int):
+        self.name = name
+        self.line = line
+        self.regime: Optional[str] = None
+        self.casts: Set[str] = set()
+        self.accumulate: Optional[str] = None
+        self.exact: Optional[bool] = None
+        self.oracle: Optional[str] = None
+
+
+def _str_dict_items(node: ast.AST) -> Optional[List[Tuple[str, ast.AST]]]:
+    if not isinstance(node, ast.Dict):
+        return None
+    out = []
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            return None
+        out.append((k.value, v))
+    return out
+
+
+def _const(node: ast.AST):
+    return node.value if isinstance(node, ast.Constant) else None
+
+
+def _str_seq(node: ast.AST) -> Optional[List[str]]:
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out = []
+    for e in node.elts:
+        if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+            return None
+        out.append(e.value)
+    return out
+
+
+def _parse_contract(mod: L.ModuleInfo,
+                    findings: List[Finding]) -> Optional[List[_Entry]]:
+    """PRECISION_CONTRACT -> validated entries; malformed declarations
+    land as undeclared-cast findings (the contract itself is the first
+    thing held to the vocabulary)."""
+    stmt = _module_assign(mod, "PRECISION_CONTRACT")
+    if stmt is None:
+        return None
+    line = stmt.lineno
+    items = _str_dict_items(stmt.value)
+    if items is None:
+        findings.append(Finding(
+            "undeclared-cast", mod.relpath, line, "<module>",
+            "PRECISION_CONTRACT must be a dict literal keyed by entry-"
+            "point name (the numerics pass reads it statically)"))
+        return []
+    entries: List[_Entry] = []
+    for name, spec in items:
+        e = _Entry(name, line)
+        fields = _str_dict_items(spec)
+        if fields is None:
+            findings.append(Finding(
+                "undeclared-cast", mod.relpath, line, name,
+                f"entry {name!r}: the contract value must be a dict "
+                "literal {regime, casts, exact[, accumulate, oracle]}"))
+            continue
+        fmap = dict(fields)
+        regime = _const(fmap.get("regime"))
+        if regime not in CONTRACT_REGIMES:
+            findings.append(Finding(
+                "undeclared-cast", mod.relpath, line, name,
+                f"entry {name!r}: regime {regime!r} is outside the "
+                f"declared vocabulary {CONTRACT_REGIMES}"))
+            continue
+        e.regime = regime
+        casts = _str_seq(fmap.get("casts", ast.Tuple(elts=[], ctx=None)))
+        if casts is None or any(c not in CAST_TOKENS for c in casts):
+            findings.append(Finding(
+                "undeclared-cast", mod.relpath, line, name,
+                f"entry {name!r}: casts must be a tuple/list literal of "
+                f"tokens from {CAST_TOKENS} (the sanctioned cast "
+                "boundaries)"))
+            continue
+        e.casts = set(casts)
+        exact = _const(fmap.get("exact"))
+        if not isinstance(exact, bool):
+            findings.append(Finding(
+                "undeclared-cast", mod.relpath, line, name,
+                f"entry {name!r}: exact must be a True/False literal — "
+                "byte-pinned or tolerance-gated, never unstated"))
+            continue
+        e.exact = exact
+        if "accumulate" in fmap:
+            acc = _const(fmap["accumulate"])
+            if acc != "f32":
+                findings.append(Finding(
+                    "undeclared-cast", mod.relpath, line, name,
+                    f"entry {name!r}: accumulate must be the literal "
+                    "\"f32\" (the only accumulator regime the "
+                    "unstable-reduction rule can verify)"))
+                continue
+            e.accumulate = acc
+        if "oracle" in fmap:
+            orc = _const(fmap["oracle"])
+            if not isinstance(orc, str):
+                findings.append(Finding(
+                    "undeclared-cast", mod.relpath, line, name,
+                    f"entry {name!r}: oracle must be a string literal "
+                    "TOLERANCE_POLICY path"))
+                continue
+            e.oracle = orc
+        entries.append(e)
+    return entries
+
+
+def _resolve_entry_fn(mod: L.ModuleInfo, name: str) -> Optional[ast.AST]:
+    fn = mod.functions.get(name)
+    if fn is not None:
+        return fn
+    hit = L._suffix_index(mod).get(name)
+    return hit[1] if hit is not None else None
+
+
+# -- AST half ----------------------------------------------------------------
+
+
+def _module_has_low_precision(mod: L.ModuleInfo) -> Optional[int]:
+    """First line referencing a sub-f32 value dtype: ``jnp.bfloat16`` /
+    ``.int8`` / ``.float16`` attributes, or a string constant EXACTLY
+    equal to one of those names anywhere in the tree (call args,
+    name-bound module constants like ``KV_DTYPE = "int8"``, dtype
+    comparisons). Exact equality keeps docstrings/comments out — a
+    prose sentence mentioning int8 is never the whole constant —
+    while a name-bound spelling can't evade the trigger."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Attribute) \
+                and node.attr in _LOW_PRECISION_NAMES:
+            return node.lineno
+        if isinstance(node, ast.Constant) \
+                and node.value in _LOW_PRECISION_NAMES:
+            return node.lineno
+    return None
+
+
+def _cast_token_of(node: ast.AST) -> Optional[str]:
+    """The dtype token a cast argument names: a dtype attribute
+    (``jnp.float16``) or string constant maps to its token; an ignored
+    integer/bool dtype maps to None (skip); anything dynamic
+    (``x.dtype``, a variable) is a ``carried`` boundary."""
+    name = None
+    if isinstance(node, ast.Attribute):
+        # jnp.float16 — but x.dtype (attr "dtype") is dynamic
+        if node.attr == "dtype":
+            return "carried"
+        name = node.attr
+    elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+        name = node.value
+    if name is not None:
+        if name in _IGNORED_DTYPES:
+            return None
+        if name in _DTYPE_TOKENS:
+            return _DTYPE_TOKENS[name]
+        if name in _LOW_PRECISION_NAMES:
+            # a low-precision spelling outside the token map (fp8):
+            # conservative — treat as its own undeclarable token
+            return name
+        return "carried" if isinstance(node, ast.Attribute) else name
+    return "carried"
+
+
+def _cast_sites(fn: ast.AST) -> List[Tuple[int, Optional[str], str]]:
+    """(line, token, spelling) per cast call in the body: ``.astype(d)``
+    and ``[jax.]lax.convert_element_type(x, d)``."""
+    out = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "astype" \
+                and node.args:
+            out.append((node.lineno, _cast_token_of(node.args[0]),
+                        "astype"))
+        elif isinstance(f, ast.Attribute) \
+                and f.attr == "convert_element_type":
+            arg = (node.args[1] if len(node.args) > 1 else
+                   next((kw.value for kw in node.keywords
+                         if kw.arg == "new_dtype"), None))
+            if arg is not None:
+                out.append((node.lineno, _cast_token_of(arg),
+                            "convert_element_type"))
+    return out
+
+
+# -- jaxpr half --------------------------------------------------------------
+
+
+class TracedEntry:
+    """One production entry point traced at representative avals.
+
+    ``build`` is called lazily (imports jax + the target module) and
+    returns ``(fn, args)`` for ``jax.make_jaxpr(fn)(*args)``. The
+    (relpath, entry) pair joins the trace to its declared contract."""
+
+    def __init__(self, relpath: str, entry: str,
+                 build: Callable[[], tuple]):
+        self.relpath = relpath
+        self.entry = entry
+        self.build = build
+
+
+def traced_entry_points() -> List[TracedEntry]:
+    """The production trace table: the mixed-precision entry points of
+    ops/layers.py, ops/quant.py (XLA lowerings — the Pallas kernels'
+    bodies are checked by the AST half), and runtime/engine.py's
+    samplers, each at the low-precision avals serving actually runs
+    them with. Kept beside the rules so adding a traced entry and its
+    contract is one review."""
+    import jax.numpy as jnp
+
+    def bf(*s):
+        return jnp.zeros(s, jnp.bfloat16)
+
+    def f32(*s):
+        return jnp.zeros(s, jnp.float32)
+
+    def _layers():
+        from llm_sharding_demo_tpu.ops import layers
+        return layers
+
+    def _quant():
+        from llm_sharding_demo_tpu.ops import quant
+        return quant
+
+    def _engine():
+        from llm_sharding_demo_tpu.runtime import engine
+        return engine
+
+    LAYERS = "llm_sharding_demo_tpu/ops/layers.py"
+    QUANT = "llm_sharding_demo_tpu/ops/quant.py"
+    ENGINE = "llm_sharding_demo_tpu/runtime/engine.py"
+    return [
+        TracedEntry(LAYERS, "layer_norm", lambda: (
+            _layers().layer_norm, (bf(2, 8), f32(8), f32(8)))),
+        TracedEntry(LAYERS, "rms_norm", lambda: (
+            _layers().rms_norm, (bf(2, 8), bf(8)))),
+        TracedEntry(LAYERS, "gelu_new", lambda: (
+            _layers().gelu_new, (bf(2, 8),))),
+        TracedEntry(QUANT, "quant_matmul", lambda: (
+            lambda x, q, s: _quant().quant_matmul(
+                x, _quant().QuantizedTensor(q, s)),
+            (bf(2, 8), jnp.zeros((8, 16), jnp.int8), bf(16)))),
+        TracedEntry(QUANT, "head_logits", lambda: (
+            lambda h, q, s: _quant().head_logits(
+                h, _quant().QuantizedTensor(q, s)),
+            (bf(1, 1, 8), jnp.zeros((16, 8), jnp.int8), bf(8)))),
+        TracedEntry(QUANT, "embed_rows", lambda: (
+            lambda q, s, ids: _quant().embed_rows(
+                _quant().QuantizedTensor(q, s), ids),
+            (jnp.zeros((16, 8), jnp.int8), bf(8),
+             jnp.zeros((2, 3), jnp.int32)))),
+        TracedEntry(QUANT, "quantize_array", lambda: (
+            _quant().quantize_array, (f32(8, 16),))),
+        TracedEntry(ENGINE, "sampler_pmf", lambda: (
+            lambda lg: _engine().sampler_pmf(
+                lg, _engine().SamplingConfig(mode="sample")),
+            (bf(2, 64),))),
+        TracedEntry(ENGINE, "select_token", lambda: (
+            lambda lg: _engine().select_token(
+                lg, _engine().SamplingConfig(), None),
+            (f32(2, 64),))),
+    ]
+
+
+def _dtype_token(dtype) -> Optional[str]:
+    name = getattr(dtype, "name", str(dtype))
+    if name in _IGNORED_DTYPES:
+        return None
+    return _DTYPE_TOKENS.get(name, name)
+
+
+def _token_width(token: Optional[str]) -> Optional[int]:
+    return _TOKEN_WIDTH.get(token) if token is not None else None
+
+
+def _is_float(aval) -> bool:
+    import jax.numpy as jnp
+    return jnp.issubdtype(aval.dtype, jnp.floating)
+
+
+def _walk_eqns(jaxpr):
+    from .semantic import _sub_jaxprs
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn):
+            yield from _walk_eqns(sub)
+
+
+_ACCUM_REDUCES = ("reduce_sum", "reduce_prod", "cumsum", "cumprod",
+                  "cumlogsumexp")
+
+
+def _check_traced(entry: TracedEntry, contract: _Entry, path: str,
+                  line: int, findings: List[Finding]) -> int:
+    """Trace one entry and run the three jaxpr rules against its
+    declared contract. Returns checks performed."""
+    import jax
+    import jax.numpy as jnp
+
+    fn, args = entry.build()
+    closed = jax.make_jaxpr(fn)(*args)
+    flat_in, _ = jax.tree_util.tree_flatten(args)
+    in_float_dtypes = {a.dtype for a in flat_in
+                       if hasattr(a, "dtype")
+                       and jnp.issubdtype(a.dtype, jnp.floating)}
+    carried_width = None
+    for a in flat_in:
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
+            carried_width = _token_width(_dtype_token(a.dtype)) or 32
+            break
+    checks = 0
+    scope = entry.entry
+
+    low_accum_eqns = 0
+    for eqn in _walk_eqns(closed.jaxpr):
+        prim = eqn.primitive.name
+        if prim == "convert_element_type":
+            checks += 1
+            op = eqn.invars[0]
+            src = getattr(getattr(op, "aval", None), "dtype", None)
+            dst = eqn.params.get("new_dtype")
+            if src is None or dst is None or src == dst:
+                continue
+            if getattr(op.aval, "ndim", 0) == 0:
+                continue  # scalar parameter casts are not value streams
+            token = _dtype_token(dst)
+            if token is None:
+                continue  # integer/index cast: control flow
+            sanctioned = token in contract.casts or (
+                "carried" in contract.casts and dst in in_float_dtypes)
+            if not sanctioned:
+                findings.append(Finding(
+                    "undeclared-cast", path, line, scope,
+                    f"traced {entry.entry} converts "
+                    f"{getattr(src, 'name', src)} -> "
+                    f"{getattr(dst, 'name', dst)} but {token!r} is not "
+                    "a declared cast boundary of this entry "
+                    f"(casts: {sorted(contract.casts)})"))
+        elif prim == "dot_general" or prim in _ACCUM_REDUCES:
+            ops_low = [v for v in eqn.invars
+                       if getattr(getattr(v, "aval", None), "dtype", None)
+                       is not None
+                       and _token_width(_dtype_token(v.aval.dtype))
+                       not in (None, 32, 64)]
+            if not ops_low:
+                continue
+            checks += 1
+            low_accum_eqns += 1
+            pet = eqn.params.get("preferred_element_type")
+            pet_ok = pet is not None and (
+                _token_width(_dtype_token(jnp.dtype(pet))) or 0) >= 32
+            out_ok = all(
+                (_token_width(_dtype_token(v.aval.dtype)) or 32) >= 32
+                for v in eqn.outvars if _is_float(v.aval))
+            if not (pet_ok or out_ok):
+                findings.append(Finding(
+                    "unstable-reduction", path, line, scope,
+                    f"traced {entry.entry}: {prim} over "
+                    f"{sorted(v.aval.dtype.name for v in ops_low)} "
+                    "avals accumulates below f32 (no "
+                    "preferred_element_type and a sub-f32 output) — "
+                    "the declared f32-accumulator discipline is not "
+                    "established in the program"))
+    if low_accum_eqns and contract.accumulate != "f32":
+        checks += 1
+        findings.append(Finding(
+            "unstable-reduction", path, line, scope,
+            f"traced {entry.entry} contains {low_accum_eqns} low-"
+            "precision dot/reduce equation(s) but its contract declares "
+            "no accumulate: \"f32\" — the accumulator discipline must "
+            "be declared, not implied"))
+
+    # silent-downcast: the output boundary against the declared regime
+    checks += 1
+    regime_width = (_TOKEN_WIDTH.get(contract.regime)
+                    if contract.regime != "carried" else carried_width)
+    if regime_width is not None:
+        for aval in closed.out_avals:
+            if not hasattr(aval, "dtype") or not _is_float(aval):
+                continue
+            w = _token_width(_dtype_token(aval.dtype)) or 32
+            if w < regime_width:
+                findings.append(Finding(
+                    "silent-downcast", path, line, scope,
+                    f"traced {entry.entry} returns "
+                    f"{aval.dtype.name} from a declared "
+                    f"{contract.regime!r} regime (width {regime_width})"
+                    " — an undeclared narrowing at the jit boundary"))
+    return checks
+
+
+# -- tolerance-policy registry (graftnum.py, read statically) ----------------
+
+
+def _parse_policy(mod: Optional[L.ModuleInfo],
+                  findings: List[Finding]) -> Tuple[Dict[str, dict], int]:
+    """graftnum's TOLERANCE_POLICY -> {path: {metric: value}} + decl
+    line; malformed shapes are approx-without-oracle findings against
+    the graftnum file itself."""
+    if mod is None:
+        return {}, 0
+    stmt = _module_assign(mod, "TOLERANCE_POLICY")
+    if stmt is None:
+        findings.append(Finding(
+            "approx-without-oracle", mod.relpath, 1, "<module>",
+            "graftnum declares no TOLERANCE_POLICY — the approximate "
+            "paths have no registered budgets"))
+        return {}, 0
+    line = stmt.lineno
+    items = _str_dict_items(stmt.value)
+    if items is None:
+        findings.append(Finding(
+            "approx-without-oracle", mod.relpath, line, "<module>",
+            "TOLERANCE_POLICY must be a dict literal keyed by path"))
+        return {}, line
+    out: Dict[str, dict] = {}
+    for path_name, spec in items:
+        metrics = _str_dict_items(spec)
+        vals = {}
+        ok = metrics is not None
+        if ok:
+            for m, v in metrics:
+                c = _const(v)
+                if m not in ORACLE_METRICS or not isinstance(
+                        c, (int, float)) or isinstance(c, bool):
+                    ok = False
+                    break
+                vals[m] = float(c)
+            ok = ok and set(vals) == set(ORACLE_METRICS)
+        if not ok:
+            findings.append(Finding(
+                "approx-without-oracle", mod.relpath, line, path_name,
+                f"TOLERANCE_POLICY[{path_name!r}] must declare exactly "
+                f"the numeric metrics {ORACLE_METRICS} (a cap and a "
+                "floor — a partial budget gates nothing)"))
+            continue
+        out[path_name] = vals
+    return out, line
+
+
+# -- the pass ----------------------------------------------------------------
+
+
+def run_numerics(root: str, paths: Optional[List[str]] = None,
+                 traced: Optional[Sequence[TracedEntry]] = None,
+                 policy: Optional[Dict[str, dict]] = None,
+                 trace: bool = True,
+                 ) -> Tuple[List[Finding], dict]:
+    """The whole static pass -> (findings, summary). ``summary``
+    carries ``numerics_checks`` (contract entries validated + cast
+    sites scanned + traced-rule evaluations — the vacuity guard on the
+    pass itself), ``numerics_contracts`` (per-module live entry count)
+    and ``vacuous`` (modules whose contract resolves to zero live
+    functions — the strict driver fails these). ``paths`` / ``traced``
+    / ``policy`` are injectable for rule fixtures; ``trace=False``
+    (lint-only mode) keeps the pass jax-free."""
+    findings: List[Finding] = []
+    checks = 0
+    contracts: Dict[str, int] = {}
+    vacuous: List[str] = []
+
+    scan_paths = paths if paths is not None else L.iter_sources(root)
+    mods: Dict[str, L.ModuleInfo] = {}
+    for path in scan_paths:
+        mod = L.index_module(path, root)
+        if mod is not None:
+            mods[mod.relpath] = mod
+
+    # tolerance-policy registry (injectable; default: graftnum.py's own
+    # declaration, parsed statically)
+    policy_line = 0
+    if policy is None:
+        gmod = mods.get(GRAFTNUM_RELPATH)
+        if gmod is None and paths is None:
+            import os
+            gpath = os.path.join(root, GRAFTNUM_RELPATH)
+            if os.path.exists(gpath):
+                gmod = L.index_module(gpath, root)
+        if gmod is not None:
+            policy, policy_line = _parse_policy(gmod, findings)
+            checks += 1
+        else:
+            policy = {}
+    oracle_refs: Set[str] = set()
+
+    entries_by_mod: Dict[str, Dict[str, _Entry]] = {}
+    for relpath, mod in sorted(mods.items()):
+        in_scope = relpath.startswith("llm_sharding_demo_tpu/ops/") or \
+            relpath.startswith("llm_sharding_demo_tpu/runtime/") or \
+            (paths is not None and ("/ops/" in "/" + relpath
+                                    or "/runtime/" in "/" + relpath))
+        entries = _parse_contract(mod, findings)
+        if entries is None:
+            if in_scope:
+                low_line = _module_has_low_precision(mod)
+                if low_line is not None:
+                    checks += 1
+                    findings.append(Finding(
+                        "undeclared-cast", relpath, low_line, "<module>",
+                        "module references sub-f32 dtypes but declares "
+                        "no PRECISION_CONTRACT — low-precision "
+                        "arithmetic must declare its regime, cast "
+                        "boundaries, and exactness (docs/ARCHITECTURE."
+                        "md 'Numerics discipline')"))
+            continue
+        checks += 1
+        live = 0
+        emap: Dict[str, _Entry] = {}
+        for e in entries:
+            checks += 1
+            fn = _resolve_entry_fn(mod, e.name)
+            if fn is None:
+                findings.append(Finding(
+                    "undeclared-cast", relpath, e.line, e.name,
+                    f"PRECISION_CONTRACT names {e.name!r} but no such "
+                    "function exists in this module (stale "
+                    "declaration)"))
+                continue
+            live += 1
+            emap[e.name] = e
+            # AST cast scan over the entry's body
+            for cline, token, spelling in _cast_sites(fn):
+                if token is None:
+                    continue
+                checks += 1
+                if token not in e.casts:
+                    findings.append(Finding(
+                        "undeclared-cast", relpath, cline, e.name,
+                        f"{spelling} to {token!r} is not a declared "
+                        f"cast boundary of entry {e.name!r} (casts: "
+                        f"{sorted(e.casts)}) — sanction it in "
+                        "PRECISION_CONTRACT or keep the value in its "
+                        "declared regime"))
+            # oracle discipline
+            checks += 1
+            if e.exact is False:
+                if e.oracle is None:
+                    findings.append(Finding(
+                        "approx-without-oracle", relpath, e.line, e.name,
+                        f"entry {e.name!r} declares exact: False but "
+                        "maps to no tolerance oracle — every "
+                        "approximate path needs a declared "
+                        "TOLERANCE_POLICY budget (graftnum)"))
+                elif e.oracle not in policy:
+                    findings.append(Finding(
+                        "approx-without-oracle", relpath, e.line, e.name,
+                        f"entry {e.name!r} maps to oracle path "
+                        f"{e.oracle!r}, which TOLERANCE_POLICY does not "
+                        f"declare (declared: {sorted(policy)})"))
+                else:
+                    oracle_refs.add(e.oracle)
+            elif e.exact is True and e.oracle is not None:
+                findings.append(Finding(
+                    "approx-without-oracle", relpath, e.line, e.name,
+                    f"entry {e.name!r} declares exact: True AND an "
+                    f"oracle path {e.oracle!r} — a byte-equality pin "
+                    "must not claim an approx-declared path (pick "
+                    "one)"))
+        entries_by_mod[relpath] = emap
+        contracts[relpath] = live
+        if live == 0:
+            vacuous.append(relpath)
+
+    # stale policy paths: budgets no contract routes to
+    for path_name in sorted(set(policy) - oracle_refs):
+        checks += 1
+        findings.append(Finding(
+            "approx-without-oracle", GRAFTNUM_RELPATH, policy_line or 1,
+            path_name,
+            f"TOLERANCE_POLICY declares path {path_name!r} but no "
+            "PRECISION_CONTRACT entry maps to it (stale budget — or an "
+            "approximate path lost its declaration)"))
+
+    # jaxpr half
+    if trace:
+        for t in (traced if traced is not None else traced_entry_points()):
+            emap = entries_by_mod.get(t.relpath, {})
+            e = emap.get(t.entry)
+            checks += 1
+            if e is None:
+                findings.append(Finding(
+                    "undeclared-cast", t.relpath, 1, t.entry,
+                    f"traced entry point {t.entry!r} has no "
+                    "PRECISION_CONTRACT entry — its casts and "
+                    "accumulators are unreviewable"))
+                continue
+            fn_node = (_resolve_entry_fn(mods[t.relpath], t.entry)
+                       if t.relpath in mods else None)
+            line = getattr(fn_node, "lineno", e.line)
+            checks += _check_traced(t, e, t.relpath, line, findings)
+
+    summary = {
+        "numerics_checks": checks,
+        "numerics_contracts": contracts,
+        "vacuous": sorted(vacuous),
+    }
+    return (sorted(findings, key=lambda f: (f.path, f.line, f.rule)),
+            summary)
