@@ -73,6 +73,23 @@ SHARDING_DESCRIPTOR = {
 }
 
 
+# Numerics contract (tools/graftcheck numerics pass): the two expert
+# contractions are the only low-precision arithmetic this module owns
+# (everything else delegates to ops/layers.py and ops/quant.py, which
+# carry their own contracts). Both follow quant.quant_matmul's
+# f32-accumulate / single-final-rounding discipline and ride the same
+# seeded ``decode.int8`` tolerance budget — the routed and dense paths
+# share these functions, so one declaration covers both.
+PRECISION_CONTRACT = {
+    "_expert_einsum": {"regime": "carried", "exact": False,
+                       "oracle": "decode.int8", "accumulate": "f32",
+                       "casts": ("f32", "carried")},
+    "_gathered_einsum": {"regime": "carried", "exact": False,
+                         "oracle": "decode.int8", "accumulate": "f32",
+                         "casts": ("f32", "carried")},
+}
+
+
 def expert_capacity(config: MoEConfig, seq_len: int) -> int:
     """Static per-expert slot count for one batch row."""
     cap = int(config.capacity_factor * config.expert_top_k * seq_len
@@ -136,9 +153,15 @@ def _expert_einsum(eq: str, x: jnp.ndarray, kernel) -> jnp.ndarray:
     if quant.is_quantized(kernel):
         lead = x.shape[1:-1]
         e, _, out = kernel.q.shape
-        y = jnp.einsum(eq, x, kernel.q.astype(x.dtype))
-        return y * kernel.scale.reshape(
-            (e,) + (1,) * len(lead) + (out,)).astype(x.dtype)
+        # f32 accumulation + ONE final rounding to the activation dtype
+        # — the quant.quant_matmul discipline. The bf16 form previously
+        # accumulated at bf16 and rounded twice (dot, then rescale); the
+        # numerics pass's unstable-reduction rule flags that shape. f32
+        # activations are unchanged bit-for-bit.
+        y = jnp.einsum(eq, x, kernel.q.astype(x.dtype),
+                       preferred_element_type=jnp.float32)
+        scale = kernel.scale.reshape((e,) + (1,) * len(lead) + (out,))
+        return (y * scale.astype(jnp.float32)).astype(x.dtype)
     return jnp.einsum(eq, x, kernel)
 
 
@@ -161,8 +184,9 @@ def _gathered_einsum(x: jnp.ndarray, kernel) -> jnp.ndarray:
     from ..ops import quant
 
     if quant.is_quantized(kernel):
-        y = jnp.einsum("nd,ndf->nf", x, kernel.q.astype(x.dtype))
-        return y * kernel.scale.astype(x.dtype)
+        y = jnp.einsum("nd,ndf->nf", x, kernel.q.astype(x.dtype),
+                       preferred_element_type=jnp.float32)
+        return (y * kernel.scale.astype(jnp.float32)).astype(x.dtype)
     return jnp.einsum("nd,ndf->nf", x, kernel)
 
 

@@ -1,0 +1,255 @@
+"""Quantized KV block storage for the paged pool: int8/fp8 + scales.
+
+Helix Parallelism's serving-side framing (PAPERS.md) is that interactive
+decode is KV-bound — pool CAPACITY and gather bandwidth bound goodput,
+not FLOPs. ``ops.quant`` already halves the WEIGHT stream with
+per-channel int8; this module applies the same discipline to the other
+HBM-resident tensor population, the paged KV pool itself: blocks are
+stored in a narrow dtype (int8, or fp8 ``float8_e4m3fn`` where the
+backend has it) with one symmetric absmax scale per (layer, block, k|v,
+kv-head), quantized on scatter and dequantized on gather. At int8 that
+is ~4x the f32 pool's rows-per-byte (~2x vs bf16) for the same HBM
+budget — rows-before-first-preemption and prefix-store depth scale with
+it (bench.py ``kv_quant_capacity``).
+
+Scale placement: ``[L, num_blocks+1, 2, n_kv_head]`` f32, absmax over
+the block's ``[block_size, hd]`` slots. Per-(block, head) rather than
+per-tensor keeps one outlier head from widening every block's step, and
+per-BLOCK rather than per-token keeps the scale array negligible
+(1/(bs*hd) of the data) and block-granular like everything else the
+allocator moves: CoW copies, poisoning, and prefix sharing move
+(data, scale) pairs with the same traced block ids.
+
+Re-quantization policy: scales are CONTENT-ONLY state. A scatter
+recomputes the scale of every block it writes from the values being
+written, so the pool never carries placement history; re-scattering the
+same gathered columns (the per-segment decode write-back) re-quantizes
+them, and that bounded drift is part of the ``kv.int8`` / ``kv.fp8``
+tolerance budget the graftnum oracle measures — NOT hidden under a
+byte-equality claim. Full-precision pools never route through this
+module (runtime.kv_pool constructs the quantized jit family only when
+``block_dtype`` is set), so the paged≡contiguous byte-equality pins are
+structurally unable to extend to quantized mode (the approx-without-
+oracle rule in tools/graftcheck/numerics.py enforces the split).
+
+Like ``ops.quant``'s XLA fallback, every dequantizing product
+accumulates in f32 with ONE final rounding to the consumer dtype
+(``dequantize_blocks``): the gathered working cache sees exactly one
+quantize→dequantize round-trip of error per slot, never a second
+rounding through the scale multiply.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Storage dtype per declared KV regime (utils.graftnum REGIMES): the
+# tokens are the SAME vocabulary ``graftnum.regime_of`` validates, so a
+# serving knob typo fails with the regime-vocabulary error, not a
+# KeyError here. fp8 uses e4m3fn: KV magnitudes are activation-scale
+# (absmax-normalized per block), so mantissa beats the e5m2 exponent.
+STORAGE_DTYPES = {
+    "int8": jnp.int8,
+    "fp8": jnp.float8_e4m3fn,
+}
+
+# Largest finite magnitudes of the narrow codes: symmetric clip targets.
+_INT8_QMAX = 127.0
+_FP8_QMAX = 448.0  # float8_e4m3fn max finite
+
+# Numerics contract (tools/graftcheck numerics pass — the static half of
+# graftnum). The quantizers and scatters are ``exact: False``: they
+# route to the seeded ``kv.int8`` / ``kv.fp8`` tolerance budgets in
+# utils/graftnum.py TOLERANCE_POLICY instead of claiming byte-equality
+# they cannot have (re-quantization drift is part of the measured
+# budget, see module docstring). The gather/dequant side shares one
+# compiled program across both regimes, so its budget routes through
+# the regime-specific scatter/quantizer entries; ``kv.int8`` is named
+# here as the representative oracle path. ``copy_blocks_q`` moves
+# (data, scale) bytes verbatim — the one exact entry.
+PRECISION_CONTRACT = {
+    "quantize_blocks_int8": {"regime": "int8", "exact": False,
+                             "oracle": "kv.int8",
+                             "casts": ("f32", "int8", "carried")},
+    "quantize_blocks_fp8": {"regime": "fp8", "exact": False,
+                            "oracle": "kv.fp8",
+                            "casts": ("f32", "fp8", "carried")},
+    "dequantize_blocks": {"regime": "carried", "exact": False,
+                          "oracle": "kv.int8",
+                          "casts": ("f32", "carried")},
+    "gather_kv_q": {"regime": "carried", "exact": False,
+                    "oracle": "kv.int8",
+                    "casts": ("f32", "carried")},
+    "scatter_kv_int8": {"regime": "carried", "exact": False,
+                        "oracle": "kv.int8",
+                        "casts": ("f32", "int8", "carried")},
+    "scatter_kv_fp8": {"regime": "carried", "exact": False,
+                       "oracle": "kv.fp8",
+                       "casts": ("f32", "fp8", "carried")},
+    "copy_blocks_q": {"regime": "carried", "exact": True, "casts": ()},
+}
+
+
+def fp8_supported() -> bool:
+    """Whether this backend round-trips ``float8_e4m3fn`` (CPU under
+    recent jaxlib does; older TPU generations may not) — the gate the
+    serving knob and the oracle wiring consult before constructing an
+    fp8 pool, so an unsupported backend skips WITH a reason instead of
+    crashing mid-trace."""
+    try:
+        x = jnp.asarray([1.0, -2.0], jnp.float8_e4m3fn)
+        return bool(np.asarray(x.astype(jnp.float32))[0] == 1.0)
+    except Exception:
+        return False
+
+
+def scales_shape(n_layer: int, num_blocks: int,
+                 n_kv_head: int) -> Tuple[int, ...]:
+    """THE scale aval contract, parallel to ``paged_attention.pool_shape``
+    (same trailing +1 trash block): one f32 absmax scale per (layer,
+    physical block, k|v, kv-head)."""
+    return (n_layer, num_blocks + 1, 2, n_kv_head)
+
+
+def quantize_blocks_int8(blk: jnp.ndarray,
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``[..., bs, hd]`` float blocks -> (int8 codes, f32 scales[...]).
+
+    Symmetric per-block absmax over the trailing ``[bs, hd]`` slots —
+    the same scheme as ``ops.quant.quantize_array`` with the channel
+    axis replaced by the block axis. The 1e-8 floor keeps all-zero
+    blocks (fresh pool, trash) at scale~0 codes instead of 0/0.
+    """
+    x = blk.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=(-2, -1))
+    scale = jnp.maximum(absmax, 1e-8) / _INT8_QMAX
+    q = jnp.clip(jnp.round(x / scale[..., None, None]),
+                 -_INT8_QMAX, _INT8_QMAX)
+    return q.astype(jnp.int8), scale
+
+
+def quantize_blocks_fp8(blk: jnp.ndarray,
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``[..., bs, hd]`` float blocks -> (e4m3fn codes, f32 scales[...]).
+
+    Same absmax normalization as int8, scaled to e4m3fn's max finite
+    (448) so the code range is fully used; the clip runs BEFORE the
+    narrowing cast because e4m3fn has no inf to saturate into.
+    """
+    x = blk.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=(-2, -1))
+    scale = jnp.maximum(absmax, 1e-8) / _FP8_QMAX
+    q = jnp.clip(x / scale[..., None, None], -_FP8_QMAX, _FP8_QMAX)
+    return q.astype(jnp.float8_e4m3fn), scale
+
+
+def dequantize_blocks(q: jnp.ndarray, scale: jnp.ndarray,
+                      out_dtype) -> jnp.ndarray:
+    """(codes ``[..., bs, hd]``, scales ``[...]``) -> float blocks.
+
+    f32 accumulation with ONE final rounding to ``out_dtype`` — the
+    ``ops.quant.quant_matmul`` fallback discipline: never a second
+    rounding through the scale multiply.
+    """
+    return (q.astype(jnp.float32)
+            * scale[..., None, None]).astype(out_dtype)
+
+
+def gather_kv_q(data: jnp.ndarray, scales: jnp.ndarray,
+                tables: jnp.ndarray, out_dtype,
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Assemble contiguous per-row K/V views from a QUANTIZED pool.
+
+    data ``[L, NBp, 2, H, bs, hd]`` narrow; scales ``[L, NBp, 2, H]``
+    f32; tables ``[B, NBm]`` int32 (traced). Returns ``(k, v)`` each
+    ``[L, B, H, NBm*bs, hd]`` in ``out_dtype`` — the engine's contiguous
+    cache layout, exactly ``paged_attention.gather_kv``'s reshape with a
+    dequantize between the take and the transpose. Tables stay traced:
+    one compiled gather per (B, NBm), regardless of placement, same as
+    the full-precision mover.
+    """
+    b, nbm = tables.shape
+    l, _, _, h, bs, hd = data.shape
+    flat = tables.reshape(-1)
+    g = jnp.take(data, flat, axis=1)    # [L, B*NBm, 2, H, bs, hd] narrow
+    s = jnp.take(scales, flat, axis=1)  # [L, B*NBm, 2, H] f32
+    g = dequantize_blocks(g, s, out_dtype)
+    g = g.reshape(l, b, nbm, 2, h, bs, hd)
+    g = g.transpose(3, 0, 1, 4, 2, 5, 6)  # [2, L, B, H, NBm, bs, hd]
+    kv = g.reshape(2, l, b, h, nbm * bs, hd)
+    return kv[0], kv[1]
+
+
+def _scatter_kv_q(data: jnp.ndarray, scales: jnp.ndarray,
+                  k: jnp.ndarray, v: jnp.ndarray, tables: jnp.ndarray,
+                  qfn: Callable) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantize-on-scatter core shared by both regimes: build the
+    per-(row, block) source exactly as ``paged_attention.scatter_kv``,
+    quantize the whole stack in one ``qfn`` call (one fused absmax/clip
+    over every written block), then write code AND scale with the same
+    unrolled ``dynamic_update_slice`` chain — duplicate targets (ghost/
+    pad entries aliasing the trash block) resolve deterministically,
+    last write wins, for both arrays in lockstep."""
+    l, b, h, s, hd = k.shape
+    nbm = tables.shape[1]
+    bs = s // nbm
+    kk = k.reshape(l, b, h, nbm, bs, hd)
+    vv = v.reshape(l, b, h, nbm, bs, hd)
+    # [B, NBm, L, 2, H, bs, hd]: one leading (row, block) index pair per
+    # update
+    src = jnp.stack([kk, vv], axis=0).transpose(2, 4, 1, 0, 3, 5, 6)
+    q, sc = qfn(src)  # codes same shape; scales [B, NBm, L, 2, H]
+    zero = jnp.zeros((), jnp.int32)
+    for bi in range(b):
+        for j in range(nbm):
+            data = jax.lax.dynamic_update_slice(
+                data, q[bi, j][:, None],
+                (zero, tables[bi, j], zero, zero, zero, zero))
+            scales = jax.lax.dynamic_update_slice(
+                scales, sc[bi, j][:, None],
+                (zero, tables[bi, j], zero, zero))
+    return data, scales
+
+
+def scatter_kv_int8(data: jnp.ndarray, scales: jnp.ndarray,
+                    k: jnp.ndarray, v: jnp.ndarray, tables: jnp.ndarray,
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Write contiguous per-row K/V back as int8 blocks + fresh scales
+    (content-only: see module docstring on re-quantization)."""
+    return _scatter_kv_q(data, scales, k, v, tables, quantize_blocks_int8)
+
+
+def scatter_kv_fp8(data: jnp.ndarray, scales: jnp.ndarray,
+                   k: jnp.ndarray, v: jnp.ndarray, tables: jnp.ndarray,
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Write contiguous per-row K/V back as e4m3fn blocks + fresh
+    scales."""
+    return _scatter_kv_q(data, scales, k, v, tables, quantize_blocks_fp8)
+
+
+def copy_blocks_q(data: jnp.ndarray, scales: jnp.ndarray,
+                  src: jnp.ndarray, dst: jnp.ndarray,
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Copy whole quantized blocks ``src[i] -> dst[i]`` (both ``[n]``
+    int32, traced): code bytes AND scale move verbatim, so a CoW copy
+    (or a GRAFTSAN poison overwrite from the trash block) is
+    byte-preserving — no re-quantization on the copy path, the copied
+    block dequantizes to exactly what the original did."""
+    n = src.shape[0]
+    zero = jnp.zeros((), jnp.int32)
+    for i in range(n):
+        blk = jax.lax.dynamic_slice(
+            data, (zero, src[i], zero, zero, zero, zero),
+            (data.shape[0], 1) + data.shape[2:])
+        data = jax.lax.dynamic_update_slice(
+            data, blk, (zero, dst[i], zero, zero, zero, zero))
+        sc = jax.lax.dynamic_slice(
+            scales, (zero, src[i], zero, zero),
+            (scales.shape[0], 1) + scales.shape[2:])
+        scales = jax.lax.dynamic_update_slice(
+            scales, sc, (zero, dst[i], zero, zero))
+    return data, scales

@@ -63,11 +63,13 @@ class PipelineRunner:
         self.max_seq = max_seq
         self.dtype = dtype
         # declared-vocabulary gate first (typed reject of float16/fp8/
-        # typos — the same graftnum.regime_of mechanism DecodeEngine
-        # uses), THEN the targeted int8 refusal (this runner casts, and
-        # an astype to int8 would truncate floats, not quantize)
-        from ..utils.graftnum import regime_of
-        regime_of(dtype)
+        # typos — the same graftnum.engine_regime_of mechanism
+        # DecodeEngine uses; fp8 is a KV-block storage regime, not an
+        # engine compute dtype), THEN the targeted int8 refusal (this
+        # runner casts, and an astype to int8 would truncate floats,
+        # not quantize)
+        from ..utils.graftnum import engine_regime_of
+        engine_regime_of(dtype)
         from ..ops.quant import reject_raw_int8
         reject_raw_int8(dtype)
         # inference compute dtype applies to the WEIGHTS too (the decode

@@ -130,8 +130,8 @@ class PipelinedDecoder:
         # DecodeEngine — every engine-building path shares the one
         # mechanism, so an off-vocabulary dtype can't slip into a
         # sibling constructor's astype
-        from ..utils.graftnum import regime_of
-        if regime_of(dtype) == "int8":
+        from ..utils.graftnum import engine_regime_of
+        if engine_regime_of(dtype) == "int8":
             # same weight-only scheme as the single-device engine:
             # int8 kernels/embedding with per-channel scales, bf16
             # activations + KV cache (ops.quant)

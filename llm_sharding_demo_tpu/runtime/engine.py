@@ -513,13 +513,14 @@ class DecodeEngine:
                 raise NotImplementedError(
                     "prefill_chunk requires window-independent routing; "
                     "MoE models prefill monolithically")
-        # dtype is validated against the DECLARED regime vocabulary
-        # (graftnum.REGIMES) with a typed error: an off-vocabulary
-        # dtype ("float16", "fp8", a typo) used to flow straight into
-        # astype and run a precision no PRECISION_CONTRACT covers and
-        # no TOLERANCE_POLICY budgets.
-        from ..utils.graftnum import regime_of
-        self.regime = regime_of(dtype)
+        # dtype is validated against the DECLARED engine regime
+        # vocabulary (graftnum.REGIMES minus fp8 — that one is a
+        # KV-block storage regime, kv_pool block_dtype) with a typed
+        # error: an off-vocabulary dtype ("float16", a typo) used to
+        # flow straight into astype and run a precision no
+        # PRECISION_CONTRACT covers and no TOLERANCE_POLICY budgets.
+        from ..utils.graftnum import engine_regime_of
+        self.regime = engine_regime_of(dtype)
         quantize = self.regime == "int8"
         if quantize and mesh is not None and not hasattr(config, "n_experts"):
             # refuse BEFORE any weight work (quantizing a real checkpoint

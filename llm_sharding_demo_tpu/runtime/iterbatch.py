@@ -98,6 +98,16 @@ not ``max_seq`` slots. The pool is also the ADMISSION authority:
   Byte-identical to the un-preempted stream (prefix-stable key splits;
   prefill-recomputed KV equals incrementally-decoded KV — pinned by
   tests for greedy and seeded sample, plain and spec batches).
+
+Every admission/watermark/preemption quantity above is denominated in
+BLOCKS (``allocator.blocks_for``), never bytes — so a quantized pool
+(``block_dtype`` set: narrow storage, smaller bytes-per-block) raises
+the admissible row count at a fixed HBM budget purely by being built
+with more blocks, with zero scheduler branches. Under quantized storage
+the resume-by-recompute stream is equivalent within the declared
+``kv.int8``/``kv.fp8`` tolerance budgets rather than byte-identical
+(rescattering recomputes content scales — see runtime.kv_pool); the
+full-precision pool keeps every byte-equality pin above.
 """
 
 from __future__ import annotations

@@ -32,6 +32,20 @@ reference server.py:169-181). This module is the first-class manager:
   share one physical copy, with the partially-filled frontier block
   copy-on-write'd before the row's first write into it.
 
+Quantized block storage (``block_dtype="int8"`` / ``"fp8"``, the
+serving ``KV_POOL_DTYPE`` knob): the pool stores narrow codes plus one
+f32 absmax scale per (layer, block, k|v, kv-head) — ``ops.kv_quant`` —
+with quantize-on-scatter / dequant-on-gather movers (``_gather_q`` /
+``_scatter_q`` / ``_scatter_row_q`` / ``_copy_q``, the ``_q`` jit
+family). At int8 that is ~4x the f32 pool's rows-per-byte at equal HBM:
+the allocator contract (refcounts, CoW, prefix sharing, GRAFTSAN
+provenance) is untouched — quantization changes block CONTENTS only —
+while capacity-per-byte scales with the narrow dtype. The path is
+``exact: False`` under the ``kv.int8``/``kv.fp8`` tolerance budgets
+(utils.graftnum); full-precision pools construct ONLY the plain mover
+family, so every paged≡contiguous byte-equality pin is structurally
+confined to them.
+
 Preemption (the admission story's other half) lives in
 ``runtime.iterbatch``: under pool exhaustion the scheduler parks the
 lowest-priority row, frees its blocks, and later resumes it by
@@ -68,6 +82,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops import kv_quant as KVQ
 from ..ops import paged_attention as PA
 from ..ops.attention import KVCache
 from ..utils import graftfault, graftsched, graftscope, grafttime, \
@@ -81,16 +96,23 @@ from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
 # this module, by holding attribute — enumerated by the recompile-budget
 # certifier; an undeclared site is a lint finding. ``_poison`` is the
 # sanitizer's free-block poisoner (GRAFTSAN=1 only — see GraftsanError).
+# The ``_q`` names are the quantized-pool mover family (constructed
+# instead of — never alongside — the plain family when ``block_dtype``
+# is set); ``_poison_q`` is its GRAFTSAN-only poisoner.
 JIT_ENTRY_POINTS = ("_gather", "_scatter", "_scatter_row", "_copy",
-                    "_poison")
+                    "_poison", "_gather_q", "_scatter_q",
+                    "_scatter_row_q", "_copy_q", "_poison_q")
 
 # Observability contract (tools/graftcheck scope pass + utils/graftscope):
 # every serving-path mover's dispatch is timed into the graftscope ring,
 # keyed (batch, table width) — the certifier's paged_runner_keys model.
-# ``_poison`` is deliberately NOT profiled: it is the GRAFTSAN-only
-# free-block poisoner, a sanitizer hook off every serving path —
-# baselined in tools/graftcheck/baseline.txt with that justification.
-PROFILED_SCOPES = ("_gather", "_scatter", "_scatter_row", "_copy")
+# ``_poison``/``_poison_q`` are deliberately NOT profiled: they are the
+# GRAFTSAN-only free-block poisoners, sanitizer hooks off every serving
+# path — baselined in tools/graftcheck/baseline.txt with that
+# justification.
+PROFILED_SCOPES = ("_gather", "_scatter", "_scatter_row", "_copy",
+                   "_gather_q", "_scatter_q", "_scatter_row_q",
+                   "_copy_q")
 
 # Timeline contract (tools/graftcheck timeline pass): the allocator's
 # LRU evictions land on the unified causal stream (utils/grafttime) —
@@ -122,12 +144,36 @@ def _scatter_row_scope_key(pool, k, v, table_row, roll):
 def _copy_scope_key(pool, src, dst):
     return (int(src.shape[0]),)
 
+
+# quantized-family keys: same (batch, table width) model — the scale
+# array rides along as a second carried operand and never keys programs
+# beyond the shapes the data already keys
+
+def _gather_q_scope_key(data, scales, tables):
+    return (int(tables.shape[0]), int(tables.shape[1]))
+
+
+def _scatter_q_scope_key(data, scales, k, v, tables):
+    return (int(tables.shape[0]), int(tables.shape[1]))
+
+
+def _scatter_row_q_scope_key(data, scales, k, v, table_row, roll):
+    return (int(k.shape[-2]), int(table_row.shape[0]))
+
+
+def _copy_q_scope_key(data, scales, src, dst):
+    return (int(src.shape[0]),)
+
 # Donation contract (tools/graftcheck sanitize pass): the pool movers
 # all consume the pool buffer itself (arg 0) — ``self.data`` is re-bound
 # from every call's output under ``_dev_lock``, and nothing may hold a
-# host view of it.
+# host view of it. The quantized movers additionally consume the scale
+# array (arg 1): ``self.scales`` is re-bound in the same statement, so
+# (data, scales) stay one atomic device state.
 DONATED_ARGS = {"_scatter": (0,), "_scatter_row": (0,), "_copy": (0,),
-                "_poison": (0,)}
+                "_poison": (0,), "_scatter_q": (0, 1),
+                "_scatter_row_q": (0, 1), "_copy_q": (0, 1),
+                "_poison_q": (0, 1)}
 
 # Pool-mover lease scopes (tools/graftcheck sanitize pass): the paged
 # runner's two mover sites — every block id they move is a live
@@ -145,7 +191,28 @@ GUARDED_STATE = {
     "_free": "_lock", "_ref": "_lock", "_prefix": "_lock",
     "_prefix_ref": "_lock", "_san_*": "_lock",
     "evictions": "_lock", "cow_copies": "_lock",
-    "data": "_dev_lock",
+    "data": "_dev_lock", "scales": "_dev_lock",
+}
+
+# Numerics contract (tools/graftcheck numerics pass): the quantized
+# mover family is ``exact: False`` — it routes to the seeded ``kv.*``
+# tolerance budgets in utils/graftnum.py TOLERANCE_POLICY. The entries
+# name the per-instance nested impls (the lint resolver indexes nested
+# defs by qualname suffix). All four are ``carried``: the narrowing/
+# widening casts live in ops.kv_quant's own contracted quantizers —
+# these impls carry (data, scales) through and pick the regime's
+# quantizer at construction. ``kv.int8`` is the representative oracle
+# path for the regime-shared programs (gather/copy compile once per
+# shape for either storage dtype); the fp8-specific budget routes
+# through ops.kv_quant's ``scatter_kv_fp8``/``quantize_blocks_fp8``.
+PRECISION_CONTRACT = {
+    "_gather_q_impl": {"regime": "carried", "exact": False,
+                       "oracle": "kv.int8", "casts": ("carried",)},
+    "_scatter_q_impl": {"regime": "carried", "exact": False,
+                        "oracle": "kv.int8", "casts": ("carried",)},
+    "_scatter_row_q_impl": {"regime": "carried", "exact": False,
+                            "oracle": "kv.int8", "casts": ("carried",)},
+    "_copy_q_impl": {"regime": "carried", "exact": True, "casts": ()},
 }
 
 # Permitted acquisition order: device ops validate tables against live
@@ -159,6 +226,30 @@ LOCK_ORDER = ("_dev_lock", "_lock")
 # through every scatter; the solo runner runs one generation at a
 # time), not a blocking-under-lock finding.
 DEVICE_LOCKS = ("_dev_lock", "_gen_lock")
+
+# gauge/stats label spelling for full-precision storage, keyed by numpy
+# dtype name — the quantized regimes label with their graftnum tokens
+# directly, so the ``block_dtype`` label space is exactly the regime
+# vocabulary
+_REGIME_LABELS = {"float32": "f32", "bfloat16": "bf16",
+                  "float16": "f16", "float64": "f64"}
+
+
+def bytes_per_block(n_layer: int, n_kv_head: int, block_size: int,
+                    head_dim: int, dtype=jnp.float32,
+                    block_dtype: Optional[str] = None) -> int:
+    """HBM bytes one physical block costs, scales included: the unit
+    the capacity bench (`kv_quant_capacity`) uses to size an int8 and
+    an f32 pool to the SAME byte budget, and the number the
+    ``kv_pool_bytes_per_block`` gauge publishes. Quantized blocks pay
+    ``2 * n_kv_head`` f32 scales per layer on top of the narrow codes
+    (1/(block_size*head_dim) of the data — negligible, but counted)."""
+    slots = n_layer * 2 * n_kv_head * block_size * head_dim
+    if block_dtype is None:
+        return slots * np.dtype(dtype).itemsize
+    storage = KVQ.STORAGE_DTYPES[block_dtype]
+    scale_bytes = n_layer * 2 * n_kv_head * np.dtype(np.float32).itemsize
+    return slots * np.dtype(storage).itemsize + scale_bytes
 
 
 class PoolExhausted(RuntimeError):
@@ -654,7 +745,8 @@ class KVBlockPool:
     def __init__(self, n_layer: int, num_blocks: int, n_kv_head: int,
                  block_size: int, head_dim: int, max_seq: int,
                  dtype=jnp.float32, watermark: float = 0.9,
-                 sanitize: Optional[bool] = None):
+                 sanitize: Optional[bool] = None,
+                 block_dtype: Optional[str] = None):
         self.nbm = PA.blocks_per_row(max_seq, block_size)
         if num_blocks < self.nbm:
             raise ValueError(
@@ -666,19 +758,63 @@ class KVBlockPool:
         self.max_seq = max_seq
         self.trash = num_blocks
         self.dtype = dtype
+        # quantized block storage (opt-in): validate the knob through
+        # THE regime vocabulary (a typo fails with graftnum's
+        # regime-vocabulary error, not a KeyError), then reject
+        # full-precision spellings — those pools already store blocks
+        # in the engine dtype, and routing them here would silently
+        # trade their byte-equality pins for a tolerance budget.
+        self.block_dtype: Optional[str] = None
+        if block_dtype:
+            from ..utils.graftnum import regime_of
+            regime = regime_of(block_dtype)
+            if regime not in KVQ.STORAGE_DTYPES:
+                raise ValueError(
+                    f"block_dtype={block_dtype!r} is the full-precision "
+                    f"regime {regime!r} — the pool already stores blocks "
+                    "in the engine dtype there; quantized storage takes "
+                    f"one of {sorted(KVQ.STORAGE_DTYPES)}")
+            if regime == "fp8" and not KVQ.fp8_supported():
+                raise ValueError(
+                    "block_dtype='fp8' requires float8_e4m3fn support "
+                    "on this backend (ops.kv_quant.fp8_supported() is "
+                    "False) — use 'int8' here")
+            self.block_dtype = regime
+        self.block_regime = self.block_dtype or _REGIME_LABELS.get(
+            np.dtype(dtype).name, np.dtype(dtype).name)
         self.allocator = BlockAllocator(num_blocks, block_size,
                                         watermark=watermark,
                                         sanitize=sanitize)
-        self.data = jnp.zeros(
-            PA.pool_shape(n_layer, num_blocks, n_kv_head, block_size,
-                          head_dim), dtype=dtype)
+        shape = PA.pool_shape(n_layer, num_blocks, n_kv_head, block_size,
+                              head_dim)
+        if self.block_dtype is not None:
+            self.data = jnp.zeros(shape,
+                                  dtype=KVQ.STORAGE_DTYPES[self.block_dtype])
+            self.scales = jnp.zeros(
+                KVQ.scales_shape(n_layer, num_blocks, n_kv_head),
+                dtype=jnp.float32)
+        else:
+            self.data = jnp.zeros(shape, dtype=dtype)
+            self.scales = None
+        self._bytes_per_block = self.data.nbytes // shape[1] + (
+            0 if self.scales is None
+            else self.scales.nbytes // shape[1])
         self._dev_lock = graftsched.rlock("kv_pool.KVBlockPool._dev_lock")
 
         # per-instance defs (not the module-level ops directly): each
         # pool owns its jitted-program caches, so ``_cache_size()`` is
         # THIS pool's program count — the recompile-budget certifier
         # pins it per workload, which a function-identity-shared cache
-        # would smear across instances
+        # would smear across instances. A pool constructs exactly ONE
+        # mover family: plain (full precision, below) or ``_q``
+        # (quantized, _init_quantized_movers) — never both, so the
+        # full-precision jit population is bit-identical to a build
+        # without this feature and the byte-equality pins stay pinned
+        # to precisely the programs they always covered.
+        if self.block_dtype is not None:
+            self._compile_watches = self._init_quantized_movers()
+            return
+
         def _gather_impl(pool, tables):
             return PA.gather_kv(pool, tables)
 
@@ -732,6 +868,64 @@ class KVBlockPool:
             watches.append(CompileWatch("kv_pool", self._poison))
         self._compile_watches = tuple(watches)
 
+    def _init_quantized_movers(self) -> tuple:
+        """Construct the ``_q`` jit family for a quantized pool: the
+        same four movers, carrying (data, scales) as one donated pair.
+        The regime's quantizer is bound at construction (ops.kv_quant),
+        so the traced programs contain no regime branching; the gather
+        dequantizes into the ENGINE dtype — downstream decode programs
+        see exactly the avals the full-precision gather produces and
+        stay shared with contiguous serving."""
+        out_dtype = self.dtype
+        scatter_fn = (KVQ.scatter_kv_int8 if self.block_dtype == "int8"
+                      else KVQ.scatter_kv_fp8)
+
+        def _gather_q_impl(data, scales, tables):
+            return KVQ.gather_kv_q(data, scales, tables, out_dtype)
+
+        def _scatter_q_impl(data, scales, k, v, tables):
+            return scatter_fn(data, scales, k, v, tables)
+
+        def _scatter_row_q_impl(data, scales, k, v, table_row, roll):
+            # admission merge, quantized: same roll-then-scatter as the
+            # plain family; the full row re-quantizes on the way in.
+            k = jnp.roll(k, roll, axis=-2)
+            v = jnp.roll(v, roll, axis=-2)
+            return scatter_fn(data, scales, k, v, table_row[None])
+
+        def _copy_q_impl(data, scales, src, dst):
+            return KVQ.copy_blocks_q(data, scales, src, dst)
+
+        self._gather_q = graftscope.instrument(
+            jax.jit(_gather_q_impl), "kv_pool._gather_q",
+            key_fn=_gather_q_scope_key)
+        self._scatter_q = graftscope.instrument(
+            jax.jit(_scatter_q_impl, donate_argnums=(0, 1)),
+            "kv_pool._scatter_q", key_fn=_scatter_q_scope_key)
+        self._scatter_row_q = graftscope.instrument(
+            jax.jit(_scatter_row_q_impl, donate_argnums=(0, 1)),
+            "kv_pool._scatter_row_q", key_fn=_scatter_row_q_scope_key)
+        self._copy_q = graftscope.instrument(
+            jax.jit(_copy_q_impl, donate_argnums=(0, 1)),
+            "kv_pool._copy_q", key_fn=_copy_q_scope_key)
+        watches = [
+            CompileWatch("kv_pool", self._gather_q),
+            CompileWatch("kv_pool", self._scatter_q),
+            CompileWatch("kv_pool", self._scatter_row_q),
+            CompileWatch("kv_pool", self._copy_q)]
+        if self.allocator.sanitize:
+            # quantized poisoner: trash-copy through copy_blocks_q so
+            # the block's SCALE is poisoned along with its codes — a
+            # use-after-free gather of a poisoned block dequantizes to
+            # trash-block garbage, never to stale real content.
+            def _poison_q_impl(data, scales, src, dst):
+                return KVQ.copy_blocks_q(data, scales, src, dst)
+
+            self._poison_q = jax.jit(_poison_q_impl, donate_argnums=(0, 1))
+            self.allocator._on_free = self._graftsan_poison
+            watches.append(CompileWatch("kv_pool", self._poison_q))
+        return tuple(watches)
+
     # -- graftsan (GRAFTSAN=1) -----------------------------------------------
 
     def _graftsan_poison(self, ids: List[int]) -> None:
@@ -743,8 +937,12 @@ class KVBlockPool:
             for b in ids:
                 if self.allocator.refcount(b) > 0:
                     continue  # re-allocated between free and poison
-                self.data = self._poison(self.data, trash,
-                                         jnp.asarray([b], jnp.int32))
+                dst = jnp.asarray([b], jnp.int32)
+                if self.block_dtype is not None:
+                    self.data, self.scales = self._poison_q(
+                        self.data, self.scales, trash, dst)
+                else:
+                    self.data = self._poison(self.data, trash, dst)
 
     def _graftsan_check_tables(self, tables, op: str,
                                write: bool = False) -> None:
@@ -783,7 +981,8 @@ class KVBlockPool:
     def for_engine(cls, engine: DecodeEngine, num_blocks: int,
                    block_size: int = DEFAULT_KV_BLOCK_SIZE,
                    watermark: float = 0.9,
-                   sanitize: Optional[bool] = None) -> "KVBlockPool":
+                   sanitize: Optional[bool] = None,
+                   block_dtype: Optional[str] = None) -> "KVBlockPool":
         """Build a pool matching an engine's cache geometry. The paged
         path drives the engine's OWN compiled programs on gathered
         views, so the engine must run the plain XLA single-device
@@ -806,7 +1005,8 @@ class KVBlockPool:
         heads = getattr(cfg, "n_kv_head", cfg.n_head)
         return cls(cfg.n_layer, num_blocks, heads, block_size,
                    cfg.head_dim, engine._cache_seq, dtype=engine.dtype,
-                   watermark=watermark, sanitize=sanitize)
+                   watermark=watermark, sanitize=sanitize,
+                   block_dtype=block_dtype)
 
     # -- device ops (all under _dev_lock) ------------------------------------
 
@@ -817,15 +1017,23 @@ class KVBlockPool:
         with self._dev_lock:
             if self.allocator.sanitize:
                 self._graftsan_check_tables(tables, "gather")
-            k, v = self._gather(self.data, jnp.asarray(tables, jnp.int32))
+            tj = jnp.asarray(tables, jnp.int32)
+            if self.block_dtype is not None:
+                k, v = self._gather_q(self.data, self.scales, tj)
+            else:
+                k, v = self._gather(self.data, tj)
         return KVCache(k=k, v=v, length=jnp.asarray(length, jnp.int32))
 
     def scatter(self, cache: KVCache, tables: np.ndarray) -> None:
         with self._dev_lock:
             if self.allocator.sanitize:
                 self._graftsan_check_tables(tables, "scatter", write=True)
-            self.data = self._scatter(self.data, cache.k, cache.v,
-                                      jnp.asarray(tables, jnp.int32))
+            tj = jnp.asarray(tables, jnp.int32)
+            if self.block_dtype is not None:
+                self.data, self.scales = self._scatter_q(
+                    self.data, self.scales, cache.k, cache.v, tj)
+            else:
+                self.data = self._scatter(self.data, cache.k, cache.v, tj)
 
     def scatter_columns(self, cache: KVCache, tables: np.ndarray,
                         nb_lo: int) -> None:
@@ -849,10 +1057,15 @@ class KVBlockPool:
         with self._dev_lock:
             if self.allocator.sanitize:
                 self._graftsan_check_tables(table_row, "scatter_row", write=True)
-            self.data = self._scatter_row(
-                self.data, cache.k, cache.v,
-                jnp.asarray(table_row, jnp.int32),
-                jnp.asarray(roll, jnp.int32))
+            row_j = jnp.asarray(table_row, jnp.int32)
+            roll_j = jnp.asarray(roll, jnp.int32)
+            if self.block_dtype is not None:
+                self.data, self.scales = self._scatter_row_q(
+                    self.data, self.scales, cache.k, cache.v, row_j,
+                    roll_j)
+            else:
+                self.data = self._scatter_row(
+                    self.data, cache.k, cache.v, row_j, roll_j)
 
     def cow_copy(self, src: int) -> int:
         """Copy-on-write: allocate a private block, copy ``src`` into
@@ -862,9 +1075,13 @@ class KVBlockPool:
             self._graftsan_check_tables(np.asarray([src]), "cow_copy")
         dst = self.allocator.alloc(1)[0]
         with self._dev_lock:
-            self.data = self._copy(self.data,
-                                   jnp.asarray([src], jnp.int32),
-                                   jnp.asarray([dst], jnp.int32))
+            src_j = jnp.asarray([src], jnp.int32)
+            dst_j = jnp.asarray([dst], jnp.int32)
+            if self.block_dtype is not None:
+                self.data, self.scales = self._copy_q(
+                    self.data, self.scales, src_j, dst_j)
+            else:
+                self.data = self._copy(self.data, src_j, dst_j)
         # locked counter bump (locks-pass finding: pools are shared
         # across front ends — the prefix store's insert and a paged
         # runner can CoW concurrently, and a bare += here loses updates)
@@ -881,19 +1098,30 @@ class KVBlockPool:
     def note_gauges(self, component: str = "pool") -> None:
         st = self.allocator.stats()
         in_use = st.blocks_in_use - st.blocks_evictable
+        # the block-count gauges carry the storage regime as a label so
+        # a capacity dashboard can translate blocks to bytes (and tell
+        # a quantized pool's 2x block count from a provisioning change)
         REGISTRY.gauge("kv_cache_blocks_in_use", in_use,
-                       component=component)
+                       component=component,
+                       block_dtype=self.block_regime)
         REGISTRY.gauge("kv_cache_blocks_total", st.blocks_total,
-                       component=component)
+                       component=component,
+                       block_dtype=self.block_regime)
+        REGISTRY.gauge("kv_pool_bytes_per_block", self._bytes_per_block,
+                       component=component,
+                       block_dtype=self.block_regime)
         # graftscope occupancy time series: blocks-in-use over time at
         # the pool's own accounting points, served at /debug/profile
         graftscope.sample("kv_cache_blocks_in_use", in_use,
-                          component=component)
+                          component=component,
+                          block_dtype=self.block_regime)
 
     def stats(self) -> dict:
         return {**self.allocator.stats().as_dict(),
                 "block_size": self.block_size,
                 "blocks_per_row": self.nbm,
+                "block_dtype": self.block_regime,
+                "bytes_per_block": self._bytes_per_block,
                 "graftsan": self.allocator.sanitize}
 
 

@@ -498,6 +498,14 @@ def create_app(cfg: Optional[ServingConfig] = None,
                         f"injected kv_pool spans {kv_pool.max_seq} "
                         f"slots, engine cache is {engine._cache_seq} — "
                         "shared-pool replicas must agree on geometry")
+                if kv_pool.block_dtype is not None:
+                    # config already refuses KV_POOL_DTYPE under
+                    # continuous mode; an injected pool must not smuggle
+                    # quantized movers past the certified plan set
+                    raise ValueError(
+                        f"injected kv_pool stores {kv_pool.block_regime} "
+                        "blocks — AUTO_PLAN_CONTINUOUS certifies the "
+                        "full-precision mover programs only")
             else:
                 from ..runtime.kv_pool import KVBlockPool
                 kv_pool = KVBlockPool.for_engine(
@@ -631,13 +639,27 @@ def create_app(cfg: Optional[ServingConfig] = None,
                         f"injected kv_pool spans {kv_pool.max_seq} "
                         f"slots, engine cache is {eng_._cache_seq} — "
                         "shared-pool replicas must agree on geometry")
+                # storage regime is geometry too: a decode replica
+                # gathering f32 views from a pool a prefill replica
+                # filled as int8 (or vice versa) would be a silent
+                # cross-replica numerics mismatch
+                from ..utils.graftnum import regime_of as _regime_of
+                want = (_regime_of(cfg.kv_pool_dtype)
+                        if cfg.kv_pool_dtype else None)
+                if kv_pool.block_dtype != want:
+                    raise ValueError(
+                        f"injected kv_pool stores {kv_pool.block_regime} "
+                        f"blocks, KV_POOL_DTYPE={cfg.kv_pool_dtype!r} — "
+                        "shared-pool replicas must agree on block "
+                        "storage")
             else:
                 from ..runtime.kv_pool import KVBlockPool
                 kv_pool = KVBlockPool.for_engine(
                     spec_runner.plain if spec_runner is not None
                     else runner,
                     num_blocks=cfg.kv_pool_blocks,
-                    block_size=cfg.kv_block_size)
+                    block_size=cfg.kv_block_size,
+                    block_dtype=cfg.kv_pool_dtype or None)
         elif kv_pool is not None:
             raise ValueError("kv_pool injected but KV_POOL_BLOCKS=0 — "
                              "a silently unused pool would misreport "
@@ -731,6 +753,7 @@ def create_app(cfg: Optional[ServingConfig] = None,
             "tp_decode": cfg.tp_decode,
             "kv_pool_blocks": cfg.kv_pool_blocks,
             "kv_block_size": cfg.kv_block_size,
+            "kv_pool_dtype": cfg.kv_pool_dtype,
             # graftfleet (llm_sharding_demo_tpu/fleet): this replica's
             # declared role and the prefix-store alignment width the
             # router's affinity keys must match

@@ -102,6 +102,13 @@ class ServingConfig:
     kv_pool_blocks: int = 0
     # Cache slots per pool block; MAX_SEQ must be a multiple of it.
     kv_block_size: int = 16
+    # Quantized KV block storage (runtime.kv_pool / ops.kv_quant):
+    # "int8" or "fp8" stores pool blocks narrow with per-block f32
+    # scales — 2-4x the rows per HBM byte, dequantized on gather under
+    # the seeded kv.int8/kv.fp8 tolerance budgets (utils/graftnum
+    # TOLERANCE_POLICY). "" (default) keeps full-precision blocks and
+    # every byte-equality pin. Requires KV_POOL_BLOCKS > 0.
+    kv_pool_dtype: str = ""
     # Prefix-store alignment width (runtime.prefix_cache): >0 overrides
     # the store's chunk (default: PREFILL_CHUNK, else 64). The fleet
     # router's affinity keys are content keys at THIS width, so every
@@ -197,6 +204,25 @@ class ServingConfig:
         if self.kv_block_size < 1:
             raise ValueError(
                 f"KV_BLOCK_SIZE={self.kv_block_size} must be >= 1")
+        if self.kv_pool_dtype:
+            if self.kv_pool_blocks == 0:
+                raise ValueError(
+                    "KV_POOL_DTYPE selects the paged pool's block "
+                    "storage; it needs KV_POOL_BLOCKS > 0 (a silently "
+                    "ignored knob would misreport the serving "
+                    "composition)")
+            from .graftnum import GraftnumError, regime_of
+            try:
+                regime = regime_of(self.kv_pool_dtype)
+            except GraftnumError as e:
+                raise ValueError(
+                    f"KV_POOL_DTYPE={self.kv_pool_dtype!r}: {e}") from e
+            if regime not in ("int8", "fp8"):
+                raise ValueError(
+                    f"KV_POOL_DTYPE={self.kv_pool_dtype!r} names the "
+                    f"full-precision regime {regime!r} — the pool "
+                    "already stores full-precision blocks by default; "
+                    "quantized storage takes 'int8' or 'fp8'")
         if self.prefix_chunk < 0:
             raise ValueError(
                 f"PREFIX_CHUNK={self.prefix_chunk} must be >= 0 "
@@ -232,13 +258,14 @@ class ServingConfig:
                     "MAX_BATCH > 1 and BATCH_MODE=iter")
             if (self.spec_decode > 0 or self.prefix_cache > 0
                     or self.prefill_chunk > 0 or self.pp_decode
-                    or self.tp_decode or self.ep_decode):
+                    or self.tp_decode or self.ep_decode
+                    or self.kv_pool_dtype):
                 raise ValueError(
                     "AUTO_PLAN_CONTINUOUS certifies exactly the "
                     "solo-paged and pooled-iter program sets; "
                     "SPEC_DECODE/PREFIX_CACHE/PREFILL_CHUNK/PP|TP|"
-                    "EP_DECODE own other compile spaces and would let "
-                    "a switch reach uncertified programs")
+                    "EP_DECODE/KV_POOL_DTYPE own other compile spaces "
+                    "and would let a switch reach uncertified programs")
         if self.auto_plan_journal and not self.auto_plan_continuous:
             raise ValueError(
                 "AUTO_PLAN_JOURNAL calibrates the continuous planner's "
@@ -333,6 +360,7 @@ def from_env() -> ServingConfig:
         batch_mode=os.environ.get("BATCH_MODE", "admission"),
         kv_pool_blocks=_env_int("KV_POOL_BLOCKS", 0),
         kv_block_size=_env_int("KV_BLOCK_SIZE", 16),
+        kv_pool_dtype=os.environ.get("KV_POOL_DTYPE", ""),
         prefix_chunk=_env_int("PREFIX_CHUNK", 0),
         fleet_role=os.environ.get("FLEET_ROLE", ""),
         auto_plan=_env_bool("AUTO_PLAN"),

@@ -44,10 +44,14 @@ Oracle methodology (:class:`ToleranceOracle`):
   provenance (prompt index, step, per-position MSE, both argmaxes), so
   the failing position is debuggable, not just the aggregate.
 
-First consumers: the int8 weight-only path (``decode.int8``) and
-bf16-vs-f32 decode (``decode.bf16``) — the landing pad for quantized KV
-blocks (ROADMAP item 4): per-block int8/fp8 KV storage lands as a new
-policy path measured by this same oracle.
+Consumers: the int8 weight-only path (``decode.int8``), bf16-vs-f32
+decode (``decode.bf16``), and the quantized KV pool (``kv.int8`` /
+``kv.fp8``): per-block narrow KV storage (runtime.kv_pool
+``block_dtype``, ops.kv_quant) measured by this same oracle through
+:class:`_QuantizedKVProbe` — the production pool movers
+(quantize-on-scatter, dequant-on-gather) inserted into the exact
+engine's own compiled forward, so the measured divergence is exactly
+one pool round-trip per scored position.
 """
 
 from __future__ import annotations
@@ -57,15 +61,22 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 # The dtype-regime vocabulary. tools/graftcheck/numerics.py mirrors
 # this as NUM_REGIMES (tests pin the two stay equal, like the slo
-# pass's SLO_METRICS); DecodeEngine(dtype=...) admits exactly these.
-REGIMES = ("f32", "bf16", "int8")
+# pass's SLO_METRICS). ``fp8`` is a KV-block STORAGE regime only
+# (runtime.kv_pool ``block_dtype`` / serving ``KV_POOL_DTYPE``);
+# engines admit the first three via :func:`engine_regime_of`.
+REGIMES = ("f32", "bf16", "int8", "fp8")
 
 # Accepted spellings per regime (engine callers pass jnp dtypes, numpy
 # dtypes, or serving-config strings; all collapse to one regime token).
+# Both fp8 interchange formats collapse to one regime: the contract is
+# about the quantize/dequantize boundary, and kv-block storage uses
+# e4m3fn (ops.kv_quant.STORAGE_DTYPES — mantissa over exponent for
+# absmax-normalized block content).
 _REGIME_ALIASES = {
     "float32": "f32", "f32": "f32",
     "bfloat16": "bf16", "bf16": "bf16",
     "int8": "int8",
+    "fp8": "fp8", "float8_e4m3fn": "fp8", "float8_e5m2": "fp8",
 }
 
 # Declared quality budgets per approximate path — the oracle's gate and
@@ -84,6 +95,16 @@ TOLERANCE_POLICY = {
     # bf16 decode (matmul operand rounding only; LN stats/softmax/
     # logits stay f32) vs the f32 parity engine
     "decode.bf16": {"logit_mse": 5e-5, "top1_agreement": 0.95},
+    # quantized KV blocks (runtime.kv_pool block_dtype, ops.kv_quant):
+    # the exact engine's own forward with one pool scatter/gather
+    # round-trip on the KV cache per scored position
+    # (_QuantizedKVProbe). Measured on seed 0 (demo model): 1.5e-8
+    # int8 / 3.0e-7 fp8-e4m3fn, agreement 1.0 both — same ~100x
+    # headroom convention as the decode paths. (int8 is TIGHTER than
+    # fp8 here: 127 uniform levels beat e4m3's 3-bit mantissa on
+    # absmax-normalized block content.)
+    "kv.int8": {"logit_mse": 2e-6, "top1_agreement": 0.90},
+    "kv.fp8": {"logit_mse": 3e-5, "top1_agreement": 0.90},
 }
 
 
@@ -113,9 +134,9 @@ class GraftnumError(Exception):
 def regime_of(dtype) -> str:
     """Collapse a dtype spelling to its declared regime token.
 
-    Accepts the three regimes in any spelling (``jnp.float32`` /
-    ``"bfloat16"`` / ``"int8"`` / numpy dtypes); anything else —
-    ``"float16"``, ``"fp8"``, a typo — raises a typed
+    Accepts the declared regimes in any spelling (``jnp.float32`` /
+    ``"bfloat16"`` / ``"int8"`` / ``"fp8"`` / numpy dtypes); anything
+    else — ``"float16"``, a typo — raises a typed
     :class:`GraftnumError` instead of flowing into ``astype`` and
     silently running a precision nothing declared.
     """
@@ -130,11 +151,30 @@ def regime_of(dtype) -> str:
     if regime is None:
         raise GraftnumError(
             f"dtype {dtype!r} is outside the declared regime vocabulary "
-            f"{REGIMES} (spellings: float32/bfloat16/int8 and their jnp "
-            "dtypes). Low-precision regimes are a declared contract "
+            f"{REGIMES} (spellings: float32/bfloat16/int8/fp8 and their "
+            "jnp dtypes). Low-precision regimes are a declared contract "
             "(PRECISION_CONTRACT + TOLERANCE_POLICY, see "
             "docs/ARCHITECTURE.md 'Numerics discipline'); an undeclared "
             "dtype has no cast boundaries and no tolerance budget.")
+    return regime
+
+
+def engine_regime_of(dtype) -> str:
+    """:func:`regime_of`, restricted to ENGINE compute regimes.
+
+    ``fp8`` is in the declared vocabulary as a KV-block STORAGE regime
+    (``runtime.kv_pool`` ``block_dtype`` / serving ``KV_POOL_DTYPE``) —
+    no engine forward runs fp8 activations or weights, so an engine
+    constructor passing it gets the same typed regime-vocabulary error
+    an undeclared dtype would, pointing at the knob that does exist.
+    """
+    regime = regime_of(dtype)
+    if regime == "fp8":
+        raise GraftnumError(
+            f"dtype {dtype!r} is outside the ENGINE regime vocabulary "
+            f"{REGIMES[:-1]}: 'fp8' is a KV-block storage regime — set "
+            "it per pool (KVBlockPool(block_dtype='fp8') / the serving "
+            "KV_POOL_DTYPE knob), not as an engine compute dtype.")
     return regime
 
 
@@ -250,24 +290,101 @@ class ToleranceOracle:
         return report
 
 
+# Lease contract (tools/graftcheck sanitize pass): the probe's
+# ``_prefill`` is the one scope here that moves pool blocks, and it
+# brackets its movers with its own alloc/free (try/finally) — the
+# lease is held for exactly the round-trip being measured.
+POOL_MOVER_SCOPES = ("_QuantizedKVProbe._prefill",)
+
+
+class _QuantizedKVProbe:
+    """An "approximate engine" whose ONLY approximation is the
+    quantized KV pool: the exact engine's own compiled programs, with
+    the KV cache routed through the pool's production quantize-on-
+    scatter / dequant-on-gather movers between prefilling the history
+    and scoring the last position. The oracle's ``_last_logits`` call
+    therefore measures exactly one pool round-trip of KV error per
+    position — model weights, activations, and every other program are
+    the exact engine's, so a budget breach localizes to the movers.
+
+    Duck-types the slice of the engine surface the oracle touches:
+    ``config``, ``_run_params``, ``_prefill``.
+    """
+
+    def __init__(self, engine, pool):
+        if pool.block_dtype is None:
+            raise GraftnumError(
+                "probe pool stores full-precision blocks — the probe "
+                "would measure a byte-identity, not a quantized path; "
+                "construct the pool with block_dtype set")
+        self.engine = engine
+        self.pool = pool
+        self.config = engine.config
+
+    def _run_params(self):
+        return self.engine._run_params()
+
+    def _prefill(self, params, ids, pad):
+        """[1, S] ids -> ([1, V] last-position logits, cache): prefill
+        the first S-1 tokens exactly, round-trip that cache through the
+        quantized pool (scatter = quantize, gather = dequantize), then
+        score token S with the exact engine's cached forward on the
+        dequantized working view."""
+        import numpy as np
+
+        eng, pool = self.engine, self.pool
+        hist = int(ids.shape[1]) - 1
+        _logits, cache = eng._prefill(params, ids[:, :-1], pad)
+        row = pool.allocator.alloc(pool.nbm)
+        tables = np.asarray([row], np.int32)
+        try:
+            pool.scatter(cache, tables)
+            working = pool.gather(tables, hist)
+            logits, working = eng._forward_cached(params, ids[:, -1:],
+                                                  working, pad)
+        finally:
+            pool.allocator.free(row)
+        return logits[:, -1], working
+
+
 def oracle_rows(seed: int = 0, max_seq: int = 64) -> List[dict]:
     """The bench/CI consumer: run every declared TOLERANCE_POLICY path
     on the pinned demo model (fleet.harness.demo_model — the same
     geometry every harness serves) and return one compact report row
     per path (positions dropped; the oracle raises on breach, so a row
-    existing means the path is inside its declared budget)."""
+    existing means the path is inside its declared budget). A path
+    whose backend prerequisite is missing (fp8 storage on an old chip)
+    yields a ``{"skipped": reason}`` row — present, so the journal
+    shows the gap, but unmeasured."""
     import jax.numpy as jnp
 
     from ..fleet.harness import demo_model
+    from ..ops import kv_quant
     from ..runtime.engine import DecodeEngine
+    from ..runtime.kv_pool import KVBlockPool
+    from .metrics import DEFAULT_KV_BLOCK_SIZE
 
     cfg, params = demo_model(max_seq)
     exact = DecodeEngine(params, cfg, max_seq=max_seq)
+
+    def kv_probe(block_dtype):
+        # twice the one-row block count: headroom is irrelevant to the
+        # oracle (one row at a time), this just keeps the allocator's
+        # watermark out of the way
+        pool = KVBlockPool.for_engine(
+            exact, num_blocks=2 * (exact._cache_seq // DEFAULT_KV_BLOCK_SIZE),
+            block_dtype=block_dtype)
+        return _QuantizedKVProbe(exact, pool)
+
     engines = {
         "decode.int8": DecodeEngine(params, cfg, max_seq=max_seq,
                                     dtype="int8"),
         "decode.bf16": DecodeEngine(params, cfg, max_seq=max_seq,
                                     dtype=jnp.bfloat16),
+        "kv.int8": kv_probe("int8"),
+        "kv.fp8": (kv_probe("fp8") if kv_quant.fp8_supported()
+                   else "backend lacks float8_e4m3fn storage "
+                        "(ops.kv_quant.fp8_supported() is False)"),
     }
     oracle = ToleranceOracle(seed)
     rows = []
@@ -282,6 +399,10 @@ def oracle_rows(seed: int = 0, max_seq: int = 64) -> List[dict]:
                 f"builds no engine for it (covered: {sorted(engines)})"
                 " — wire the new path's approximate engine in before "
                 "declaring its budget", path=path)
+        if isinstance(engines[path], str):
+            rows.append({"path": path, "seed": seed,
+                         "skipped": engines[path]})
+            continue
         report = oracle.compare(path, engines[path], exact)
         rows.append({k: v for k, v in report.items() if k != "positions"})
     return rows

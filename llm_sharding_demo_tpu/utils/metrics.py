@@ -144,8 +144,15 @@ METRIC_CATALOG: Dict[str, str] = {
     # whole serving surface, so "how full is KV memory" is one query.
     # Replaces the retired per-component kv_cache_slots_in_use series
     # (see RETIRED_METRICS).
+    # Pool-backed components additionally label the pair with
+    # block_dtype (the storage regime: f32/bf16 full-precision, or
+    # int8/fp8 quantized — runtime.kv_pool) so a capacity query can
+    # group by what a block IS, and publish the per-block HBM cost:
+    # quantized pools fit 2-4x the blocks in the same bytes, and the
+    # gauge pair alone would misread that as "more memory".
     "kv_cache_blocks_in_use": "gauge",
     "kv_cache_blocks_total": "gauge",
+    "kv_pool_bytes_per_block": "gauge",
     "jit_program_cache_size": "gauge",      # compiled programs per component
     "spec_acceptance_rate": "gauge",        # emitted tokens per verify
     # continuous planning (utils/graftwatch.py): one increment per live

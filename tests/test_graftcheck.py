@@ -215,7 +215,10 @@ def test_repo_passes_graftcheck():
     for rel in ("llm_sharding_demo_tpu/ops/quant.py",
                 "llm_sharding_demo_tpu/ops/layers.py",
                 "llm_sharding_demo_tpu/ops/decode_layer.py",
-                "llm_sharding_demo_tpu/runtime/engine.py"):
+                "llm_sharding_demo_tpu/ops/kv_quant.py",
+                "llm_sharding_demo_tpu/runtime/engine.py",
+                "llm_sharding_demo_tpu/runtime/kv_pool.py",
+                "llm_sharding_demo_tpu/models/moe.py"):
         assert npc.get(rel, 0) >= 1, (
             f"{rel}: no live PRECISION_CONTRACT entry — the numerics "
             "discipline stopped seeing its low-precision paths")

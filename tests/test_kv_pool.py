@@ -173,8 +173,13 @@ def test_paged_runner_emits_pool_gauges(setup):
     rng = np.random.default_rng(5)
     runner.generate(rng.integers(0, 211, size=(6,))[None, :], 8)
     snap = REGISTRY.snapshot()
-    assert snap["kv_cache_blocks_total{component=paged}"] == 24
-    assert "kv_cache_blocks_in_use{component=paged}" in snap
+    # pool-backed gauges carry the storage regime label (f32 here: the
+    # full-precision pool inherits the engine dtype) plus the per-block
+    # HBM cost — see tests/test_kv_quant.py for the quantized labels
+    key = "{block_dtype=f32,component=paged}"
+    assert snap["kv_cache_blocks_total" + key] == 24
+    assert ("kv_cache_blocks_in_use" + key) in snap
+    assert snap["kv_pool_bytes_per_block" + key] == pool._bytes_per_block
 
 
 # -- prefix store on the pool ------------------------------------------------

@@ -428,7 +428,8 @@ def test_metric_catalog_covers_runtime_names():
     """Spot-check the catalog knows the series this PR's tests assert."""
     for name in ("ttft_seconds", "tpot_seconds", "compile_events_total",
                  "queue_depth", "iter_live_rows", "kv_cache_blocks_in_use",
-                 "kv_cache_blocks_total", "kv_pool_preemptions_total",
+                 "kv_cache_blocks_total", "kv_pool_bytes_per_block",
+                 "kv_pool_preemptions_total",
                  "jit_program_cache_size", "spec_acceptance_rate",
                  "batch_occupancy"):
         assert name in METRIC_CATALOG, name

@@ -71,7 +71,14 @@ _HIGHER_BETTER = ("tokens_per_sec", "tokens_per_second", "speedup",
                   # sibling regresses DOWNWARD (checked before the
                   # lower-better list so the metric never falls through
                   # to a latency-ish suffix match)
-                  "top1_agreement")
+                  "top1_agreement",
+                  # kv_quant_capacity row (quantized KV blocks): rows
+                  # admitted before the first preemption, the int8/f32
+                  # admitted-row ratio at equal pool bytes, and the
+                  # prefix-store depth all regress DOWNWARD — fewer
+                  # resident rows per HBM byte
+                  "before_first_preemption", "capacity_ratio",
+                  "prefix_store_depth")
 _LOWER_BETTER = ("_ms", "latency", "step_ms", "prefill_ms",
                  # traffic_mix occupancy join: deeper queues at the
                  # same offered rate = the serving stack fell behind

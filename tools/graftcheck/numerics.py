@@ -4,17 +4,17 @@ The static half of **graftnum** (``llm_sharding_demo_tpu/utils/
 graftnum.py`` is the dynamic half — the same split as sanitize/locks/
 faults/slo/fleet/watch/timeline). Every exact path in this repo is
 pinned byte-for-byte; the approximate paths (weight-only int8, bf16
-decode) until now carried their precision discipline as PROSE — "LN
-stats, softmax and logits stay f32" — that no pass checked. This pass
-makes precision a DECLARED contract:
+decode, quantized KV blocks) until now carried their precision
+discipline as PROSE — "LN stats, softmax and logits stay f32" — that no
+pass checked. This pass makes precision a DECLARED contract:
 
-Every ops/ and runtime/ module with low-precision arithmetic declares
-``PRECISION_CONTRACT`` beside ``JIT_ENTRY_POINTS``::
+Every ops/, runtime/, and models/ module with low-precision arithmetic
+declares ``PRECISION_CONTRACT`` beside ``JIT_ENTRY_POINTS``::
 
     PRECISION_CONTRACT = {
         "<entry point>": {
-            "regime": "f32" | "bf16" | "int8" | "carried",
-            "casts": ("f32", "bf16", "int8", "carried", ...),
+            "regime": "f32" | "bf16" | "int8" | "fp8" | "carried",
+            "casts": ("f32", "bf16", "int8", "fp8", "carried", ...),
             "accumulate": "f32",          # required when low-precision
                                           # dots/reductions exist
             "exact": True | False,
@@ -93,11 +93,11 @@ NUMERICS_RULE_IDS = ("undeclared-cast", "unstable-reduction",
 
 # The dtype-regime vocabulary (graftnum.REGIMES mirrors this — tests
 # pin the two stay equal, like the slo pass's SLO_METRICS).
-NUM_REGIMES = ("f32", "bf16", "int8")
+NUM_REGIMES = ("f32", "bf16", "int8", "fp8")
 # contract regimes add "carried": output dtype follows the input's
 CONTRACT_REGIMES = NUM_REGIMES + ("carried",)
 # sanctioned-cast vocabulary: value-precision dtype tokens + "carried"
-CAST_TOKENS = ("f32", "bf16", "f16", "f64", "int8", "carried")
+CAST_TOKENS = ("f32", "bf16", "f16", "f64", "int8", "fp8", "carried")
 # the two oracle metrics every TOLERANCE_POLICY path must declare
 ORACLE_METRICS = ("logit_mse", "top1_agreement")
 
@@ -111,10 +111,10 @@ _DTYPE_TOKENS = {
     "float16": "f16", "f16": "f16", "fp16": "f16", "half": "f16",
     "float64": "f64", "f64": "f64", "double": "f64",
     "int8": "int8",
-    # fp8 spellings map to one token the traced rules can width-check;
-    # "fp8" is deliberately OUTSIDE CAST_TOKENS/NUM_REGIMES today, so
-    # any fp8 cast/dot is an unsanctionable finding until a future PR
-    # declares the regime (+ its TOLERANCE_POLICY path)
+    # fp8 spellings map to one token the traced rules can width-check.
+    # The regime is DECLARED (quantized KV block storage, ops/kv_quant.py)
+    # with its TOLERANCE_POLICY path "kv.fp8" — fp8 casts are sanctionable
+    # wherever a contract lists the token, same as int8.
     "float8_e4m3fn": "fp8", "float8_e5m2": "fp8", "fp8": "fp8",
 }
 # integer/bool/index casts are control flow, not value precision
@@ -344,10 +344,11 @@ class TracedEntry:
 def traced_entry_points() -> List[TracedEntry]:
     """The production trace table: the mixed-precision entry points of
     ops/layers.py, ops/quant.py (XLA lowerings — the Pallas kernels'
-    bodies are checked by the AST half), and runtime/engine.py's
-    samplers, each at the low-precision avals serving actually runs
-    them with. Kept beside the rules so adding a traced entry and its
-    contract is one review."""
+    bodies are checked by the AST half), ops/kv_quant.py (the quantized
+    KV-block movers), models/moe.py's expert contractions, and
+    runtime/engine.py's samplers, each at the low-precision avals
+    serving actually runs them with. Kept beside the rules so adding a
+    traced entry and its contract is one review."""
     import jax.numpy as jnp
 
     def bf(*s):
@@ -355,6 +356,12 @@ def traced_entry_points() -> List[TracedEntry]:
 
     def f32(*s):
         return jnp.zeros(s, jnp.float32)
+
+    def i8(*s):
+        return jnp.zeros(s, jnp.int8)
+
+    def i32(*s):
+        return jnp.zeros(s, jnp.int32)
 
     def _layers():
         from llm_sharding_demo_tpu.ops import layers
@@ -364,12 +371,22 @@ def traced_entry_points() -> List[TracedEntry]:
         from llm_sharding_demo_tpu.ops import quant
         return quant
 
+    def _kvq():
+        from llm_sharding_demo_tpu.ops import kv_quant
+        return kv_quant
+
+    def _moe():
+        from llm_sharding_demo_tpu.models import moe
+        return moe
+
     def _engine():
         from llm_sharding_demo_tpu.runtime import engine
         return engine
 
     LAYERS = "llm_sharding_demo_tpu/ops/layers.py"
     QUANT = "llm_sharding_demo_tpu/ops/quant.py"
+    KVQ = "llm_sharding_demo_tpu/ops/kv_quant.py"
+    MOE = "llm_sharding_demo_tpu/models/moe.py"
     ENGINE = "llm_sharding_demo_tpu/runtime/engine.py"
     return [
         TracedEntry(LAYERS, "layer_norm", lambda: (
@@ -393,6 +410,39 @@ def traced_entry_points() -> List[TracedEntry]:
              jnp.zeros((2, 3), jnp.int32)))),
         TracedEntry(QUANT, "quantize_array", lambda: (
             _quant().quantize_array, (f32(8, 16),))),
+        # quantized KV-block movers at the tiny paged geometry
+        # (L=1, NB=2 + trash, Hkv=2, bs=4, hd=4, B=1, NBm=2)
+        TracedEntry(KVQ, "quantize_blocks_int8", lambda: (
+            _kvq().quantize_blocks_int8, (f32(2, 2, 4, 4),))),
+        TracedEntry(KVQ, "quantize_blocks_fp8", lambda: (
+            _kvq().quantize_blocks_fp8, (f32(2, 2, 4, 4),))),
+        TracedEntry(KVQ, "dequantize_blocks", lambda: (
+            lambda q, s: _kvq().dequantize_blocks(q, s, jnp.float32),
+            (i8(2, 2, 4, 4), f32(2, 2)))),
+        TracedEntry(KVQ, "gather_kv_q", lambda: (
+            lambda d, s, t: _kvq().gather_kv_q(d, s, t, jnp.float32),
+            (i8(1, 3, 2, 2, 4, 4), f32(1, 3, 2, 2), i32(1, 2)))),
+        TracedEntry(KVQ, "scatter_kv_int8", lambda: (
+            _kvq().scatter_kv_int8,
+            (i8(1, 3, 2, 2, 4, 4), f32(1, 3, 2, 2),
+             f32(1, 1, 2, 8, 4), f32(1, 1, 2, 8, 4), i32(1, 2)))),
+        TracedEntry(KVQ, "scatter_kv_fp8", lambda: (
+            _kvq().scatter_kv_fp8,
+            (jnp.zeros((1, 3, 2, 2, 4, 4), jnp.float8_e4m3fn),
+             f32(1, 3, 2, 2),
+             f32(1, 1, 2, 8, 4), f32(1, 1, 2, 8, 4), i32(1, 2)))),
+        TracedEntry(KVQ, "copy_blocks_q", lambda: (
+            _kvq().copy_blocks_q,
+            (i8(1, 3, 2, 2, 4, 4), f32(1, 3, 2, 2), i32(1), i32(1)))),
+        # MoE expert contractions at the serving int8 x bf16 avals
+        TracedEntry(MOE, "_expert_einsum", lambda: (
+            lambda x, q, s: _moe()._expert_einsum(
+                "ebcd,edf->ebcf", x, _quant().QuantizedTensor(q, s)),
+            (bf(2, 2, 2, 8), i8(2, 8, 16), bf(2, 16)))),
+        TracedEntry(MOE, "_gathered_einsum", lambda: (
+            lambda x, q, s: _moe()._gathered_einsum(
+                x, _quant().QuantizedTensor(q, s)),
+            (bf(2, 8), i8(2, 8, 16), bf(2, 16)))),
         TracedEntry(ENGINE, "sampler_pmf", lambda: (
             lambda lg: _engine().sampler_pmf(
                 lg, _engine().SamplingConfig(mode="sample")),
@@ -629,8 +679,10 @@ def run_numerics(root: str, paths: Optional[List[str]] = None,
     for relpath, mod in sorted(mods.items()):
         in_scope = relpath.startswith("llm_sharding_demo_tpu/ops/") or \
             relpath.startswith("llm_sharding_demo_tpu/runtime/") or \
+            relpath.startswith("llm_sharding_demo_tpu/models/") or \
             (paths is not None and ("/ops/" in "/" + relpath
-                                    or "/runtime/" in "/" + relpath))
+                                    or "/runtime/" in "/" + relpath
+                                    or "/models/" in "/" + relpath))
         entries = _parse_contract(mod, findings)
         if entries is None:
             if in_scope:

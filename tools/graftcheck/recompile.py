@@ -62,10 +62,18 @@ class SpecDesc:
 @dataclasses.dataclass(frozen=True)
 class PagedDesc:
     """The KVBlockPool fields that shape its program space (the pool's
-    block COUNT never keys programs — tables are traced)."""
+    block COUNT never keys programs — tables are traced).
+
+    ``quantized``: the pool stores narrow blocks (``block_dtype`` set),
+    so its movers are the ``_gather_q``/``_scatter_q`` family — same
+    key structure (tables traced, scales ride the same program), the
+    plain movers' bound drops to zero. The STORAGE dtype itself never
+    keys programs either: int8 vs fp8 pools mint the same key set.
+    """
 
     max_seq: int
     block_size: int
+    quantized: bool = False
 
     @property
     def nbm(self) -> int:
@@ -189,14 +197,22 @@ def paged_runner_keys(desc: EngineDesc, paged: PagedDesc,
       the owned columns); plain runs stay on the full-width key;
     - ``_scatter_row``/``_copy``: admission/CoW movers — unused by a
       plain generate (the iteration scheduler and prefix sharing mint
-      them), so their bound here is zero.
+      them), so their bound here is zero;
+    - a QUANTIZED pool (``paged.quantized``) runs the ``_q`` mover
+      family instead — identical key structure (the scales array rides
+      the same program; tables stay traced), with the plain movers
+      bounded at zero.
     """
     keys = engine_call_keys(desc, call)
     b = len(call.prompt_lens)
-    keys["_gather"] = ({(b, paged.nbm)} if call.max_new > 1 else set())
-    keys["_scatter"] = {(b, paged.nbm)}
-    keys["_scatter_row"] = set()
-    keys["_copy"] = set()
+    gather = "_gather_q" if paged.quantized else "_gather"
+    scatter = "_scatter_q" if paged.quantized else "_scatter"
+    row = "_scatter_row_q" if paged.quantized else "_scatter_row"
+    copy = "_copy_q" if paged.quantized else "_copy"
+    keys[gather] = ({(b, paged.nbm)} if call.max_new > 1 else set())
+    keys[scatter] = {(b, paged.nbm)}
+    keys[row] = set()
+    keys[copy] = set()
     return keys
 
 

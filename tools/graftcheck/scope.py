@@ -68,6 +68,10 @@ SCOPE_OF: Dict[str, str] = {
     "_scatter": "kv_pool._scatter",
     "_scatter_row": "kv_pool._scatter_row",
     "_copy": "kv_pool._copy",
+    "_gather_q": "kv_pool._gather_q",
+    "_scatter_q": "kv_pool._scatter_q",
+    "_scatter_row_q": "kv_pool._scatter_row_q",
+    "_copy_q": "kv_pool._copy_q",
 }
 
 
