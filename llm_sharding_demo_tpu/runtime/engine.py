@@ -40,7 +40,7 @@ import numpy as np
 
 from ..models.gpt2 import GPT2Config, Params
 from ..ops.attention import KVCache
-from ..utils import graftscope, tracing
+from ..utils import graftmem, graftscope, tracing
 from ..utils.metrics import REGISTRY, CompileWatch, kv_block_gauges
 
 # Reference sampler constants (server.py:188, 191).
@@ -82,6 +82,20 @@ DONATED_ARGS = {"_decode_seg": (2,)}
 # stalls the dispatch pipeline. Intentional syncs are baselined in
 # tools/graftcheck/baseline.txt with a justification.
 GRAFTCHECK_HOT_LOOPS = ("DecodeEngine._decode_and_pack",)
+
+# HBM-ledger contract (tools/graftcheck memory pass + utils/graftmem):
+# the engine's long-lived device holdings, by graftmem component.
+# ``params`` is the finalized weight tree — placed, quantized, or the
+# staged slices (whichever copy the compiled programs actually read;
+# registered once, AFTER mesh placement / stage partitioning settles
+# which). ``cache`` is the contiguous decode working view: one ledger
+# entry per in-flight ``_decode_and_pack`` (handle-keyed, so concurrent
+# generates on one engine attribute independently), released where the
+# last segment's output drops its alias on the donated prefill cache.
+MEMORY_LEDGER = {
+    "params": "params",
+    "cache": "engine_cache",
+}
 
 # Numerics contract (tools/graftcheck numerics pass — the static half
 # of graftnum): the engine's value-stream discipline. The compiled
@@ -594,6 +608,13 @@ class DecodeEngine:
             # the monolithic pytree keeps one set of weights resident, not
             # two (the slices are new buffers).
             self.params = None
+        # the weight tree is now FINAL (quantized/placed/staged) — this
+        # is the copy the compiled programs read, so it is the copy the
+        # HBM ledger attributes (graftmem measures live buffer nbytes,
+        # so a quantized tree registers its quantized footprint)
+        graftmem.track(self, "params", "params",
+                       self.params if self.params is not None
+                       else self.stage_params)
         self.prefill_chunk = prefill_chunk
         # Decode-attention dispatch (``decode_kernel``): "auto" routes
         # single-token decode steps through the Pallas flash-decode kernel
@@ -1065,6 +1086,12 @@ class DecodeEngine:
         dead tokens they save). Program set stays bounded: chunk sizes
         are powers of two or planner quanta."""
         t1 = time.perf_counter()
+        # working-view ledger entry: the contiguous cache is live for
+        # exactly this generation (handle-keyed — concurrent generates
+        # each hold their own entry); released at the ``del`` below.
+        # Segment rebinds are donated and shape-identical, so one
+        # registration covers the whole decode.
+        mem_h = graftmem.track(self, "cache", "engine_cache", cache)
         steps = max_new_tokens
         parts = [first[:, None]]
         token = first
@@ -1089,6 +1116,7 @@ class DecodeEngine:
                     if done.all():
                         break
         del cache  # last segment's output aliases the donated prefill cache
+        graftmem.release(mem_h)
         new = np.asarray(jax.block_until_ready(jnp.concatenate(parts, axis=1)))
         t2 = time.perf_counter()
         steps_run = new.shape[1] - 1

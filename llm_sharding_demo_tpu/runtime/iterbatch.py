@@ -123,8 +123,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.attention import KVCache
-from ..utils import graftfault, graftsched, graftscope, grafttime, \
-    tracing
+from ..utils import graftfault, graftmem, graftsched, graftscope, \
+    grafttime, tracing
 from ..utils.metrics import REGISTRY, kv_block_gauges
 from .batcher import _round_up
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
@@ -194,6 +194,20 @@ TIMELINE_EVENTS = {
     "preempt": "_preempt_lowest",
     "resume": "_seed_batch / _admit_one_inner",
     "breaker": "_fault_park_all (per-row park-budget state)",
+}
+
+# HBM-ledger contract (tools/graftcheck memory pass + utils/graftmem):
+# the live batch's long-lived device holdings, by graftmem component —
+# both live on ``_BatchState`` (handle-keyed per batch). ``cache`` is
+# the contiguous working cache (contiguous mode only: registered at
+# seed, re-measured at grow/admit rebinds, released when a pool takes
+# ownership of the state or the batch tears down); ``buf`` is the spec
+# verify token buffer (spec batches only). Pool-mode block storage is
+# the POOL's ledger entry (runtime/kv_pool.py) — tables hold ids, not
+# bytes, so nothing double-counts.
+MEMORY_LEDGER = {
+    "cache": "engine_cache",
+    "buf": "spec_buffers",
 }
 
 # Lock-discipline contract (tools/graftcheck locks pass): the scheduler
@@ -405,6 +419,13 @@ class _BatchState:
         self.spec_mode = False
         self.buf = None
         self.keys = None
+        # HBM ledger handles (utils/graftmem): released by _run_batch
+        # at batch teardown (the owner finalizer backstops any path
+        # that drops the state without reaching it)
+        self.mem_cache = (graftmem.track(self, "cache", "engine_cache",
+                                         cache)
+                          if cache is not None else 0)
+        self.mem_buf = 0
 
     def active(self):
         return any(s is not None for s in self.slots)
@@ -749,6 +770,12 @@ class IterBatchingEngine:
                     # shrink the pool permanently
                     self._release_blocks(state, i)
             raise
+        finally:
+            # batch teardown: its device holdings leave the HBM ledger
+            # (an idle scheduler must not keep reporting the last
+            # batch's cache/buffer bytes)
+            graftmem.release(state.mem_cache)
+            graftmem.release(state.mem_buf)
 
     # -- seeding -------------------------------------------------------------
 
@@ -900,6 +927,8 @@ class IterBatchingEngine:
                                                (0, s_max))
             state.spec_mode = True
             state.buf = buf
+            state.mem_buf = graftmem.track(state, "buf", "spec_buffers",
+                                           buf)
             keys = (dks if dks is not None
                     else jnp.zeros((b, 2), jnp.uint32))
             for i, e in enumerate(seed):
@@ -1136,6 +1165,7 @@ class IterBatchingEngine:
         state.pad_j = rep(state.pad_j, 0)
         if state.cache is not None:
             state.cache = grow_cache(state.cache)
+            graftmem.update(state.mem_cache, state.cache)
         if state.tables is not None:
             # ghost lanes read (and scatter) the trash block only
             state.tables = np.concatenate(
@@ -1146,6 +1176,7 @@ class IterBatchingEngine:
             # ghost rows clone row 0's buffer/key lane; their zero
             # budgets keep them inert through every verify (n_emit = 0)
             state.buf = rep(state.buf, 0)
+            graftmem.update(state.mem_buf, state.buf)
             state.keys = rep(state.keys, 0)
         state.slots = state.slots + [None] * pad_rows
         with self._stats_lock:
@@ -1238,6 +1269,7 @@ class IterBatchingEngine:
             state.cache = _admit_cache(
                 state.cache, solo, jnp.asarray(slot, jnp.int32),
                 jnp.asarray(state.depth - sp, jnp.int32))
+            graftmem.update(state.mem_cache, state.cache)
         state.pad_j = state.pad_j.at[slot].set(state.depth - plen_eff)
         state.token = state.token.at[slot].set(first)
         if state.spec_mode:
@@ -1315,6 +1347,10 @@ class IterBatchingEngine:
                 self._release_blocks(state, i)
             raise
         state.cache = None
+        # the pool now owns the KV bytes (its own ledger entry); the
+        # contiguous working view is gone
+        graftmem.release(state.mem_cache)
+        state.mem_cache = 0
 
     def _place_admitted(self, state: _BatchState, slot: int,
                         solo, roll: int,

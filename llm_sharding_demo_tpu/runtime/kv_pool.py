@@ -85,8 +85,8 @@ import numpy as np
 from ..ops import kv_quant as KVQ
 from ..ops import paged_attention as PA
 from ..ops.attention import KVCache
-from ..utils import graftfault, graftsched, graftscope, grafttime, \
-    tracing
+from ..utils import graftfault, graftmem, graftsched, graftscope, \
+    grafttime, tracing
 from ..utils.metrics import DEFAULT_KV_BLOCK_SIZE, REGISTRY, CompileWatch
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
                      _eos_capped_segments, _split_keys, _step_keys,
@@ -122,6 +122,20 @@ PROFILED_SCOPES = ("_gather", "_scatter", "_scatter_row", "_copy",
 # allocator's view is the block economy.)
 TIMELINE_EVENTS = {
     "eviction": "BlockAllocator._evict_lru_locked",
+}
+
+# HBM-ledger contract (tools/graftcheck memory pass + utils/graftmem):
+# the pool's two long-lived device planes, by graftmem component. The
+# block-storage plane holds full-precision blocks OR quantized codes
+# (one buffer either way — ``pool_codes`` names the plane, the
+# ``block_dtype`` stats field names what a block IS); the f32 scales
+# plane exists only for quantized pools. Sizes are CONSTANT across the
+# donated movers (every rebind is shape-identical), so registration at
+# construction is the whole lifecycle — /healthz derives ``pool_bytes``
+# from these entries, never from shape arithmetic.
+MEMORY_LEDGER = {
+    "data": "pool_codes",
+    "scales": "pool_scales",
 }
 
 
@@ -800,6 +814,9 @@ class KVBlockPool:
             0 if self.scales is None
             else self.scales.nbytes // shape[1])
         self._dev_lock = graftsched.rlock("kv_pool.KVBlockPool._dev_lock")
+        graftmem.track(self, "data", "pool_codes", self.data)
+        if self.scales is not None:
+            graftmem.track(self, "scales", "pool_scales", self.scales)
 
         # per-instance defs (not the module-level ops directly): each
         # pool owns its jitted-program caches, so ``_cache_size()`` is

@@ -50,7 +50,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils import graftsched, graftscope, tracing
+from ..utils import graftmem, graftsched, graftscope, tracing
 from ..utils.metrics import REGISTRY
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
                      prepare_generate, select_token)
@@ -95,12 +95,29 @@ POOL_MOVER_SCOPES = ("PrefixCachingEngine._gather_entry",
 HANDOFF_SCOPES = ("PrefixCachingEngine._lookup",
                   "PrefixCachingEngine._insert_pool")
 
+# HBM-ledger contract (tools/graftcheck memory pass + utils/graftmem):
+# the store's deep-copied cache pytrees (non-pool mode) are the
+# module's long-lived device holdings — one handle-keyed ledger entry
+# per stored prefix, registered at insert and released at LRU eviction.
+# Pool-mode entries are block-id tuples (host ints, refs on the pool's
+# own ledgered plane), so nothing registers and nothing double-counts.
+MEMORY_LEDGER = {
+    "_store": "prefix_store",
+}
+
+# Growth-bound contract (tools/graftcheck unbounded-device-growth
+# rule): the store accumulates device arrays but is bounded — at most
+# ``capacity`` entries, LRU ``popitem(last=False)`` eviction at insert.
+MEMORY_BOUNDS = {
+    "_store": "capacity entries; LRU popitem(last=False) at insert",
+}
+
 # Lock-discipline contract (tools/graftcheck locks pass): the store and
 # its hit/miss counters live under ``_store_lock`` only — ``stats()``
 # (the /healthz read) must never wait out an in-flight generation's
 # seconds of device time behind the big lock.
 GUARDED_STATE = {"_store": "_store_lock", "hits": "_store_lock",
-                 "misses": "_store_lock"}
+                 "misses": "_store_lock", "_mem_handles": "_store_lock"}
 
 # The device lock is always the OUTER of the pair (generate/prefill
 # take ``_lock``, then the walk touches the store under
@@ -177,6 +194,9 @@ class PrefixCachingEngine:
         self.capacity = capacity
         self.chunk = chunk
         self._store: "OrderedDict[Tuple[int, ...], object]" = OrderedDict()
+        # store key -> graftmem handle for the entry's device bytes
+        # (non-pool mode; empty under a pool)
+        self._mem_handles: dict = {}
         # Two locks: ``_lock`` serializes device work (the donation-
         # sensitive extend/decode programs run one generation at a time),
         # while ``_store_lock`` guards only the store and counters — so
@@ -296,9 +316,13 @@ class PrefixCachingEngine:
             if key in self._store:
                 self._store.move_to_end(key)
                 return
-            self._store[key] = jax.tree.map(jnp.copy, cache)
+            entry = jax.tree.map(jnp.copy, cache)
+            self._store[key] = entry
+            self._mem_handles[key] = graftmem.track(
+                self, "_store", "prefix_store", entry)
             while len(self._store) > self.capacity:
-                self._store.popitem(last=False)
+                old, _ = self._store.popitem(last=False)
+                graftmem.release(self._mem_handles.pop(old, 0))
 
     def _prefill_walk(self, prompt: np.ndarray, prompt_len: int):
         """Store-aware chunk-aligned prefill of one prompt row: returns

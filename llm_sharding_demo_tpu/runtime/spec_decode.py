@@ -76,7 +76,7 @@ import numpy as np
 
 from ..models.gpt2 import GPT2Config, Params
 from ..ops.attention import KVCache
-from ..utils import graftsched, graftscope, tracing
+from ..utils import graftmem, graftsched, graftscope, tracing
 from ..utils.metrics import REGISTRY, CompileWatch
 from .engine import (DecodeEngine, GenerateResult, SamplingConfig,
                      prepare_generate, sampler_pmf, select_token)
@@ -134,6 +134,15 @@ LOCK_ORDER = ("_stats_lock",)
 # row's cache (the re-sync roll), so paged storage must scatter whole
 # rows back, never just the newly decoded columns.
 SEG_REWRITES_FULL_CACHE = True
+
+# HBM-ledger contract (tools/graftcheck memory pass + utils/graftmem):
+# the verify loop's device token buffer ``[.., max_seq + draft_len + 1]``
+# — live from allocation to the post-loop numpy fetch (solo and batched
+# paths each register their own handle-keyed entry; the iteration
+# scheduler's per-batch spec buffer registers in runtime/iterbatch.py).
+MEMORY_LEDGER = {
+    "buf": "spec_buffers",
+}
 
 
 class SpecDecodeEngine:
@@ -675,12 +684,14 @@ class SpecDecodeEngine:
         t1 = time.perf_counter()
         buf = jnp.zeros((batch, self.max_seq + self.draft_len + 1),
                         jnp.int32)
+        mem_h = graftmem.track(self, "buf", "spec_buffers", buf)
         buf = jax.lax.dynamic_update_slice(buf, ids_j, (0, 0))
         buf, pad_out, total, steps, _ = self._loop_b(
             run_params, first, cache, buf, jnp.int32(prompt_len),
             loop_keys, jnp.asarray(pad, dtype=jnp.int32),
             max_new=max_new_tokens, sampling=sampling)
         buf = np.asarray(jax.block_until_ready(buf))
+        graftmem.release(mem_h)  # device buffer fetched; entry retires
         pad_np = np.asarray(pad_out).astype(np.int32)
         total_i = int(total)
         t2 = time.perf_counter()
@@ -732,12 +743,14 @@ class SpecDecodeEngine:
         pad_j = jnp.asarray(pad) if pad is not None and pad.any() else None
         t1 = time.perf_counter()
         buf = jnp.zeros((self.max_seq + self.draft_len + 1,), jnp.int32)
+        mem_h = graftmem.track(self, "buf", "spec_buffers", buf)
         buf = jax.lax.dynamic_update_slice(
             buf, jnp.asarray(prompt_row, dtype=jnp.int32), (0,))
         buf, steps, _ = self._loop(run_params, first[0], cache, buf,
                                    jnp.int32(prompt_len), loop_key, pad_j,
                                    max_new=max_new_tokens, sampling=sampling)
         buf = np.asarray(jax.block_until_ready(buf))
+        graftmem.release(mem_h)  # device buffer fetched; entry retires
         t2 = time.perf_counter()
 
         steps_i = int(steps)

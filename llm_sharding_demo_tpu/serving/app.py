@@ -42,7 +42,7 @@ from ..models import gpt2
 from ..parallel import partition as P_
 from ..parallel.pipeline import PipelineRunner
 from ..runtime.engine import REF_TEMPERATURE, REF_TOP_K, SamplingConfig
-from ..utils import graftfault, grafttime, tracing
+from ..utils import graftfault, graftmem, grafttime, tracing
 from ..utils.config import ServingConfig, from_env
 from ..utils.metrics import REGISTRY
 from ..utils.tracing import timed
@@ -813,7 +813,26 @@ def create_app(cfg: Optional[ServingConfig] = None,
                     "kv_pool_stats conservation violated: "
                     f"{st['blocks_in_use']} in_use + {st['blocks_free']} "
                     f"free != {st['blocks_total']} total")
+            # HBM bytes of the pool's device planes, from the graftmem
+            # ledger (codes + quantized scales) — NEVER re-derived from
+            # shape arithmetic here, so byte reporting has exactly one
+            # bookkeeping path (the blocks-conservation discipline,
+            # applied to bytes)
+            st["pool_bytes"] = (
+                graftmem.holding_bytes(kv_pool, "data")
+                + graftmem.holding_bytes(kv_pool, "scales"))
             live["kv_pool_stats"] = st
+        # Byte-conservation invariant (the blocks_in_use + blocks_free
+        # == blocks_total pattern, applied to the HBM ledger): the
+        # per-entry table must agree with the running component/grand
+        # totals. Drift means the ledger's accounting broke — 500, not
+        # a silently wrong /debug/memory.
+        mem = graftmem.snapshot()
+        if graftmem.enabled() and not mem["conserved"]:
+            raise AssertionError(
+                "graftmem byte conservation violated: component sum "
+                f"{mem['components']} disagrees with ledger total "
+                f"{mem['total_bytes']}")
         return {
             **live,
             "status": "ok",
@@ -880,6 +899,28 @@ def create_app(cfg: Optional[ServingConfig] = None,
             return 422, {"detail": "n must be an integer"}
         return {"serving": _topology(), **switcher.describe(n=n)}
 
+    @app.get("/debug/memory")
+    def debug_memory():
+        """graftmem HBM ledger view (utils/graftmem): the per-component
+        live-byte table with peaks and per-device attribution, the
+        hottest registered holdings, the conservation verdict, and —
+        when a pool serves — the pool geometry with its ledger-derived
+        ``pool_bytes``. Bytes are live jax buffer nbytes over
+        REGISTERED holdings (the MEMORY_LEDGER contract; the payload's
+        honesty header spells what is and is not counted). Same
+        topology header as /healthz (pinned equal by tests)."""
+        body = {
+            "serving": _topology(),
+            **graftmem.snapshot(),
+        }
+        if kv_pool is not None:
+            st = kv_pool.stats()
+            st["pool_bytes"] = (
+                graftmem.holding_bytes(kv_pool, "data")
+                + graftmem.holding_bytes(kv_pool, "scales"))
+            body["pool"] = st
+        return body
+
     @app.get("/debug")
     def debug_index():
         """The debug-surface index: every /debug/* endpoint with a
@@ -902,6 +943,10 @@ def create_app(cfg: Optional[ServingConfig] = None,
                     "grafttime unified causal event stream, one clock "
                     "over spans/dispatches/faults/plan switches "
                     "(?rid=, ?since=, ?kinds=, ?n=)"),
+                "/debug/memory": (
+                    "graftmem HBM ledger: per-component live bytes, "
+                    "peaks, per-device attribution, pool geometry, "
+                    "byte-conservation verdict"),
             },
         }
 

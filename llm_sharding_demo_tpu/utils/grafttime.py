@@ -115,6 +115,9 @@ EVENT_KINDS = {
     "plan_eval":      "graftwatch evaluated the plan set at a wave "
                       "boundary",
     "plan_switch":    "graftwatch installed a different certified plan",
+    "mem_alloc":      "a graftmem ledger holding grew (byte delta + "
+                      "component total)",
+    "mem_free":       "a graftmem ledger holding shrank or retired",
 }
 
 # kind -> keyword arguments an emit SITE must spell out (values may be
@@ -138,6 +141,8 @@ KIND_FIELDS = {
     "resume":         ("rid",),
     "plan_eval":      ("to_plan",),
     "plan_switch":    ("to_plan",),
+    "mem_alloc":      ("component", "bytes"),
+    "mem_free":       ("component", "bytes"),
 }
 
 # Replay contract: fields that carry wall-clock/interleaving truth and
@@ -145,8 +150,11 @@ KIND_FIELDS = {
 REPLAY_EXEMPT_FIELDS = ("seq", "ts", "tid", "dur_ms", "wait_ms")
 # ...and kinds that OBSERVE the schedule itself (lock events record the
 # interleaving; occupancy values depend on when the sampler ran
-# relative to other threads) — exempt as whole events.
-REPLAY_EXEMPT_KINDS = ("lock_acquire", "lock_contend", "occupancy")
+# relative to other threads; graftmem byte deltas record residency as
+# the allocator threads happened to interleave) — exempt as whole
+# events.
+REPLAY_EXEMPT_KINDS = ("lock_acquire", "lock_contend", "occupancy",
+                       "mem_alloc", "mem_free")
 
 # The declared overhead bound tests/test_grafttime.py pins (the
 # graftscope pattern): a decode run with the bus armed must finish
@@ -523,6 +531,23 @@ def export_chrome(evs: List[dict], meta: Optional[dict] = None) -> dict:
                 "pid": pid, "tid": tid,
                 "args": {"value": float(e.get("value", 0.0))},
             })
+        elif kind in ("mem_alloc", "mem_free"):
+            # graftmem byte series: one Perfetto counter track per
+            # component, plotting the component's running total (the
+            # event's ``total`` field); the signed delta rides a
+            # second counter key so the viewer can overlay causality
+            trace_events.append({
+                "name": f"hbm_bytes:{e.get('component', 'unknown')}",
+                "cat": "graftmem",
+                "ph": "C",
+                "ts": ts_us,
+                "pid": pid, "tid": tid,
+                "args": {"value": float(e.get("total",
+                                              e.get("bytes", 0))),
+                         "delta": (float(e.get("bytes", 0))
+                                   * (1 if kind == "mem_alloc"
+                                      else -1))},
+            })
         else:
             trace_events.append({
                 "name": (f"{kind}:{e['name']}" if "name" in e
@@ -591,7 +616,8 @@ def sample_event(kind: str) -> dict:
     fills = {"rid": "r0", "name": "x", "scope": "mod._fn", "key": "('k',)",
              "value": 1.0, "wait_ms": 0.1, "site": "mod.site",
              "fault": "kindname", "state": "closed", "blocks": 1,
-             "reason": "preempt", "to_plan": "solo", "dur_ms": 0.5}
+             "reason": "preempt", "to_plan": "solo", "dur_ms": 0.5,
+             "component": "params", "bytes": 1}
     for f in KIND_FIELDS.get(kind, ()):
         ev[f] = fills[f]
     if kind in _WINDOW_KINDS:

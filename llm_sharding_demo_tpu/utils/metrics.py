@@ -163,6 +163,14 @@ METRIC_CATALOG: Dict[str, str] = {
     # same timeline as queue depth and pool blocks.
     "plan_switches_total": "counter",
     "auto_plan_active": "gauge",
+    # declared HBM ledger (utils/graftmem.py): live registered device
+    # bytes, labeled component= from the MEMORY_COMPONENTS vocabulary
+    # (params / pool_codes / pool_scales / engine_cache / spec_buffers
+    # / prefix_store, plus the "total" grand sum). The gauge doubles
+    # as a graftscope occupancy series, so residency trajectories sit
+    # beside queue depth and pool blocks; /debug/memory serves the
+    # full per-holding table.
+    "hbm_bytes": "gauge",
 }
 
 # Metric names that USED to exist and were replaced: a call site (or a

@@ -82,6 +82,7 @@ _QUICK_MODULES = {
     "test_graftwatch",      # continuous re-planning: watcher, switcher
     "test_grafttime",       # unified causal timeline: bus, export, pass
     "test_graftnum",        # numerics discipline: contracts + oracle
+    "test_graftmem",        # HBM ledger: attribution, reconcile, pass
 }
 
 
@@ -108,14 +109,15 @@ def _metrics_isolation():
     test's generate calls must not inflate another's counters or
     dispatch rings). ``create_app`` additionally accepts an injected
     registry/recorder for tests that want full isolation."""
-    from llm_sharding_demo_tpu.utils import (graftscope, grafttime,
-                                             metrics, tracing)
+    from llm_sharding_demo_tpu.utils import (graftmem, graftscope,
+                                             grafttime, metrics, tracing)
     state = metrics.REGISTRY.dump_state()
     scope_state = graftscope.dump_state()
     scope_flags = (graftscope.enabled(), graftscope.sync_enabled())
     time_state = grafttime.dump_state()
     time_enabled = grafttime.enabled()
     blackbox_saved = grafttime.blackbox_dumps()
+    mem_state = graftmem.dump_state()
     with tracing.RECORDER._lock:
         saved = list(tracing.RECORDER._traces)
     yield
@@ -125,6 +127,7 @@ def _metrics_isolation():
     graftscope.set_sync(scope_flags[1])
     grafttime.restore_state(time_state)
     grafttime.set_enabled(time_enabled)
+    graftmem.restore_state(mem_state)
     grafttime.clear_blackbox()
     with grafttime._DUMPS_LOCK:
         grafttime._DUMPS.extend(blackbox_saved)

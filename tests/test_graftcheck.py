@@ -222,6 +222,25 @@ def test_repo_passes_graftcheck():
         assert npc.get(rel, 0) >= 1, (
             f"{rel}: no live PRECISION_CONTRACT entry — the numerics "
             "discipline stopped seeing its low-precision paths")
+    assert payload["memory_checks"] >= 10, (
+        "graftmem memory pass went vacuous — a new "
+        "untracked-device-state / ledger-drift / "
+        "unbounded-device-growth finding anywhere in the tree fails "
+        "this strict run (rule fixtures in tests/test_graftmem.py)")
+    assert payload["memory_vacuous"] == [], (
+        "MEMORY_LEDGER declarations with no live graftmem.track site "
+        "(a pool-holding module went unattributed): "
+        f"{payload['memory_vacuous']}")
+    # every module holding long-lived device state declares a LIVE ledger
+    ml = payload["memory_ledgers"]
+    for rel in ("llm_sharding_demo_tpu/runtime/kv_pool.py",
+                "llm_sharding_demo_tpu/runtime/engine.py",
+                "llm_sharding_demo_tpu/runtime/iterbatch.py",
+                "llm_sharding_demo_tpu/runtime/spec_decode.py",
+                "llm_sharding_demo_tpu/runtime/prefix_cache.py"):
+        assert ml.get(rel, 0) >= 1, (
+            f"{rel}: no live MEMORY_LEDGER holding — its device "
+            "allocations stopped registering with the byte ledger")
     assert payload["suppressed"] >= 1, (
         "the documented sync points should be baselined findings — an "
         "empty suppression set means the host-sync rule stopped seeing "

@@ -1,0 +1,641 @@
+"""graftmem: the declared HBM ledger (live attribution + drift watch).
+
+What is pinned here:
+
+1. **ledger mechanics**: track/update/release conservation (the
+   entry-table-vs-running-totals cross-check), idempotent release +
+   owner-GC finalizers, the GRAFTMEM=0 null-handle path, the bounded
+   holdings table, and the vocabulary guard.
+2. **reconcile exactness (ISSUE 17 acceptance)**: on CPU the ledger's
+   ``params`` and pool component bytes EXACTLY equal the live jax
+   buffer nbytes — and the cost model's aval arithmetic — for a solo
+   f32 engine, a pooled-iter composition, and an int8-quantized pool
+   (codes + scales both attributed; the int8 drift below the f32-aval
+   prediction is reported, not hidden).
+3. **lifecycle under stress** (GRAFTSAN=1): bytes conserved across
+   pool preemption/park/resume, prefix-store LRU eviction releases its
+   entry, pool CoW moves NO ledger bytes (the planes are fixed), spec
+   buffers register and retire — with clean sanitizer sweeps.
+4. **serving surfaces**: /debug/memory topology pinned equal to
+   /healthz; ``kv_pool_stats.pool_bytes`` is ledger-derived and equal
+   on both surfaces; a conservation violation 500s /healthz.
+5. **Perfetto counters**: mem_alloc/mem_free ride the grafttime bus
+   and export as schema-valid Chrome counter tracks
+   (``hbm_bytes:{component}``), including through ``python -m
+   tools.grafttime export``.
+6. **the static memory pass**: rule fixtures (untracked device state,
+   ledger drift in all its shapes, unbounded container growth) each
+   produce findings with file:line, plus the repo-clean/non-vacuous
+   pin mirrored by the strict in-suite driver.
+"""
+
+import gc
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_sharding_demo_tpu.models import gpt2
+from llm_sharding_demo_tpu.runtime.engine import DecodeEngine
+from llm_sharding_demo_tpu.runtime.iterbatch import IterBatchingEngine
+from llm_sharding_demo_tpu.runtime.kv_pool import KVBlockPool, PagedKVRunner
+from llm_sharding_demo_tpu.runtime.prefix_cache import PrefixCachingEngine
+from llm_sharding_demo_tpu.utils import graftmem, grafttime
+from tools.graftcheck import costmodel as cm
+from tools.graftcheck import memory as mem_pass
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = gpt2.GPT2Config(vocab_size=211, n_positions=256, n_embd=32,
+                      n_layer=2, n_head=4)
+
+
+def _params():
+    return jax.tree.map(lambda x: x * 8.0,
+                        gpt2.init_params(CFG, jax.random.PRNGKey(0)))
+
+
+class _Holder:
+    """A weakref-able owner for raw ledger tests."""
+
+
+# -- 1. ledger mechanics ------------------------------------------------------
+
+
+def test_track_update_release_conservation():
+    graftmem.clear()
+    h = _Holder()
+    a = jnp.zeros((8, 4), dtype=jnp.float32)         # 128 bytes
+    hd = graftmem.track(h, "a", "params", a)
+    assert hd != 0
+    assert graftmem.holding_bytes(h, "a") == a.nbytes
+    assert graftmem.component_bytes() == {"params": int(a.nbytes)}
+    assert graftmem.total_bytes() == a.nbytes
+    # rebind to a bigger value: update re-measures the SAME entry
+    b = jnp.zeros((16, 4), dtype=jnp.float32)        # 256 bytes
+    graftmem.update(hd, b)
+    assert graftmem.holding_bytes(h, "a") == b.nbytes
+    snap = graftmem.snapshot()
+    assert snap["conserved"] is True
+    assert snap["components"]["params"]["bytes"] == b.nbytes
+    assert snap["peak_bytes"] == b.nbytes
+    # per-device attribution sums to the total (CPU: one device or
+    # the "unsharded" bucket, either way conservation holds)
+    assert sum(snap["devices"].values()) == b.nbytes
+    graftmem.release(hd)
+    graftmem.release(hd)                              # idempotent
+    assert graftmem.total_bytes() == 0
+    assert graftmem.snapshot()["conserved"] is True
+    # peak survives release (a watermark, not a live value)
+    assert graftmem.peak_bytes() == b.nbytes
+
+
+def test_owner_gc_auto_releases():
+    graftmem.clear()
+    h = _Holder()
+    graftmem.track(h, "a", "params", jnp.zeros((4,)))
+    assert graftmem.total_bytes() > 0
+    del h
+    gc.collect()
+    assert graftmem.total_bytes() == 0
+    assert graftmem.snapshot()["conserved"] is True
+
+
+def test_disabled_records_nothing():
+    graftmem.clear()
+    prev = graftmem.set_enabled(False)
+    try:
+        hd = graftmem.track(_Holder(), "a", "params", jnp.zeros((4,)))
+        assert hd == 0
+        graftmem.update(hd, jnp.zeros((8,)))          # no-ops on the
+        graftmem.release(hd)                          # null handle
+        assert graftmem.total_bytes() == 0
+        assert graftmem.snapshot()["enabled"] is False
+    finally:
+        graftmem.set_enabled(prev)
+
+
+def test_track_rejects_unknown_component():
+    with pytest.raises(ValueError, match="outside the graftmem"):
+        graftmem.track(_Holder(), "a", "warp_drive", jnp.zeros((4,)))
+
+
+def test_snapshot_holdings_bounded_and_truncation_marked():
+    graftmem.clear()
+    h = _Holder()
+    for _ in range(graftmem.HOLDINGS_CAPACITY + 6):
+        graftmem.track(h, "a", "params", jnp.zeros((2,)))
+    snap = graftmem.snapshot()
+    assert len(snap["holdings"]) == graftmem.HOLDINGS_CAPACITY
+    assert snap["holdings_truncated"] is True
+    assert snap["entries"] == graftmem.HOLDINGS_CAPACITY + 6
+    assert snap["conserved"] is True
+
+
+# -- 2. reconcile exactness (the acceptance pins) -----------------------------
+
+
+def test_reconcile_solo_engine_params_exact():
+    """Solo f32 engine: the ledger's params bytes EXACTLY equal both
+    the live buffer nbytes and the cost model's aval arithmetic —
+    ratio 1.0, drift 0.0, no tolerance."""
+    graftmem.clear()
+    eng = DecodeEngine(_params(), CFG, max_seq=64)
+    live = sum(int(leaf.nbytes)
+               for leaf in jax.tree_util.tree_leaves(eng.params))
+    assert graftmem.holding_bytes(eng, "params") == live
+    predicted = cm.tree_bytes(cm.param_avals(gpt2, CFG))
+    assert live == predicted
+    rec = graftmem.reconcile({"label": "solo",
+                              "param_bytes_per_device": predicted})
+    p = rec["components"]["params"]
+    assert p["measured_bytes"] == p["predicted_bytes"] == predicted
+    assert p["ratio"] == 1.0 and p["drift"] == 0.0
+    assert rec["max_component_drift"] == 0.0
+    assert rec["plan"] == "solo"
+
+
+def test_reconcile_pooled_iter_exact():
+    """Pooled-iter composition: pool plane bytes equal the allocator's
+    live buffer AND costmodel.kv_pool_bytes (the shared pool_shape
+    math) exactly — and stay constant across a scheduled run."""
+    graftmem.clear()
+    eng = DecodeEngine(_params(), CFG, max_seq=64)
+    pool = KVBlockPool.for_engine(eng, num_blocks=16, block_size=8)
+    measured = graftmem.holding_bytes(pool, "data")
+    assert measured == int(pool.data.nbytes)
+    assert measured == cm.kv_pool_bytes(CFG, 16, 8)
+    pred_params = cm.tree_bytes(cm.param_avals(gpt2, CFG))
+    rec = graftmem.reconcile({
+        "label": "paged",
+        "param_bytes_per_device": pred_params,
+        "kv_bytes_per_device": cm.kv_pool_bytes(CFG, 16, 8),
+    })
+    assert rec["components"]["params"]["drift"] == 0.0
+    assert rec["components"]["kv"]["drift"] == 0.0
+    assert rec["max_component_drift"] == 0.0
+    # a scheduled pooled run rebinds planes through donated movers:
+    # shape-identical, so the ledger entry's bytes never move
+    ib = IterBatchingEngine(eng, max_batch=2, seg_steps=8,
+                            max_wait_ms=10.0, pool=pool)
+    rng = np.random.default_rng(11)
+    ib.generate(rng.integers(0, 211, size=(9,)), 8, timeout=120)
+    assert graftmem.holding_bytes(pool, "data") == measured
+    assert graftmem.snapshot()["conserved"] is True
+
+
+def test_reconcile_int8_pool_codes_and_scales_exact():
+    """Quantized pool: codes AND scales planes both attributed, their
+    sum exactly the live nbytes — and reconcile reports the designed
+    drift BELOW the f32-aval prediction instead of hiding it."""
+    graftmem.clear()
+    eng = DecodeEngine(_params(), CFG, max_seq=64)
+    pool = KVBlockPool.for_engine(eng, num_blocks=16, block_size=8,
+                                  block_dtype="int8")
+    codes = graftmem.holding_bytes(pool, "data")
+    scales = graftmem.holding_bytes(pool, "scales")
+    assert codes == int(pool.data.nbytes)
+    assert scales == int(pool.scales.nbytes) > 0
+    comp = graftmem.component_bytes()
+    assert comp["pool_codes"] == codes
+    assert comp["pool_scales"] == scales
+    pred_f32 = cm.kv_pool_bytes(CFG, 16, 8)
+    rec = graftmem.reconcile({"label": "paged-int8",
+                              "kv_bytes_per_device": pred_f32})
+    kv = rec["components"]["kv"]
+    assert kv["measured_bytes"] == codes + scales
+    assert kv["ratio"] < 1.0 and kv["drift"] > 0.0
+    assert rec["ledger"]["pool_codes"] == codes
+
+
+def test_engine_working_cache_registers_during_generate():
+    """The contiguous working cache is a ledger entry only WHILE a
+    generate is in flight: zero before, zero after, a nonzero
+    engine_cache peak and a matching mem_alloc/mem_free pair on the
+    timeline bus during."""
+    graftmem.clear()
+    prev = grafttime.set_enabled(True)
+    try:
+        eng = DecodeEngine(_params(), CFG, max_seq=64)
+        assert graftmem.component_bytes().get("engine_cache", 0) == 0
+        grafttime.clear()
+        rng = np.random.default_rng(3)
+        eng.generate(rng.integers(0, 211, size=(6,))[None, :], 4)
+        assert graftmem.component_bytes().get("engine_cache", 0) == 0
+        snap = graftmem.snapshot()
+        assert snap["peaks"]["engine_cache"]["bytes"] > 0
+        kinds = [(e["kind"], e["component"]) for e in grafttime.events()
+                 if e["kind"] in ("mem_alloc", "mem_free")]
+        assert ("mem_alloc", "engine_cache") in kinds
+        assert ("mem_free", "engine_cache") in kinds
+    finally:
+        grafttime.set_enabled(prev)
+
+
+# -- 3. lifecycle under stress (GRAFTSAN=1) -----------------------------------
+
+
+def _poll_component_zero(component, deadline_s=10.0):
+    """The scheduler's worker releases batch state in its own thread's
+    ``finally`` — poll briefly instead of racing it."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if graftmem.component_bytes().get(component, 0) == 0:
+            return True
+        time.sleep(0.01)
+    return graftmem.component_bytes().get(component, 0) == 0
+
+
+def test_preempt_park_resume_conserves_bytes(monkeypatch):
+    """Two long rows oversubscribe a deliberately tiny pool (the
+    test_iterbatch preemption geometry): park frees blocks, resume
+    recomputes — and through the whole storm the ledger stays
+    conserved, the pool planes never move, and the transient
+    components drain to zero, under GRAFTSAN=1 with a clean sweep."""
+    import threading
+
+    from llm_sharding_demo_tpu.runtime import kv_pool as kv_pool_mod
+    from llm_sharding_demo_tpu.utils import graftsched
+
+    monkeypatch.setenv("GRAFTSAN", "1")
+    graftmem.clear()
+    eng = DecodeEngine(_params(), CFG, max_seq=200)
+    pool = KVBlockPool.for_engine(eng, num_blocks=25, block_size=8)
+    pool_bytes = graftmem.holding_bytes(pool, "data")
+    assert pool_bytes > 0
+    ib = IterBatchingEngine(eng, max_batch=4, seg_steps=8,
+                            max_wait_ms=300.0, pool=pool)
+    rng = np.random.default_rng(42)
+    pA = rng.integers(0, 211, size=(5,))
+    pB = rng.integers(0, 211, size=(8,))
+    res = [None, None]
+
+    def run(i, p, n):
+        res[i] = ib.generate(p, n, timeout=300)
+
+    threads = [threading.Thread(target=run, args=(0, pA, 96)),
+               threading.Thread(target=run, args=(1, pB, 110))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    st = ib.stats()
+    assert st["preemptions"] >= 1 and st["resumes"] >= 1
+    assert res[0] is not None and res[1] is not None
+    # the pool's fixed planes never moved; transient state drained
+    assert graftmem.holding_bytes(pool, "data") == pool_bytes
+    assert _poll_component_zero("engine_cache")
+    assert _poll_component_zero("spec_buffers")
+    assert graftmem.snapshot()["conserved"] is True
+    kv_pool_mod.graftsan_sweep(timeout=10.0)
+    assert graftsched.findings() == [], \
+        [f.format() for f in graftsched.findings()]
+
+
+def test_prefix_store_lru_eviction_releases_bytes():
+    """Non-pool prefix store: each inserted entry is a ledger entry;
+    LRU eviction at capacity releases the evicted one's bytes."""
+    graftmem.clear()
+    eng = DecodeEngine(_params(), CFG, max_seq=64)
+    pref = PrefixCachingEngine(eng, capacity=1, chunk=16)
+    rng = np.random.default_rng(7)
+    p1 = rng.integers(0, 211, size=(20,)).astype(np.int32)
+    pref.generate(p1[None, :], 4)
+    one_entry = graftmem.component_bytes().get("prefix_store", 0)
+    assert one_entry > 0
+    snap = graftmem.snapshot()
+    assert any(h["component"] == "prefix_store"
+               for h in snap["holdings"])
+    # a second distinct prefix evicts the first (capacity 1): bytes
+    # stay at exactly one entry's worth, not two
+    p2 = rng.integers(0, 211, size=(20,)).astype(np.int32)
+    pref.generate(p2[None, :], 4)
+    assert graftmem.component_bytes()["prefix_store"] == one_entry
+    snap = graftmem.snapshot()
+    assert pref.stats()["entries"] == 1
+    assert snap["components"]["prefix_store"]["entries"] == 1
+    assert snap["conserved"] is True
+
+
+def test_pool_cow_moves_no_ledger_bytes():
+    """Copy-on-write inside the pool rearranges blocks WITHIN the
+    fixed planes — the ledger must not move (and pool-mode prefix
+    entries hold host block ids, so prefix_store stays 0: the
+    no-double-count claim)."""
+    graftmem.clear()
+    eng = DecodeEngine(_params(), CFG, max_seq=64)
+    pool = KVBlockPool.for_engine(eng, num_blocks=40, block_size=8)
+    before = graftmem.component_bytes()
+    pref = PrefixCachingEngine(eng, capacity=4, chunk=20, pool=pool)
+    runner = PagedKVRunner(eng, pool, prefix=pref)
+    rng = np.random.default_rng(6)
+    long = rng.integers(0, 211, size=(30,)).astype(np.int32)
+    runner.generate(long[None, :], 12)       # miss + insert
+    runner.generate(long[None, :], 12)       # hit: CoW at the frontier
+    assert pool.allocator.stats().cow_copies >= 1
+    assert graftmem.component_bytes() == before
+    assert graftmem.component_bytes().get("prefix_store", 0) == 0
+    assert graftmem.snapshot()["conserved"] is True
+
+
+def test_spec_buffers_register_and_retire():
+    from llm_sharding_demo_tpu.runtime.spec_decode import SpecDecodeEngine
+    graftmem.clear()
+    prev = grafttime.set_enabled(True)
+    try:
+        cfg = gpt2.GPT2Config(vocab_size=97, n_positions=128, n_embd=32,
+                              n_layer=2, n_head=4)
+        spec = SpecDecodeEngine(gpt2.init_params(cfg, jax.random.PRNGKey(0)),
+                                cfg, max_seq=128, draft_len=4)
+        grafttime.clear()
+        prompt = np.arange(10, dtype=np.int32) % cfg.vocab_size
+        spec.generate(prompt, max_new_tokens=8)
+        assert graftmem.component_bytes().get("spec_buffers", 0) == 0
+        assert graftmem.snapshot()["peaks"]["spec_buffers"]["bytes"] > 0
+        kinds = [(e["kind"], e["component"]) for e in grafttime.events()
+                 if e["kind"] in ("mem_alloc", "mem_free")]
+        assert ("mem_alloc", "spec_buffers") in kinds
+        assert ("mem_free", "spec_buffers") in kinds
+    finally:
+        grafttime.set_enabled(prev)
+
+
+# -- 4. serving surfaces ------------------------------------------------------
+
+
+@pytest.fixture()
+def single():
+    from llm_sharding_demo_tpu.fleet.harness import build_single
+    client, _rec, _reg = build_single(max_seq=128, max_batch=2,
+                                      kv_pool_blocks=32)
+    return client
+
+
+def test_debug_memory_matches_healthz_topology_and_pool_bytes(single):
+    r = single.post("/generate", json={"prompt": "Hello bytes",
+                                       "max_new_tokens": 3,
+                                       "mode": "greedy"})
+    assert r.status_code == 200
+    hz = single.get("/healthz").json()
+    mem = single.get("/debug/memory").json()
+    # the serving block IS the /healthz topology block (the /debug
+    # index discipline), full dict not a hand-copied subset
+    for k, v in mem["serving"].items():
+        assert hz[k] == v, k
+    assert {"role", "model", "batch_mode", "max_batch",
+            "kv_pool_blocks"} <= set(mem["serving"])
+    # pool_bytes is ledger-derived and IDENTICAL on both surfaces —
+    # one bookkeeping path, never re-derived shape arithmetic
+    assert hz["kv_pool_stats"]["pool_bytes"] > 0
+    assert mem["pool"]["pool_bytes"] == hz["kv_pool_stats"]["pool_bytes"]
+    assert mem["conserved"] is True
+    assert mem["components"]["params"]["bytes"] > 0
+    # THIS app's pool plane is one ledgered holding with exactly the
+    # bytes both surfaces report. The process-wide component total is
+    # >= (other module-scoped test apps may still be alive in-process
+    # and the ledger honestly counts their planes too), never ==.
+    assert mem["pool"]["pool_bytes"] in [
+        h["bytes"] for h in mem["holdings"]
+        if h["component"] == "pool_codes" and h["holding"] == "data"]
+    assert mem["components"]["pool_codes"]["bytes"] \
+        >= mem["pool"]["pool_bytes"]
+    assert "truth" in mem and "REGISTERED" in mem["truth"]
+    # the index lists the surface and it serves
+    idx = single.get("/debug").json()
+    assert "/debug/memory" in idx["surfaces"]
+
+
+def test_healthz_500_on_conservation_violation(single):
+    if not graftmem.enabled():
+        pytest.skip("GRAFTMEM=0: the conservation gate is off")
+    assert single.get("/healthz").status_code == 200
+    # corrupt ONE bookkeeping path: the running grand total drifts off
+    # the entry table -> /healthz must refuse to report capacity
+    graftmem.STATE._total += 7
+    try:
+        r = single.get("/healthz")
+        assert r.status_code == 500
+        assert "conservation" in r.json()["detail"]
+    finally:
+        graftmem.STATE._total -= 7
+    assert single.get("/healthz").status_code == 200
+
+
+# -- 5. Perfetto counter tracks -----------------------------------------------
+
+
+def test_mem_events_export_as_counter_tracks():
+    graftmem.clear()
+    prev = grafttime.set_enabled(True)
+    try:
+        grafttime.clear()
+        h = _Holder()
+        hd = graftmem.track(h, "a", "params", jnp.zeros((8,)))
+        graftmem.update(hd, jnp.zeros((16,)))
+        graftmem.release(hd)
+        evs = grafttime.events()
+        mems = [e for e in evs if e["kind"] in ("mem_alloc", "mem_free")]
+        assert len(mems) == 3                  # alloc, grow, free
+        for e in mems:
+            assert e["component"] == "params" and e["bytes"] > 0
+        payload = grafttime.export_chrome(evs)
+        assert grafttime.validate_chrome(payload) == []
+        counters = [te for te in payload["traceEvents"]
+                    if te["ph"] == "C"
+                    and te["name"] == "hbm_bytes:params"]
+        assert len(counters) == 3
+        # the counter carries the running component total; the free's
+        # delta is negative (Perfetto draws the drop)
+        assert [c["args"]["value"] for c in counters] == [32.0, 64.0, 0.0]
+        assert counters[-1]["args"]["delta"] < 0
+        json.loads(json.dumps(payload))
+    finally:
+        grafttime.set_enabled(prev)
+
+
+def test_mem_sample_events_round_trip_export_cli(tmp_path):
+    from tools import grafttime as cli
+    src = tmp_path / "stream.json"
+    out = tmp_path / "trace.json"
+    src.write_text(json.dumps(
+        {"events": [grafttime.sample_event("mem_alloc"),
+                    grafttime.sample_event("mem_free")]}))
+    assert cli.main(["export", "--input", str(src),
+                     "--output", str(out)]) == 0
+    trace = json.loads(out.read_text())
+    assert grafttime.validate_chrome(trace) == []
+    names = {te["name"] for te in trace["traceEvents"]
+             if te["ph"] == "C"}
+    assert any(n.startswith("hbm_bytes:") for n in names)
+
+
+# -- 6. the static memory pass ------------------------------------------------
+
+COMPONENTS = {"params": "x", "pool_codes": "x"}
+
+
+def _run_fixture(tmp_path, source,
+                 relpath="llm_sharding_demo_tpu/runtime/fixture_mod.py"):
+    p = tmp_path / relpath
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(source)
+    return mem_pass.run_memory(str(tmp_path), paths=[str(p)],
+                               components=COMPONENTS)
+
+
+def test_fixture_untracked_device_state(tmp_path):
+    findings, _ = _run_fixture(tmp_path, """\
+import jax.numpy as jnp
+
+class Pool:
+    def __init__(self):
+        self.data = jnp.zeros((4, 4))
+""")
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "untracked-device-state"
+    assert "self.data" in f.message and f.line == 5
+    assert f.scope == "__init__"
+
+
+def test_fixture_declared_and_tracked_is_clean(tmp_path):
+    findings, summary = _run_fixture(tmp_path, """\
+import jax.numpy as jnp
+from llm_sharding_demo_tpu.utils import graftmem
+
+MEMORY_LEDGER = {"data": "pool_codes"}
+
+class Pool:
+    def __init__(self):
+        self.data = jnp.zeros((4, 4))
+        graftmem.track(self, "data", "pool_codes", self.data)
+""")
+    assert findings == [], [f.format() for f in findings]
+    rel = "llm_sharding_demo_tpu/runtime/fixture_mod.py"
+    assert summary["memory_ledgers"][rel] == 1
+    assert summary["vacuous"] == []
+
+
+def test_fixture_ledger_drift_shapes(tmp_path):
+    """Every ledger-drift shape in one module: off-vocabulary
+    declaration, stale declaration, undeclared track, disagreeing
+    attribution, computed (non-literal) attribution."""
+    findings, summary = _run_fixture(tmp_path, """\
+import jax.numpy as jnp
+from llm_sharding_demo_tpu.utils import graftmem
+
+MEMORY_LEDGER = {"warp": "warp_core", "stale": "params",
+                 "data": "params"}
+
+class Pool:
+    def __init__(self, name):
+        graftmem.track(self, "ghost", "params", 1)       # undeclared
+        graftmem.track(self, "data", "pool_codes", 1)    # disagrees
+        graftmem.track(self, name, "params", 1)          # computed
+""")
+    rules = {f.rule for f in findings}
+    assert rules == {"ledger-drift"}
+    msgs = "\n".join(f.message for f in findings)
+    assert "outside the" in msgs                 # warp_core off-vocab
+    assert "no graftmem.track site" in msgs      # warp + stale
+    assert "not declared in this module's MEMORY_LEDGER" in msgs
+    assert "drifted" in msgs                     # data: params vs codes
+    assert "must be string literals" in msgs
+    assert len(findings) == 6
+    # only "data" of the three declared holdings has a track site
+    rel = "llm_sharding_demo_tpu/runtime/fixture_mod.py"
+    assert summary["memory_ledgers"][rel] == 1
+    assert summary["vacuous"] == []
+
+
+def test_fixture_stale_declaration_is_vacuous(tmp_path):
+    findings, summary = _run_fixture(tmp_path, """\
+from llm_sharding_demo_tpu.utils import graftmem
+
+MEMORY_LEDGER = {"data": "params"}
+""")
+    assert [f.rule for f in findings] == ["ledger-drift"]
+    assert "no graftmem.track site" in findings[0].message
+    rel = "llm_sharding_demo_tpu/runtime/fixture_mod.py"
+    assert summary["vacuous"] == [rel]
+    assert summary["memory_ledgers"][rel] == 0
+
+
+def test_fixture_malformed_declaration(tmp_path):
+    findings, _ = _run_fixture(tmp_path, """\
+from llm_sharding_demo_tpu.utils import graftmem
+
+KEYS = ("data",)
+MEMORY_LEDGER = {k: "params" for k in KEYS}
+
+def f(self):
+    graftmem.track(self, "data", "params", 1)
+""")
+    assert any("must be a dict literal" in f.message for f in findings)
+
+
+def test_fixture_unbounded_container_growth(tmp_path):
+    src = """\
+import jax
+import jax.numpy as jnp
+
+{bounds}
+class Store:
+    def put(self, key, cache):
+        self._store[key] = jax.tree.map(jnp.copy, cache)
+"""
+    findings, _ = _run_fixture(tmp_path, src.format(bounds=""))
+    assert len(findings) == 1
+    assert findings[0].rule == "unbounded-device-growth"
+    assert "self._store" in findings[0].message
+    assert findings[0].scope == "put"
+    # a declared bound silences it
+    findings, _ = _run_fixture(tmp_path, src.format(
+        bounds='MEMORY_BOUNDS = {"_store": "capacity entries, LRU"}\n'))
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_repo_memory_pass_clean_and_nonvacuous():
+    """The real tree: zero findings, every declared ledger live, the
+    pool-holding runtime modules all declared (mirrors the strict
+    in-suite driver's floors in test_graftcheck.py)."""
+    findings, summary = mem_pass.run_memory(REPO)
+    assert findings == [], [f.format() for f in findings]
+    assert summary["vacuous"] == []
+    assert summary["memory_checks"] >= 10
+    ledgers = summary["memory_ledgers"]
+    for rel, floor in (
+            ("llm_sharding_demo_tpu/runtime/kv_pool.py", 2),
+            ("llm_sharding_demo_tpu/runtime/engine.py", 2),
+            ("llm_sharding_demo_tpu/runtime/iterbatch.py", 2),
+            ("llm_sharding_demo_tpu/runtime/spec_decode.py", 1),
+            ("llm_sharding_demo_tpu/runtime/prefix_cache.py", 1)):
+        assert ledgers.get(rel, 0) >= floor, (rel, ledgers)
+
+
+# -- 7. metrics + vocabulary sync ---------------------------------------------
+
+
+def test_gauge_and_catalog_registration():
+    from llm_sharding_demo_tpu.utils.metrics import METRIC_CATALOG, REGISTRY
+    assert METRIC_CATALOG["hbm_bytes"] == "gauge"
+    graftmem.clear()
+    h = _Holder()
+    graftmem.track(h, "a", "params", jnp.zeros((8,)))
+    snap = REGISTRY.snapshot()
+    assert snap["hbm_bytes{component=params}"] == 32.0
+    assert snap["hbm_bytes{component=total}"] == 32.0
+
+
+def test_mem_kinds_in_timeline_vocabulary():
+    for kind in ("mem_alloc", "mem_free"):
+        assert kind in grafttime.EVENT_KINDS
+        assert set(grafttime.KIND_FIELDS[kind]) == {"component", "bytes"}
+        # residency deltas observe scheduling, they don't define it:
+        # replay projections must not require byte-identical allocation
+        # interleavings
+        assert kind in grafttime.REPLAY_EXEMPT_KINDS

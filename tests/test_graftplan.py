@@ -505,6 +505,8 @@ def test_verifier_json_schema_shape():
                             "timeline_vacuous",
                             "numerics_checks", "numerics_contracts",
                             "numerics_vacuous",
+                            "memory_checks", "memory_ledgers",
+                            "memory_vacuous",
                             "recompile_bounds"}
     assert isinstance(payload["ok"], bool)
     assert isinstance(payload["sanitize_checks"], int)
@@ -532,6 +534,9 @@ def test_verifier_json_schema_shape():
     assert isinstance(payload["numerics_vacuous"], list)
     assert isinstance(payload["timeline_kinds"], dict)
     assert isinstance(payload["timeline_vacuous"], list)
+    assert isinstance(payload["memory_checks"], int)
+    assert isinstance(payload["memory_ledgers"], dict)
+    assert isinstance(payload["memory_vacuous"], list)
     assert isinstance(payload["strict"], bool)
     assert isinstance(payload["findings"], list)
     assert isinstance(payload["suppressed"], int)

@@ -245,8 +245,8 @@ def test_debug_index_pinned_to_healthz_topology(demo):
     assert idx.status_code == 200
     body = idx.json()
     assert sorted(body["surfaces"]) == [
-        "/debug/plan", "/debug/profile", "/debug/requests",
-        "/debug/timeline"]
+        "/debug/memory", "/debug/plan", "/debug/profile",
+        "/debug/requests", "/debug/timeline"]
     for surface, desc in body["surfaces"].items():
         assert isinstance(desc, str) and desc
         assert client.get(surface).status_code == 200, surface

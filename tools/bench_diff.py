@@ -98,7 +98,14 @@ _LOWER_BETTER = ("_ms", "latency", "step_ms", "prefill_ms",
                  # upward drift means the quantizer/bf16 discipline
                  # lost precision (also caught by the "_ms" suffix,
                  # but the explicit name documents the intent)
-                 "logit_mse")
+                 "logit_mse",
+                 # hbm_attribution row (graftmem): |measured/modeled - 1|
+                 # byte drift between the live ledger and the cost
+                 # model's aval arithmetic — f32 configs pin at exactly
+                 # 0.0 and the int8 pool's designed savings is constant
+                 # for fixed geometry, so ANY upward movement means the
+                 # ledger lost an allocation or the model lost a term
+                 "drift")
 # environment properties, not code performance: the tunnel's RTT, the
 # reference CPU's own rate, and the attribution run's host-dependent
 # byte rates vary by machine/route — comparing them across rounds would

@@ -57,8 +57,8 @@ def run(root: str = None, lint_only: bool = False,
     if added:
         sys.path.insert(0, root)
     try:
-        from . import faults, fleet, lint, locks, numerics, sanitize, \
-            scope, slo, timeline, watch
+        from . import faults, fleet, lint, locks, memory, numerics, \
+            sanitize, scope, slo, timeline, watch
         findings = list(lint.run_lint(root))
         san, sanitize_checks = sanitize.run_sanitize(root)
         findings.extend(san)
@@ -76,6 +76,8 @@ def run(root: str = None, lint_only: bool = False,
         findings.extend(wt)
         tl, timeline_summary = timeline.run_timeline(root)
         findings.extend(tl)
+        mm, memory_summary = memory.run_memory(root)
+        findings.extend(mm)
         # the numerics pass's jaxpr half traces real entry points —
         # skip it under --lint-only (the AST half still runs jax-free)
         nm, numerics_summary = numerics.run_numerics(root,
@@ -135,6 +137,9 @@ def run(root: str = None, lint_only: bool = False,
         # and on a VACUOUS numerics contract (a PRECISION_CONTRACT
         # whose entries resolve to zero live functions — the precision
         # discipline stopped seeing that module's low-precision paths)
+        # and on a VACUOUS memory contract (a MEMORY_LEDGER none of
+        # whose holdings are registered — the HBM ledger went dark for
+        # that module's residency)
         "ok": (not active and not (strict and stale)
                and not (strict and locks_summary["vacuous"])
                and not (strict and scope_summary["vacuous"])
@@ -143,7 +148,8 @@ def run(root: str = None, lint_only: bool = False,
                and not (strict and fleet_summary["vacuous"])
                and not (strict and watch_summary["vacuous"])
                and not (strict and timeline_summary["vacuous"])
-               and not (strict and numerics_summary["vacuous"])),
+               and not (strict and numerics_summary["vacuous"])
+               and not (strict and memory_summary["vacuous"])),
         "strict": strict,
         "findings": [f.to_dict() for f in active],
         "suppressed": len(suppressed),
@@ -172,6 +178,9 @@ def run(root: str = None, lint_only: bool = False,
         "timeline_checks": timeline_summary["timeline_checks"],
         "timeline_kinds": timeline_summary["timeline_kinds"],
         "timeline_vacuous": timeline_summary["vacuous"],
+        "memory_checks": memory_summary["memory_checks"],
+        "memory_ledgers": memory_summary["memory_ledgers"],
+        "memory_vacuous": memory_summary["vacuous"],
         "numerics_checks": numerics_summary["numerics_checks"],
         "numerics_contracts": numerics_summary["numerics_contracts"],
         "numerics_vacuous": numerics_summary["vacuous"],
@@ -392,6 +401,7 @@ def main(argv=None) -> int:
               f"{payload['fleet_checks']} fleet checks, "
               f"{payload['watch_checks']} watch checks, "
               f"{payload['timeline_checks']} timeline checks, "
+              f"{payload['memory_checks']} memory checks, "
               f"{payload['numerics_checks']} numerics checks"
               + ("" if args.lint_only else
                  f", recompile bounds for {len(payload['recompile_bounds'])}"

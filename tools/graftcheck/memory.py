@@ -1,0 +1,401 @@
+"""graftcheck memory pass: declared-HBM-ledger static analysis
+(compile-free).
+
+The graftmem ledger (``llm_sharding_demo_tpu/utils/graftmem.py``) only
+earns the name "byte attribution" if every long-lived device holding
+actually registers and nothing accumulates device arrays off-ledger —
+a ledger with silent gaps is worse than none, because /debug/memory
+LOOKS complete. This pass (the static half of graftmem, riding
+``python -m tools.graftcheck`` and the strict in-suite driver — the
+timeline pass's declaration/emission-scan split, applied to bytes)
+holds the declarations to that bar:
+
+In-file declarations (the registration-annotation idiom of
+``TIMELINE_EVENTS`` / ``FAULT_POLICY`` / ``SLO_POLICY``):
+
+- ``MEMORY_LEDGER``: ``{holding: component}`` — which long-lived device
+  holdings this module owns and which graftmem component each
+  attributes to (components are the fixed
+  ``graftmem.MEMORY_COMPONENTS`` vocabulary, injectable here for
+  fixtures).
+- ``MEMORY_BOUNDS`` (optional): ``{container: bound}`` — containers
+  that accumulate device arrays, with reviewable prose naming the
+  bound (capacity + eviction policy). An undeclared accumulation site
+  is the leak shape this pass exists to catch.
+
+Rules (ids in brackets; suppressions ride the shared baseline):
+
+- [untracked-device-state]    a persistent device-array attribute
+                              (``self.X = jnp.zeros(...)`` /
+                              ``jax.device_put`` / tree-map placement)
+                              in a runtime/ module whose name is not in
+                              MEMORY_LEDGER — the mirror of
+                              undeclared-jit: residency landed off the
+                              declared contract.
+- [ledger-drift]              a malformed declaration (non-literal
+                              dict, non-string entries); a declared
+                              component outside the fixed vocabulary; a
+                              declared holding with no
+                              ``graftmem.track(owner, "<holding>", ...)``
+                              site (stale — the module stopped
+                              registering and the ledger silently lost
+                              a component); a track site whose holding/
+                              component is not a string literal, is
+                              undeclared, or disagrees with the
+                              declaration.
+- [unbounded-device-growth]   a container accumulation site
+                              (``self.X[k] = ...`` / ``self.X.append``)
+                              in a runtime/ module whose stored value
+                              builds device arrays (contains a jnp/jax
+                              call) with no MEMORY_BOUNDS entry for
+                              ``X`` — device bytes growing without a
+                              declared bound.
+
+``--strict`` additionally fails a VACUOUS pass (a module declaring
+MEMORY_LEDGER none of whose holdings are tracked — the ledger went
+dark); ``cli.run --json`` carries ``memory_checks`` /
+``memory_ledgers`` / ``memory_vacuous``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from . import lint as L
+from .core import Finding
+from .locks import _module_assign
+
+MEMORY_RULE_IDS = ("untracked-device-state", "ledger-drift",
+                   "unbounded-device-growth")
+
+# the attribute-assignment rule (and the container rule) apply to the
+# modules that own serving-path device residency; ops/ kernels build
+# transient values inside jit and utils/ holds no model state
+_RUNTIME_PREFIX = "llm_sharding_demo_tpu/runtime/"
+
+# the ledger itself is the apparatus, not a registrant (the
+# grafttime/graftsched exemption precedent)
+_EXEMPT_RELPATHS = ("llm_sharding_demo_tpu/utils/graftmem.py",)
+
+# dotted call roots that MINT persistent device residency when assigned
+# to an attribute: array constructors and explicit placement/deep-copy.
+# jax.jit / movers / plain helper calls are not allocators.
+_ALLOCATOR_CALLS = {
+    ("jnp", "zeros"), ("jnp", "ones"), ("jnp", "full"),
+    ("jnp", "empty"), ("jnp", "zeros_like"), ("jnp", "ones_like"),
+    ("jnp", "full_like"), ("jnp", "asarray"), ("jnp", "array"),
+    ("jax", "device_put"),
+    ("jax", "tree", "map"), ("jax", "tree_util", "tree_map"),
+}
+
+
+def _dotted(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """``jax.tree.map`` -> ("jax", "tree", "map"); None when the func
+    is not a plain dotted name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _contains_allocator(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            d = _dotted(sub.func)
+            if d in _ALLOCATOR_CALLS:
+                return True
+    return False
+
+
+def _contains_device_call(node: ast.AST) -> bool:
+    """Any jnp./jax.-rooted call — the container rule's broader net
+    (``jax.tree.map(jnp.copy, cache)`` deep-copies device buffers into
+    the store without being a constructor)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            d = _dotted(sub.func)
+            if d and d[0] in ("jnp", "jax"):
+                return True
+    return False
+
+
+class _TrackSite:
+    __slots__ = ("line", "scope", "holding", "component", "literal")
+
+    def __init__(self, line, scope, holding, component, literal):
+        self.line = line
+        self.scope = scope
+        self.holding = holding      # str or None (non-literal)
+        self.component = component  # str or None (non-literal)
+        self.literal = literal
+
+
+class _MemScanner(ast.NodeVisitor):
+    """Collect, with enclosing scope: ``graftmem.track/update/release``
+    call sites, persistent allocator attribute assignments, and
+    container accumulation sites."""
+
+    def __init__(self):
+        self.tracks: List[_TrackSite] = []
+        self.calls = 0  # update/release sites (checked as live usage)
+        # attr name -> (line, scope) for self.X = <allocator expr>
+        self.attr_allocs: List[Tuple[str, int, str]] = []
+        # container name -> (line, scope) for device-array accumulation
+        self.container_stores: List[Tuple[str, int, str]] = []
+        self._scope = ["<module>"]
+
+    def _visit_func(self, node):
+        self._scope.append(node.name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_ClassDef(self, node):
+        self._scope.append(node.name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    def _self_attr(self, node) -> Optional[str]:
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        return None
+
+    def visit_Assign(self, node):
+        for tgt in node.targets:
+            attr = self._self_attr(tgt)
+            if attr is not None and _contains_allocator(node.value):
+                self.attr_allocs.append((attr, node.lineno,
+                                         self._scope[-1]))
+            # self.X[k] = <device expr>
+            if isinstance(tgt, ast.Subscript):
+                attr = self._self_attr(tgt.value)
+                if attr is not None \
+                        and _contains_device_call(node.value):
+                    self.container_stores.append((attr, node.lineno,
+                                                  self._scope[-1]))
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        f = node.func
+        d = _dotted(f)
+        if d and d[0] == "graftmem" and len(d) == 2:
+            if d[1] == "track":
+                holding = component = None
+                literal = True
+                for i, name in ((1, "holding"), (2, "component")):
+                    val = None
+                    if len(node.args) > i and isinstance(
+                            node.args[i], ast.Constant) \
+                            and isinstance(node.args[i].value, str):
+                        val = node.args[i].value
+                    else:
+                        for kw in node.keywords:
+                            if kw.arg == name and isinstance(
+                                    kw.value, ast.Constant) \
+                                    and isinstance(kw.value.value, str):
+                                val = kw.value.value
+                    if val is None:
+                        literal = False
+                    elif name == "holding":
+                        holding = val
+                    else:
+                        component = val
+                self.tracks.append(_TrackSite(node.lineno,
+                                              self._scope[-1], holding,
+                                              component, literal))
+            elif d[1] in ("update", "release", "holding_bytes"):
+                self.calls += 1
+        # self.X.append(<device expr>)
+        if isinstance(f, ast.Attribute) and f.attr == "append":
+            attr = self._self_attr(f.value)
+            if attr is not None and node.args \
+                    and _contains_device_call(node.args[0]):
+                self.container_stores.append((attr, node.lineno,
+                                              self._scope[-1]))
+        self.generic_visit(node)
+
+
+def _declared_dict(stmt: ast.Assign
+                   ) -> Optional[List[Tuple[str, str, int]]]:
+    """MEMORY_LEDGER / MEMORY_BOUNDS dict literal ->
+    [(key, value, line)]; None when not a statically readable
+    string->string dict."""
+    node = stmt.value
+    if not isinstance(node, ast.Dict):
+        return None
+    out = []
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                and isinstance(v, ast.Constant)
+                and isinstance(v.value, str)):
+            return None
+        out.append((k.value, v.value, k.lineno))
+    return out
+
+
+def run_memory(root: str, paths: Optional[List[str]] = None,
+               components: Optional[Dict[str, str]] = None,
+               ) -> Tuple[List[Finding], dict]:
+    """The whole static pass -> (findings, summary). ``summary``
+    carries ``memory_checks`` (declarations + track sites + allocator/
+    container sites examined — the vacuity guard on the pass itself),
+    ``memory_ledgers`` (per-module count of declared holdings with a
+    live track site) and ``vacuous`` (modules whose MEMORY_LEDGER
+    matches no registration — the strict driver fails these).
+    ``components`` is injectable for rule fixtures; by default the real
+    ``graftmem.MEMORY_COMPONENTS``."""
+    if components is None:
+        from llm_sharding_demo_tpu.utils import graftmem as GM
+        components = GM.MEMORY_COMPONENTS
+
+    findings: List[Finding] = []
+    checks = 0
+    ledgers_live: Dict[str, int] = {}
+    vacuous: List[str] = []
+
+    for path in (paths if paths is not None else L.iter_sources(root)):
+        mod = L.index_module(path, root)
+        if mod is None:
+            continue
+        if mod.relpath in _EXEMPT_RELPATHS:
+            continue
+        in_runtime = mod.relpath.startswith(_RUNTIME_PREFIX)
+        decl_stmt = _module_assign(mod, "MEMORY_LEDGER")
+        bounds_stmt = _module_assign(mod, "MEMORY_BOUNDS")
+        scanner = _MemScanner()
+        scanner.visit(mod.tree)
+        relevant = (decl_stmt is not None or bounds_stmt is not None
+                    or scanner.tracks or scanner.calls
+                    or (in_runtime and (scanner.attr_allocs
+                                        or scanner.container_stores)))
+        if not relevant:
+            continue
+        checks += 1
+
+        declared: Dict[str, str] = {}
+        declared_lines: Dict[str, int] = {}
+        if decl_stmt is not None:
+            entries = _declared_dict(decl_stmt)
+            if entries is None:
+                findings.append(Finding(
+                    "ledger-drift", mod.relpath, decl_stmt.lineno,
+                    "<module>",
+                    "MEMORY_LEDGER must be a dict literal of string "
+                    "holding -> string component (the memory pass "
+                    "reads it statically)"))
+            else:
+                for holding, component, line in entries:
+                    declared[holding] = component
+                    declared_lines[holding] = line
+                    checks += 1
+                    if component not in components:
+                        findings.append(Finding(
+                            "ledger-drift", mod.relpath, line,
+                            "<module>",
+                            f"MEMORY_LEDGER maps {holding!r} to "
+                            f"component {component!r}, outside the "
+                            f"graftmem vocabulary "
+                            f"({sorted(components)}) — a new residency "
+                            "class is a reviewed "
+                            "graftmem.MEMORY_COMPONENTS change"))
+
+        bounds: Dict[str, str] = {}
+        if bounds_stmt is not None:
+            entries = _declared_dict(bounds_stmt)
+            if entries is None:
+                findings.append(Finding(
+                    "unbounded-device-growth", mod.relpath,
+                    bounds_stmt.lineno, "<module>",
+                    "MEMORY_BOUNDS must be a dict literal of string "
+                    "container -> string bound prose"))
+            else:
+                bounds = {k: v for k, v, _ in entries}
+
+        # -- registration sites vs the declaration ------------------------
+        tracked_holdings = set()
+        for s in scanner.tracks:
+            checks += 1
+            if not s.literal:
+                findings.append(Finding(
+                    "ledger-drift", mod.relpath, s.line, s.scope,
+                    "graftmem.track holding/component must be string "
+                    "literals (a computed attribution is unreviewable "
+                    "and unjoinable against MEMORY_LEDGER)"))
+                continue
+            tracked_holdings.add(s.holding)
+            if s.component not in components:
+                findings.append(Finding(
+                    "ledger-drift", mod.relpath, s.line, s.scope,
+                    f"graftmem.track component {s.component!r} is "
+                    f"outside the vocabulary ({sorted(components)})"))
+            if s.holding not in declared:
+                findings.append(Finding(
+                    "ledger-drift", mod.relpath, s.line, s.scope,
+                    f"graftmem.track registers holding {s.holding!r} "
+                    "not declared in this module's MEMORY_LEDGER"))
+            elif declared[s.holding] != s.component:
+                findings.append(Finding(
+                    "ledger-drift", mod.relpath, s.line, s.scope,
+                    f"graftmem.track attributes {s.holding!r} to "
+                    f"{s.component!r} but MEMORY_LEDGER declares "
+                    f"{declared[s.holding]!r} — the declaration and "
+                    "the registration drifted"))
+        checks += scanner.calls
+
+        live = 0
+        for holding, component in declared.items():
+            if holding in tracked_holdings:
+                live += 1
+            else:
+                findings.append(Finding(
+                    "ledger-drift", mod.relpath,
+                    declared_lines[holding], "<module>",
+                    f"MEMORY_LEDGER declares {holding!r} but no "
+                    "graftmem.track site in this module registers it — "
+                    "the ledger silently lost a declared holding "
+                    "(stale declaration?)"))
+        if declared:
+            ledgers_live[mod.relpath] = live
+            if live == 0:
+                vacuous.append(mod.relpath)
+
+        # -- residency landing off the declared contract -------------------
+        if in_runtime:
+            for attr, line, scope in scanner.attr_allocs:
+                checks += 1
+                if attr not in declared:
+                    findings.append(Finding(
+                        "untracked-device-state", mod.relpath, line,
+                        scope,
+                        f"persistent device-array attribute "
+                        f"``self.{attr}`` is allocated here but not "
+                        "declared in MEMORY_LEDGER — residency the "
+                        "graftmem ledger cannot attribute (the mirror "
+                        "of undeclared-jit)"))
+            for attr, line, scope in scanner.container_stores:
+                checks += 1
+                if attr not in bounds:
+                    findings.append(Finding(
+                        "unbounded-device-growth", mod.relpath, line,
+                        scope,
+                        f"container ``self.{attr}`` accumulates device "
+                        "arrays here with no MEMORY_BOUNDS entry — "
+                        "declare {container: bound} naming the "
+                        "capacity and eviction policy"))
+
+    summary = {
+        "memory_checks": checks,
+        "memory_ledgers": ledgers_live,
+        "vacuous": sorted(vacuous),
+    }
+    return (sorted(findings, key=lambda f: (f.path, f.line, f.rule)),
+            summary)
