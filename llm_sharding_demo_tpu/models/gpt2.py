@@ -88,6 +88,9 @@ SHARDING_DESCRIPTOR = {
     "expert": (),
     "tp_divisors": ("n_head",),
     "ep_divisors": (),
+    # MHA: kv heads == n_head, so a kvp (KV-partition) axis shards the
+    # same head count tp does (tools/graftcheck placement/costmodel)
+    "kvp_divisors": ("n_head",),
 }
 
 

@@ -91,6 +91,10 @@ SHARDING_DESCRIPTOR = {
     "row": ("blocks.attn.wo", "blocks.mlp.down"),
     "expert": (),
     "tp_divisors": ("n_head", "n_kv_head"),
+    # kvp (KV-partition, Helix-style) shards the PAGED POOL's kv-head
+    # dim only — query heads replicate, so unlike tp the GQA ratio does
+    # not constrain it; only the kv head count must divide
+    "kvp_divisors": ("n_kv_head",),
     "ep_divisors": (),
 }
 

@@ -32,6 +32,15 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel._shard_compat import pcast_varying, shard_map
 
+# Placement contract (tools/graftcheck placement pass + utils/
+# graftshard): Q/K/V enter and leave with the sequence dim sharded over
+# ``sp``; the traced kernel must establish exactly that placement (the
+# K/V ring rotation's ppermutes run over sp and nothing else).
+PLACEMENT_CONTRACT = {
+    "mesh_axes": ("sp",),
+    "entry:ring_attention": "sp",
+}
+
 NEG_INF = -1e9
 
 

@@ -38,6 +38,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.gpt2 import GPT2Config, Params, apply_blocks
 from ._shard_compat import pcast_varying, shard_map
 
+# Placement contract (tools/graftcheck placement pass + utils/
+# graftshard): ``pp`` is the single MANUAL axis here — the compiled
+# pipeline program's traced jaxpr must establish exactly that placement
+# (blocks split stage-major over pp, activations replicated). tp/sp
+# ride as automatic GSPMD axes inside the blocks and never appear as
+# manual placement in the traced program.
+PLACEMENT_CONTRACT = {
+    "mesh_axes": ("pp", "tp", "sp"),
+    "entry:_compiled_pipeline": "pp",
+}
+
 
 def microbatch(h: jnp.ndarray, n_microbatches: int) -> jnp.ndarray:
     """[B, ...] -> [M, B/M, ...]; validates divisibility."""

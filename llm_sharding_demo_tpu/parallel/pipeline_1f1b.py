@@ -51,6 +51,17 @@ from ..models.gpt2 import GPT2Config, Params
 from ._shard_compat import pcast_varying, shard_map
 from .gpipe import microbatch
 
+# Placement contract (tools/graftcheck placement pass + utils/
+# graftshard): same manual-axis story as gpipe — ``pp`` is the only
+# manual axis in the 1F1B program (dp grad reductions are GSPMD-
+# inserted and never manual placement); the schedule's backward trace
+# is too heavy for the compile-free traced half, so this contract is
+# checked by the AST half (liveness + literal collective axes) only.
+PLACEMENT_CONTRACT = {
+    "mesh_axes": ("pp", "tp", "dp"),
+    "entry:_compiled_1f1b": "pp",
+}
+
 
 def one_f_one_b_loss_and_grads(params: Params, ids: jnp.ndarray,
                                config: GPT2Config, mesh: Mesh,

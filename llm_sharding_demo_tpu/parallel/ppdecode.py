@@ -67,6 +67,22 @@ GRAFTCHECK_DECODE_ENTRY_POINTS = ("_pp_blocks",)
 # call would read donated storage.
 DONATED_ARGS = {"_decode": (2, 3)}
 
+# Placement contract (tools/graftcheck placement pass + utils/
+# graftshard): the decoder's long-lived holdings and its one traced
+# program, by mesh position. The stage-major stacks (blocks, the
+# validity mask) live split over ``pp``; the embed/head leaves every
+# stage reads are EXPLICITLY replicated (tiny next to the blocks — the
+# replicated-large-buffer rule holds the declaration to a byte
+# threshold); ``_pp_blocks`` is the shard_map program whose traced
+# jaxpr must establish exactly the ``pp`` placement it declares.
+PLACEMENT_CONTRACT = {
+    "mesh_axes": ("pp",),
+    "holding:blocks": "pp",
+    "holding:_valid": "pp",
+    "holding:shared": "replicated",
+    "entry:_pp_blocks": "pp",
+}
+
 
 def stage_ring_permutation(n_stages: int) -> list:
     """THE ppermute pairs for one hop along the stage ring:

@@ -138,6 +138,21 @@ MEMORY_LEDGER = {
     "scales": "pool_scales",
 }
 
+# Placement contract (tools/graftcheck placement pass + utils/
+# graftshard): the pool's two device planes are EXPLICITLY replicated
+# today — the single-device paged engine owns the whole block table.
+# ``kvp`` is the declared partition axis a mesh-sharded pool will
+# split the kv-head dim over (ROADMAP item 1; the planner already
+# enumerates and prices kvp candidates against this vocabulary) — the
+# builder that lands it flips these holdings to "kvp" and the dynamic
+# auditor (GRAFTSHARD=1) starts requiring that placement on the live
+# buffers at track()/update() time.
+PLACEMENT_CONTRACT = {
+    "mesh_axes": ("kvp",),
+    "holding:data": "replicated",
+    "holding:scales": "replicated",
+}
+
 
 # graftscope program-key derivations (the certifier's model: gather/
 # scatter key by (batch, table width) — block ids and placement are

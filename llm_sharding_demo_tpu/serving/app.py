@@ -42,7 +42,7 @@ from ..models import gpt2
 from ..parallel import partition as P_
 from ..parallel.pipeline import PipelineRunner
 from ..runtime.engine import REF_TEMPERATURE, REF_TOP_K, SamplingConfig
-from ..utils import graftfault, graftmem, grafttime, tracing
+from ..utils import graftfault, graftmem, graftshard, grafttime, tracing
 from ..utils.config import ServingConfig, from_env
 from ..utils.metrics import REGISTRY
 from ..utils.tracing import timed
@@ -833,9 +833,22 @@ def create_app(cfg: Optional[ServingConfig] = None,
                 "graftmem byte conservation violated: component sum "
                 f"{mem['components']} disagrees with ledger total "
                 f"{mem['total_bytes']}")
+        # Live placement auditor (utils/graftshard, GRAFTSHARD=1):
+        # armed/checks/violations/tracked, so operators can see whether
+        # placement discipline is being enforced — and a violation that
+        # slipped past the raise path (audit-only drift) turns the
+        # health check red instead of hiding in a log.
+        shard_status = graftshard.status()
+        if shard_status["enabled"]:
+            shard_status["audit"] = graftshard.audit()
+            if shard_status["audit"]:
+                raise AssertionError(
+                    "graftshard placement contract violated: "
+                    f"{shard_status['audit']}")
         return {
             **live,
             "status": "ok",
+            "graftshard": shard_status,
             **_topology(),
             "devices": [str(d) for d in jax.devices()],
         }

@@ -466,15 +466,25 @@ STATE = MemoryLedger()
 
 
 def track(owner: Any, holding: str, component: str, value: Any) -> int:
-    return STATE.track(owner, holding, component, value)
+    handle = STATE.track(owner, holding, component, value)
+    # ledger registration is the one moment the VALUE itself is in hand,
+    # so the live placement auditor (utils/graftshard, GRAFTSHARD=1)
+    # piggybacks here; unarmed it is a single env-var check
+    from . import graftshard
+    graftshard.observe_track(owner, holding, component, value, handle)
+    return handle
 
 
 def update(handle: int, value: Any) -> None:
     STATE.update(handle, value)
+    from . import graftshard
+    graftshard.observe_update(handle, value)
 
 
 def release(handle: int) -> None:
     STATE.release(handle)
+    from . import graftshard
+    graftshard.observe_release(handle)
 
 
 def holding_bytes(owner: Any, holding: str) -> int:

@@ -1,0 +1,298 @@
+"""graftshard: the live placement auditor (dynamic half).
+
+``tools/graftcheck/placement.py`` is the static half — the same
+static+dynamic split as graftsan/graftsched/graftmem/graftnum. The
+static pass verifies what the TRACED programs establish; this module
+verifies what the LIVE buffers actually are: every device holding the
+graftmem ledger registers (``graftmem.track`` / ``graftmem.update`` —
+the one moment the value itself is in hand) is checked against the
+owning module's declared ``PLACEMENT_CONTRACT``, so graftmem's
+per-device byte attribution is finally held to a declaration instead
+of just reported.
+
+Armed by ``GRAFTSHARD=1`` (off by default: serving pays zero cost —
+the hook is one env check per ledger registration). When armed:
+
+- at ``track``/``update`` time the registered value's ``.sharding``
+  (every leaf's PartitionSpec axis names, plus the addressable-shards
+  device set) is checked against the owner module's
+  ``PLACEMENT_CONTRACT["holding:<name>"]`` declaration;
+- a declared ``"replicated"`` holding whose live buffer names ANY mesh
+  axis — or a declared-axis holding naming any OTHER axis — raises
+  :class:`GraftshardError` with holding/component/declaration-site
+  provenance. The check is spec-level and device-count-independent: a
+  single-device buffer (no named placement) satisfies every
+  declaration; a buffer PLACED over an axis must be placed over the
+  declared one.
+- :func:`audit` re-walks every still-live tracked value (weak refs —
+  the ledger's own lifecycle) and returns the violations; ``/healthz``
+  surfaces :func:`status`.
+
+``MESH_AXES`` mirrors ``tools/graftcheck/placement.MESH_AXES`` — the
+tests pin the two stay equal (the graftnum.REGIMES pattern), so the
+dynamic auditor and the static pass can never disagree about the
+vocabulary.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import weakref
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+# THE mesh-axis vocabulary (pinned equal to tools/graftcheck/
+# placement.MESH_AXES by tests/test_graftshard.py)
+MESH_AXES = ("pp", "tp", "ep", "kvp", "dp", "sp")
+
+# locks-pass contract: graftmem's track/update/release fire from both
+# serving threads and the iterbatch worker, so the auditor's registry
+# and counters ride one instance lock (the MemoryLedger pattern)
+GUARDED_STATE = {"_registry": "_lock", "_live": "_lock",
+                 "_stats": "_lock"}
+
+REPLICATED = "replicated"
+
+
+class GraftshardError(AssertionError):
+    """A live buffer's placement disagrees with its module's declared
+    PLACEMENT_CONTRACT. AssertionError subclass for the same reason
+    GraftsanError is: this is an invariant violation, not an
+    environmental failure — tests must not catch it by accident."""
+
+    def __init__(self, message: str, owner: str = "", holding: str = "",
+                 component: str = "", expected: str = "",
+                 found: Tuple[str, ...] = (), where: str = ""):
+        super().__init__(message)
+        self.owner = owner
+        self.holding = holding
+        self.component = component
+        self.expected = expected
+        self.found = tuple(found)
+        self.where = where
+
+
+def enabled() -> bool:
+    return os.environ.get("GRAFTSHARD", "0") == "1"
+
+
+class _Auditor:
+    """The registry + counters behind the module-level API: handle ->
+    declaration row plus a weak ref to the live value (refs die with
+    the buffers, exactly like graftmem's finalizers)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # handle -> (module_name, owner_type, holding, component,
+        #            expected)
+        self._registry: Dict[int, Tuple[str, str, str, str, str]] = {}
+        self._live: Dict[int, "weakref.ref"] = {}
+        self._stats = {"checks": 0, "violations": 0}
+
+    def register(self, handle: int, row: Tuple[str, str, str, str, str],
+                 value: Any) -> None:
+        with self._lock:
+            self._registry[handle] = row
+            try:
+                self._live[handle] = weakref.ref(value)
+            except TypeError:
+                pass  # un-weakref-able values audit at track/update only
+
+    def row(self, handle: int) -> Optional[Tuple[str, str, str, str, str]]:
+        with self._lock:
+            return self._registry.get(handle)
+
+    def rebind(self, handle: int, value: Any) -> None:
+        with self._lock:
+            if handle not in self._registry:
+                return
+            try:
+                self._live[handle] = weakref.ref(value)
+            except TypeError:
+                pass
+
+    def drop(self, handle: int) -> None:
+        with self._lock:
+            self._registry.pop(handle, None)
+            self._live.pop(handle, None)
+
+    def live_rows(self):
+        with self._lock:
+            return [(h, self._registry[h], self._live[h])
+                    for h in sorted(self._registry) if h in self._live]
+
+    def count(self, checks: int = 0, violations: int = 0) -> None:
+        with self._lock:
+            self._stats["checks"] += checks
+            self._stats["violations"] += violations
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"checks": self._stats["checks"],
+                    "violations": self._stats["violations"],
+                    "tracked": len(self._registry)}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._registry.clear()
+            self._live.clear()
+            self._stats = {"checks": 0, "violations": 0}
+
+
+STATE = _Auditor()
+
+
+def _contract_of(module_name: str) -> Optional[dict]:
+    mod = sys.modules.get(module_name)
+    contract = getattr(mod, "PLACEMENT_CONTRACT", None)
+    return contract if isinstance(contract, dict) else None
+
+
+def _decl_site(module_name: str) -> str:
+    """``file:line`` of the owning module's PLACEMENT_CONTRACT — the
+    provenance every violation points back at."""
+    mod = sys.modules.get(module_name)
+    path = getattr(mod, "__file__", None)
+    if not path:
+        return module_name
+    try:
+        with open(path, encoding="utf-8") as f:
+            for i, text in enumerate(f, 1):
+                if text.startswith("PLACEMENT_CONTRACT"):
+                    return f"{path}:{i}"
+    except OSError:
+        pass
+    return path
+
+
+def _leaf_axes(value: Any) -> Tuple[Set[str], int]:
+    """(axis names any leaf's live PartitionSpec mentions, leaves
+    inspected). Host arrays / single-device placements carry no spec
+    and contribute nothing — the check is about NAMED placement."""
+    import jax
+    axes: Set[str] = set()
+    seen = 0
+    for leaf in jax.tree_util.tree_leaves(value):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is None:
+            continue
+        seen += 1
+        spec = getattr(sharding, "spec", None)
+        if spec is None:
+            continue
+        for entry in spec:
+            for a in (entry if isinstance(entry, tuple) else (entry,)):
+                if isinstance(a, str):
+                    axes.add(a)
+        # the device set backs the spec claim: a spec naming axes while
+        # the buffer sits on one device is still a single-device buffer
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is not None and len({s.device for s in shards}) <= 1 \
+                and not axes:
+            continue
+    return axes, seen
+
+
+def _problem(expected: str, axes: Set[str]) -> Optional[str]:
+    if expected == REPLICATED:
+        if axes:
+            return (f"declared \"replicated\" but the live buffer is "
+                    f"placed over mesh axes {sorted(axes)}")
+        return None
+    stray = axes - {expected}
+    if stray:
+        return (f"declared placement over {expected!r} but the live "
+                f"buffer also names {sorted(stray)}")
+    return None
+
+
+def _check(module_name: str, owner_type: str, holding: str,
+           component: str, expected: str, value: Any) -> None:
+    axes, _seen = _leaf_axes(value)
+    STATE.count(checks=1)
+    problem = _problem(expected, axes)
+    if problem is None:
+        return
+    STATE.count(violations=1)
+    where = _decl_site(module_name)
+    raise GraftshardError(
+        f"graftshard: {owner_type}.{holding} (component {component!r}) "
+        f"{problem} — contract at {where}",
+        owner=owner_type, holding=holding, component=component,
+        expected=expected, found=tuple(sorted(axes)), where=where)
+
+
+def observe_track(owner: Any, holding: str, component: str, value: Any,
+                  handle: int) -> None:
+    """graftmem.track's hook: register + check one new holding. A
+    module with no PLACEMENT_CONTRACT, or a contract not declaring
+    this holding, audits nothing (declaring is the static pass's
+    discipline; auditing the declared is this module's)."""
+    if not enabled():
+        return
+    module_name = type(owner).__module__
+    contract = _contract_of(module_name)
+    if contract is None:
+        return
+    expected = contract.get(f"holding:{holding}")
+    if not isinstance(expected, str):
+        return
+    row = (module_name, type(owner).__name__, holding, component,
+           expected)
+    STATE.register(handle, row, value)
+    _check(*row, value)
+
+
+def observe_update(handle: int, value: Any) -> None:
+    """graftmem.update's hook: re-check a re-bound holding (the donated
+    movers re-bind pool planes every dispatch — placement must
+    survive the rebind)."""
+    if not enabled():
+        return
+    row = STATE.row(handle)
+    if row is None:
+        return
+    STATE.rebind(handle, value)
+    _check(*row, value)
+
+
+def observe_release(handle: int) -> None:
+    STATE.drop(handle)
+
+
+def audit() -> List[dict]:
+    """Re-walk every still-live tracked holding against its declared
+    contract; returns one row per VIOLATION (empty = the whole ledger
+    is where it was declared to be). Never raises — /healthz and tests
+    read the rows; the raising path is the track/update-time check."""
+    out: List[dict] = []
+    for _handle, row, ref in STATE.live_rows():
+        module_name, owner_type, holding, component, expected = row
+        value = ref()
+        if value is None:
+            continue
+        axes, _seen = _leaf_axes(value)
+        STATE.count(checks=1)
+        problem = _problem(expected, axes)
+        if problem is None:
+            continue
+        STATE.count(violations=1)
+        out.append({
+            "owner": owner_type, "holding": holding,
+            "component": component, "expected": expected,
+            "found": sorted(axes), "problem": problem,
+            "where": _decl_site(module_name),
+        })
+    return out
+
+
+def status() -> dict:
+    """The /healthz view: armed or not, cumulative check/violation
+    counters, and how many live holdings are under audit."""
+    return {"enabled": enabled(), **STATE.stats()}
+
+
+def clear() -> None:
+    """Test hook: drop the registry and counters."""
+    STATE.clear()

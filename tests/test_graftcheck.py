@@ -33,8 +33,9 @@ from llm_sharding_demo_tpu.models import gpt2
 from llm_sharding_demo_tpu.runtime.engine import DecodeEngine, SamplingConfig
 from llm_sharding_demo_tpu.runtime.spec_decode import SpecDecodeEngine
 
-from tools.graftcheck import cli, lint, recompile as R, semantic
-from tools.graftcheck.core import Finding, load_baseline, split_findings
+from tools.graftcheck import cli, lint, recompile as R, sarif, semantic
+from tools.graftcheck.core import (Finding, current_pr, load_baseline,
+                                   split_findings, stale_audits)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -241,6 +242,30 @@ def test_repo_passes_graftcheck():
         assert ml.get(rel, 0) >= 1, (
             f"{rel}: no live MEMORY_LEDGER holding — its device "
             "allocations stopped registering with the byte ledger")
+    assert payload["placement_checks"] >= 10, (
+        "graftshard placement pass went vacuous — a new placement-drift"
+        " / undeclared-collective / replicated-large-buffer / "
+        "hot-path-reshard finding anywhere in the tree fails this "
+        "strict run (rule fixtures in tests/test_graftshard.py)")
+    assert payload["placement_vacuous"] == [], (
+        "PLACEMENT_CONTRACT declarations resolving to nothing live "
+        "(placement discipline stopped seeing that module's mesh): "
+        f"{payload['placement_vacuous']}")
+    # the mesh-positioned modules each declare a LIVE placement contract
+    pc = payload["placement_contracts"]
+    for rel in ("llm_sharding_demo_tpu/parallel/ppdecode.py",
+                "llm_sharding_demo_tpu/ops/ring_attention.py",
+                "llm_sharding_demo_tpu/runtime/kv_pool.py",
+                "llm_sharding_demo_tpu/models/llama.py"):
+        assert pc.get(rel, 0) >= 1, (
+            f"{rel}: no live PLACEMENT_CONTRACT/SHARDING_DESCRIPTOR "
+            "declaration — its mesh position went undeclared")
+    assert payload["stale_audits"] == [], (
+        "baseline suppressions whose 'audited: PR<n>' tag lapsed — "
+        f"re-verify and re-tag: {payload['stale_audits']}")
+    # the full run reports every pass, each with its wall time
+    assert payload["passes_run"] == list(cli.PASS_IDS)
+    assert set(payload["pass_seconds"]) == set(cli.PASS_IDS)
     assert payload["suppressed"] >= 1, (
         "the documented sync points should be baselined findings — an "
         "empty suppression set means the host-sync rule stopped seeing "
@@ -538,6 +563,125 @@ def test_baseline_parse_suppress_and_stale(tmp_path):
     bl.write_text("host-sync missing-scope-separator why\n")
     with pytest.raises(ValueError, match="malformed baseline line"):
         load_baseline(str(bl))
+
+
+def test_audit_tags_machine_checked(tmp_path):
+    """Suppressions age: an entry with no ``audited: PR<n>`` tag, or
+    one older than the last core.AUDIT_WINDOW PRs, is a stale-audit row
+    (--strict fails on any); a fresh tag is clean."""
+    (tmp_path / "CHANGES.md").write_text(
+        "PR 9: something\nPR 17: something else\n")
+    assert current_pr(str(tmp_path)) == 18
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(
+        "host-sync a/b.py::C.fresh documented (audited: PR17)\n"
+        "host-sync a/b.py::C.old documented (audited: PR1)\n"
+        "host-sync a/b.py::C.untagged documented but never re-verified\n")
+    rows = stale_audits(str(bl), str(tmp_path))
+    assert len(rows) == 2
+    assert any("C.old" in r and "audited PR1" in r for r in rows)
+    assert any("C.untagged" in r and "no 'audited: PR<n>' tag" in r
+               for r in rows)
+    assert not any("C.fresh" in r for r in rows)
+    # a window-edge tag (current - window + 1) still passes
+    from tools.graftcheck.core import AUDIT_WINDOW
+    bl.write_text(
+        f"host-sync a/b.py::C.edge ok (audited: PR{19 - AUDIT_WINDOW})\n")
+    assert stale_audits(str(bl), str(tmp_path)) == []
+    bl.write_text(
+        f"host-sync a/b.py::C.edge ok (audited: PR{18 - AUDIT_WINDOW})\n")
+    assert len(stale_audits(str(bl), str(tmp_path))) == 1
+    # no CHANGES.md -> staleness can't be judged -> report nothing
+    assert stale_audits(str(bl), str(tmp_path / "nowhere")) == []
+
+
+def test_repo_baseline_audit_tags_fresh():
+    """Every suppression in the repo's own baseline carries a
+    fresh-enough audit tag (the strict driver fails otherwise)."""
+    assert stale_audits() == [], (
+        "re-verify these baseline suppressions and re-tag them "
+        "'audited: PR<n>'")
+
+
+def test_sarif_output_schema_pinned():
+    """The --sarif emitter: SARIF 2.1.0, one run, driver graftcheck,
+    rules collected from findings, file:line regions, and baseline-
+    suppressed findings riding along marked externally suppressed
+    (never dropped)."""
+    payload = {
+        "findings": [{"rule": "host-sync", "path": "a/b.py", "line": 7,
+                      "scope": "C.m", "message": "np.asarray in loop"}],
+        "suppressed_findings": [
+            {"rule": "overlap", "path": "c/d.py", "line": 3,
+             "scope": "C.n", "message": "documented",
+             "justification": "by design (audited: PR18)"}],
+    }
+    doc = sarif.to_sarif(payload)
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+    assert len(doc["runs"]) == 1
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftcheck"
+    assert [r["id"] for r in run["tool"]["driver"]["rules"]] == [
+        "host-sync", "overlap"]  # sorted
+    active, suppressed = run["results"]
+    assert active["ruleId"] == "host-sync" and active["level"] == "error"
+    loc = active["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "a/b.py"
+    assert loc["region"]["startLine"] == 7
+    assert "suppressions" not in active
+    assert suppressed["level"] == "note"
+    assert suppressed["suppressions"] == [{
+        "kind": "external",
+        "justification": "by design (audited: PR18)"}]
+
+
+def test_sarif_cli_flag_emits_valid_document():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftcheck", "--lint-only",
+         "--sarif"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    results = doc["runs"][0]["results"]
+    # the documented baselined sync points ride along suppressed
+    assert any(r.get("suppressions") for r in results)
+    assert all(r["level"] == "note" for r in results
+               if r.get("suppressions"))
+
+
+def test_pass_selection_runs_subset_with_timings():
+    """--passes runs exactly the selection; skipped passes report their
+    schema defaults so journal consumers never branch on key presence;
+    per-pass wall time rides in pass_seconds."""
+    payload = cli.run(root=REPO, lint_only=True,
+                      passes=("lint", "locks", "placement"))
+    assert payload["passes_run"] == ["lint", "locks", "placement"]
+    assert set(payload["pass_seconds"]) == {"lint", "locks", "placement"}
+    assert all(t >= 0 for t in payload["pass_seconds"].values())
+    assert payload["locks_checks"] >= 1
+    assert payload["placement_checks"] >= 1
+    # skipped passes: defaults, visibly dead
+    assert payload["sanitize_checks"] == 0
+    assert payload["numerics_checks"] == 0
+    assert payload["numerics_contracts"] == {}
+
+
+def test_pass_selection_rejects_unknown_and_strict_subsets():
+    with pytest.raises(ValueError, match="unknown pass id"):
+        cli.run(root=REPO, passes=("nope",))
+    with pytest.raises(ValueError, match="strict requires the full"):
+        cli.run(root=REPO, strict=True, passes=("locks",))
+    # the CLI maps the refusal to exit code 2 (usage error, not finding)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftcheck", "--strict",
+         "--passes", "locks"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 2
+    assert "strict requires the full pass set" in proc.stderr
 
 
 # -- 3. recompile-budget certifier == observed cache sizes -------------------

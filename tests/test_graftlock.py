@@ -38,7 +38,7 @@ import pytest
 from llm_sharding_demo_tpu.models import gpt2
 from llm_sharding_demo_tpu.runtime.engine import DecodeEngine
 from llm_sharding_demo_tpu.runtime.kv_pool import BlockAllocator
-from llm_sharding_demo_tpu.utils import graftsched
+from llm_sharding_demo_tpu.utils import graftshard, graftsched
 from tools.graftcheck import locks
 from tools.graftcheck.core import load_baseline
 
@@ -523,7 +523,13 @@ def _iter_pool_app(monkeypatch):
     monkeypatch.setenv("GRAFTSAN", "1")
     monkeypatch.setenv("GRAFTSCHED", "1")
     monkeypatch.setenv("GRAFTSCHED_SEED", "11")
+    # the live placement auditor rides along: every pool plane the
+    # graftmem ledger registers is checked against kv_pool.py's
+    # PLACEMENT_CONTRACT at track/update time (tests/test_graftshard.py
+    # pins the must-find; here the whole serving stack must run clean)
+    monkeypatch.setenv("GRAFTSHARD", "1")
     graftsched.clear()
+    graftshard.clear()
     model = (CFG, gpt2.init_params(CFG, jax.random.PRNGKey(0)))
     cfg = ServingConfig(model_id="test", shard_role="coordinator",
                         max_seq=64, boundaries=(1,), max_batch=4,
@@ -572,6 +578,13 @@ def test_threaded_generate_clients_under_graftsan_and_graftsched(
         st = h.json()["kv_pool_stats"]
         assert st["blocks_in_use"] + st["blocks_free"] \
             == st["blocks_total"]
+        # the armed placement auditor surfaced through /healthz: the
+        # pool's declared-replicated planes audited clean throughout
+        shard = h.json()["graftshard"]
+        assert shard["enabled"] is True
+        assert shard["checks"] >= 1 and shard["violations"] == 0
+        assert shard["audit"] == []
+    assert graftshard.audit() == []
     # zero scheduler findings (lost updates, inversions, deadlocks)
     assert graftsched.findings() == [], \
         [f.format() for f in graftsched.findings()]

@@ -140,6 +140,25 @@ def test_tp_megatron_comm_bytes_golden():
     assert CM.tp_decode_comm_bytes(LLAMA_CFG, 1, 2) == 1024
 
 
+def test_kvp_partial_softmax_comm_bytes_golden():
+    """llama-gqa (Hq=4, hd=4, L=4) over kvp=2, B=1: each device attends
+    against its resident kv shard, then the partial-softmax combine
+    crosses the kvp axis once per block — an all_gather of the
+    un-normalized ``o [1, 4, 4]`` fp32 (64 B) plus the per-head
+    log-sum-exp ``lse [1, 4]`` fp32 (16 B). all_gather over an n-wide
+    axis moves b x n x (n-1) bytes: (64 + 16) x 2 x 1 = 160 B/layer,
+    x 4 layers => 640 bytes per decoded token."""
+    assert CM.kvp_decode_comm_bytes(LLAMA_CFG, 1, 2) == 640
+
+
+def test_kvp_tp_comm_bytes_compose_additively():
+    """kvp x tp: the tp psums and the kvp gathers both cross the ICI —
+    640 (kvp partial-softmax combine) + 1024 (the two Megatron psums
+    per block, pinned above) = 1664."""
+    assert (CM.kvp_decode_comm_bytes(LLAMA_CFG, 1, 2)
+            + CM.tp_decode_comm_bytes(LLAMA_CFG, 1, 2)) == 1664
+
+
 def test_collective_walker_handles_scan_trip_counts():
     """A hand-built program: psum of a [4] fp32 (16 bytes) inside a
     3-trip scan over a 2-wide axis -> 3 x (2 x 16 x 1) = 96 bytes."""
@@ -185,6 +204,25 @@ def test_pool_bytes_equal_real_pool_nbytes():
     pool = KVBlockPool(GPT2_CFG.n_layer, 16, GPT2_CFG.n_head, 8,
                        GPT2_CFG.head_dim, max_seq=64)
     assert CM.kv_pool_bytes(GPT2_CFG, 16, 8) == np.asarray(pool.data).nbytes
+
+
+def test_kvp_pool_bytes_per_device_is_exact_half():
+    """The kvp row's HBM claim against the REAL pool buffer: the
+    llama-gqa paged pool's kv-head plane sharded over kvp=2 puts
+    exactly ``pool.data.nbytes // 2`` on each device — whole kv heads,
+    no remainder (n_kv_head=2 divides)."""
+    from llm_sharding_demo_tpu.runtime.kv_pool import KVBlockPool
+    pool = KVBlockPool(LLAMA_CFG.n_layer, 16, LLAMA_CFG.n_kv_head, 16,
+                       LLAMA_CFG.head_dim, max_seq=64)
+    total = CM.kv_pool_bytes(LLAMA_CFG, 16, 16)
+    assert total == np.asarray(pool.data).nbytes
+    assert total % 2 == 0
+    payload = CM.plan(llama, LLAMA_CFG, {"kvp": 2}, max_seq=64,
+                      kv_pool_blocks=16, kv_block_size=16)
+    kvp_rows = [r for r in payload["plan"]
+                if r["config"]["topology"] == "kvp"]
+    assert kvp_rows and all(r["ok"] for r in kvp_rows)
+    assert kvp_rows[0]["kv_bytes_per_device"] == total // 2
 
 
 def test_sharded_param_bytes_split_by_axis_size():
@@ -323,6 +361,73 @@ def test_gqa_head_ratio_gates_indivisible_tp():
                for r in tp_rows for f in r["findings"])
     # the single-device fallback still serves
     assert payload["chosen"]["config"]["topology"] == "single"
+
+
+def test_kvp_tp_multi_axis_plan_verifier_gated_with_goldens():
+    """Acceptance: on a 4-device kvp=2 x tp=2 mesh with a paged pool,
+    the planner enumerates verifier-gated multi-axis rows and prices
+    them at the pinned goldens — kvp alone at 640 comm bytes/token
+    (partial-softmax combine), kvp x tp at 1664 (additive schedules),
+    both with the pool plane exactly halved per device."""
+    payload = CM.plan(llama, LLAMA_CFG, {"kvp": 2, "tp": 2}, max_seq=64,
+                      kv_pool_blocks=16, kv_block_size=16)
+    rows = {r["config"]["topology"]: r for r in payload["plan"]
+            if r["config"]["topology"] in ("kvp", "kvp-tp")}
+    assert set(rows) == {"kvp", "kvp-tp"}
+    half_pool = CM.kv_pool_bytes(LLAMA_CFG, 16, 16) // 2
+    for topo, comm in (("kvp", 640), ("kvp-tp", 1664)):
+        row = rows[topo]
+        assert row["ok"], row["findings"]
+        assert row["findings"] == []
+        assert row["comm_bytes_per_token"] == comm
+        assert row["kv_bytes_per_device"] == half_pool
+        assert row["serving_env"]["KVP_DECODE"] == "1"
+        assert row["serving_env"]["KV_POOL_BLOCKS"] == "16"
+    assert rows["kvp-tp"]["serving_env"]["TP_DECODE"] == "1"
+    assert rows["kvp"]["serving_env"]["TP_DECODE"] == "0"
+    # kvp x tp additionally shards the params: strictly less HBM than
+    # the kvp-only row's replicated weights
+    assert (rows["kvp-tp"]["param_bytes_per_device"]
+            < rows["kvp"]["param_bytes_per_device"])
+    assert payload["chosen"] is not None
+
+
+def test_kvp_indivisible_kv_heads_rejected_with_diagnostics():
+    """The families() llama stand-in has n_kv_head=1, which a 2-wide
+    kvp axis cannot split into whole kv heads — the kvp candidate must
+    be REJECTED with the divisibility diagnostic, never scored."""
+    _, tiny = registry.families()["llama-tiny"]
+    payload = CM.plan(llama, tiny, {"kvp": 2}, max_seq=64,
+                      kv_pool_blocks=16, kv_block_size=16)
+    kvp_rows = [r for r in payload["plan"]
+                if r["config"]["topology"] == "kvp"]
+    assert kvp_rows and all(not r["ok"] for r in kvp_rows)
+    assert any("n_kv_head=1 not divisible" in f["message"]
+               and "kvp" in f["message"]
+               for r in kvp_rows for f in r["findings"])
+    assert all(r["cost_per_token"] is None for r in kvp_rows)
+
+
+def test_kvp_without_descriptor_fields_rejected():
+    """A family whose SHARDING_DESCRIPTOR declares no kvp_divisors is
+    unreviewable for pool-plane sharding — the kvp row is rejected
+    with that diagnostic (moe also rejects the pool itself: window-
+    dependent attention)."""
+    payload = CM.plan(moe, MOE_CFG, {"kvp": 2}, max_seq=64,
+                      kv_pool_blocks=16, kv_block_size=16)
+    kvp_rows = [r for r in payload["plan"]
+                if r["config"]["topology"] == "kvp"]
+    assert kvp_rows and all(not r["ok"] for r in kvp_rows)
+    assert any("kvp_divisors" in f["message"]
+               for r in kvp_rows for f in r["findings"])
+
+
+def test_kvp_requires_a_pool():
+    """No paged pool, no kvp rows: the axis shards the pool's kv-head
+    plane, so a poolless mesh enumerates none."""
+    payload = CM.plan(llama, LLAMA_CFG, {"kvp": 2}, max_seq=64)
+    assert [r for r in payload["plan"]
+            if r["config"]["topology"] == "kvp"] == []
 
 
 def test_illegal_compositions_rejected_never_scored():
@@ -489,7 +594,10 @@ def test_verifier_json_schema_shape():
     the full run's semantics)."""
     payload = cli.run(lint_only=True)
     assert set(payload) == {"ok", "strict", "findings", "suppressed",
-                            "stale_baseline", "semantic_checks",
+                            "suppressed_findings",
+                            "stale_baseline", "stale_audits",
+                            "passes_run", "pass_seconds",
+                            "semantic_checks",
                             "sanitize_checks", "locks_checks",
                             "locks_guarded_regions", "locks_vacuous",
                             "fault_checks", "fault_policies",
@@ -507,6 +615,8 @@ def test_verifier_json_schema_shape():
                             "numerics_vacuous",
                             "memory_checks", "memory_ledgers",
                             "memory_vacuous",
+                            "placement_checks", "placement_contracts",
+                            "placement_vacuous",
                             "recompile_bounds"}
     assert isinstance(payload["ok"], bool)
     assert isinstance(payload["sanitize_checks"], int)
@@ -537,6 +647,14 @@ def test_verifier_json_schema_shape():
     assert isinstance(payload["memory_checks"], int)
     assert isinstance(payload["memory_ledgers"], dict)
     assert isinstance(payload["memory_vacuous"], list)
+    assert isinstance(payload["placement_checks"], int)
+    assert isinstance(payload["placement_contracts"], dict)
+    assert isinstance(payload["placement_vacuous"], list)
+    assert isinstance(payload["stale_audits"], list)
+    assert isinstance(payload["passes_run"], list)
+    assert isinstance(payload["pass_seconds"], dict)
+    assert set(payload["pass_seconds"]) == set(payload["passes_run"])
+    assert isinstance(payload["suppressed_findings"], list)
     assert isinstance(payload["strict"], bool)
     assert isinstance(payload["findings"], list)
     assert isinstance(payload["suppressed"], int)
@@ -565,7 +683,7 @@ def test_plan_json_schema_shape():
                                       "kv_pool_blocks", "kv_block_size"}
     assert payload["chosen"]["serving_env"].keys() >= {
         "BATCH_MODE", "MAX_BATCH", "PP_DECODE", "TP_DECODE", "EP_DECODE",
-        "KV_POOL_BLOCKS", "KV_BLOCK_SIZE"}
+        "KVP_DECODE", "KV_POOL_BLOCKS", "KV_BLOCK_SIZE"}
     json.dumps(payload, default=str)
 
 

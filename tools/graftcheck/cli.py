@@ -32,8 +32,54 @@ import argparse
 import json
 import os
 import sys
+import time
 
-from .core import load_baseline, split_findings
+from .core import load_baseline, split_findings, stale_audits
+
+# canonical pass ids, in run order, for --passes selection. "sem"
+# covers the semantic contract checks AND the recompile certifier —
+# they share the jax-tracing stage --lint-only gates off.
+PASS_IDS = ("lint", "sanitize", "locks", "faults", "scope", "slo",
+            "fleet", "watch", "timeline", "memory", "numerics",
+            "placement", "sem")
+
+# payload keys each pass owns, with the value a SKIPPED pass reports:
+# every key is always present whatever --passes selected (the schema
+# test pins the full set), so journal consumers never branch on which
+# passes ran — ``passes_run`` says which numbers are live.
+_PASS_DEFAULTS = {
+    "lint": {},
+    "sanitize": {"sanitize_checks": 0},
+    "locks": {"locks_checks": 0, "locks_guarded_regions": {},
+              "locks_vacuous": []},
+    "faults": {"fault_checks": 0, "fault_policies": {},
+               "fault_vacuous": []},
+    "scope": {"scope_checks": 0, "scope_profiled_regions": {},
+              "scope_vacuous": []},
+    "slo": {"slo_checks": 0, "slo_policies": {}, "slo_vacuous": []},
+    "fleet": {"fleet_checks": 0, "fleet_policies": {},
+              "fleet_vacuous": []},
+    "watch": {"watch_checks": 0, "watch_signals": {},
+              "watch_vacuous": []},
+    "timeline": {"timeline_checks": 0, "timeline_kinds": {},
+                 "timeline_vacuous": []},
+    "memory": {"memory_checks": 0, "memory_ledgers": {},
+               "memory_vacuous": []},
+    "numerics": {"numerics_checks": 0, "numerics_contracts": {},
+                 "numerics_vacuous": []},
+    "placement": {"placement_checks": 0, "placement_contracts": {},
+                  "placement_vacuous": []},
+    "sem": {"semantic_checks": 0, "recompile_bounds": {}},
+}
+
+# the vacuous flags strict conjoins over (each list is "modules where
+# this contract family went blind"); a SKIPPED pass contributes its
+# falsy default, but strict refuses subsets outright (below), so a
+# strict pass can never go green by not looking
+_VACUOUS_KEYS = ("locks_vacuous", "scope_vacuous", "fault_vacuous",
+                 "slo_vacuous", "fleet_vacuous", "watch_vacuous",
+                 "timeline_vacuous", "numerics_vacuous",
+                 "memory_vacuous", "placement_vacuous")
 
 
 def _repo_root() -> str:
@@ -42,14 +88,35 @@ def _repo_root() -> str:
 
 
 def run(root: str = None, lint_only: bool = False,
-        baseline_path: str = None, strict: bool = False) -> dict:
-    """All passes (lint + graftsan sanitize + semantic) -> one JSON-able
-    payload. Import-light until called;
-    the semantic pass imports jax (CPU stand-ins only). ``strict``
-    fails the run on stale baseline entries too (the in-suite driver
-    runs strict so CI catches dead suppressions; the standalone default
-    stays report-only)."""
+        baseline_path: str = None, strict: bool = False,
+        passes=None) -> dict:
+    """All passes (lint + graftsan sanitize + ... + placement +
+    semantic) -> one JSON-able payload. Import-light until called; the
+    traced halves import jax (CPU stand-ins only). ``strict`` fails the
+    run on stale baseline entries, stale audit tags, and any VACUOUS
+    contract pass (see the ok comment below); the in-suite driver runs
+    strict so CI catches all three, the standalone default stays
+    report-only. ``passes`` selects a subset of :data:`PASS_IDS`
+    (default: all); strict refuses subsets — a strict run that skipped
+    a pass would report green without looking."""
     root = root or _repo_root()
+    selected = tuple(passes) if passes is not None else PASS_IDS
+    unknown = sorted(set(selected) - set(PASS_IDS))
+    if unknown:
+        raise ValueError(f"unknown pass id(s) {unknown}; known passes: "
+                         f"{', '.join(PASS_IDS)}")
+    if strict and set(selected) != set(PASS_IDS):
+        missing = sorted(set(PASS_IDS) - set(selected))
+        raise ValueError("--strict requires the full pass set; missing: "
+                         f"{', '.join(missing)}")
+
+    findings = []
+    fragments = {}
+    for name in PASS_IDS:
+        fragments.update(_PASS_DEFAULTS[name])
+    pass_seconds = {}
+    passes_run = []
+
     # scoped insert (the same leak-class hygiene as the check_metrics
     # shim): in-suite callers run() in-process, and a permanent prepend
     # would leak into every later test
@@ -58,52 +125,104 @@ def run(root: str = None, lint_only: bool = False,
         sys.path.insert(0, root)
     try:
         from . import faults, fleet, lint, locks, memory, numerics, \
-            sanitize, scope, slo, timeline, watch
-        findings = list(lint.run_lint(root))
-        san, sanitize_checks = sanitize.run_sanitize(root)
-        findings.extend(san)
-        lk, locks_summary = locks.run_locks(root)
-        findings.extend(lk)
-        fl, faults_summary = faults.run_faults(root)
-        findings.extend(fl)
-        sc, scope_summary = scope.run_scope_static(root)
-        findings.extend(sc)
-        sl, slo_summary = slo.run_slo(root)
-        findings.extend(sl)
-        ft, fleet_summary = fleet.run_fleet(root)
-        findings.extend(ft)
-        wt, watch_summary = watch.run_watch(root)
-        findings.extend(wt)
-        tl, timeline_summary = timeline.run_timeline(root)
-        findings.extend(tl)
-        mm, memory_summary = memory.run_memory(root)
-        findings.extend(mm)
-        # the numerics pass's jaxpr half traces real entry points —
-        # skip it under --lint-only (the AST half still runs jax-free)
-        nm, numerics_summary = numerics.run_numerics(root,
-                                                     trace=not lint_only)
-        findings.extend(nm)
-        semantic_checks = 0
-        bounds = {}
-        if not lint_only:
+            placement, sanitize, scope, slo, timeline, watch
+
+        def _summary(runner, keymap, **kw):
+            def thunk():
+                fs, s = runner(root, **kw)
+                return fs, {out: s[src] for out, src in keymap.items()}
+            return thunk
+
+        def _lint():
+            return list(lint.run_lint(root)), {}
+
+        def _sanitize():
+            fs, n = sanitize.run_sanitize(root)
+            return fs, {"sanitize_checks": n}
+
+        def _sem():
             from . import recompile, registry, semantic
-            sem, semantic_checks = semantic.run_semantic()
-            findings.extend(sem)
+            from .core import Finding
+            fs, checks = semantic.run_semantic()
+            fs = list(fs)
+            bounds = {}
             for label, desc, calls in registry.serving_workloads():
                 for call in calls:
-                    for problem in recompile.planner_invariants(desc, call):
-                        from .core import Finding
-                        findings.append(Finding(
+                    for problem in recompile.planner_invariants(desc,
+                                                                call):
+                        fs.append(Finding(
                             "recompile-budget",
                             "llm_sharding_demo_tpu/runtime/engine.py", 1,
                             label, problem))
-                        semantic_checks += 1
+                        checks += 1
                 bounds[label] = recompile.certify(desc, calls)
-                semantic_checks += len(calls)
+                checks += len(calls)
             for label, desc, paged, pcalls in registry.paged_workloads():
                 bounds[label] = recompile.certify_paged(desc, paged,
                                                         pcalls)
-                semantic_checks += len(pcalls)
+                checks += len(pcalls)
+            return fs, {"semantic_checks": checks,
+                        "recompile_bounds": bounds}
+
+        table = {
+            "lint": _lint,
+            "sanitize": _sanitize,
+            "locks": _summary(locks.run_locks, {
+                "locks_checks": "locks_checks",
+                "locks_guarded_regions": "guarded_regions",
+                "locks_vacuous": "vacuous"}),
+            "faults": _summary(faults.run_faults, {
+                "fault_checks": "fault_checks",
+                "fault_policies": "fault_policies",
+                "fault_vacuous": "vacuous"}),
+            "scope": _summary(scope.run_scope_static, {
+                "scope_checks": "scope_checks",
+                "scope_profiled_regions": "profiled_regions",
+                "scope_vacuous": "vacuous"}),
+            "slo": _summary(slo.run_slo, {
+                "slo_checks": "slo_checks",
+                "slo_policies": "slo_policies",
+                "slo_vacuous": "vacuous"}),
+            "fleet": _summary(fleet.run_fleet, {
+                "fleet_checks": "fleet_checks",
+                "fleet_policies": "fleet_policies",
+                "fleet_vacuous": "vacuous"}),
+            "watch": _summary(watch.run_watch, {
+                "watch_checks": "watch_checks",
+                "watch_signals": "watch_signals",
+                "watch_vacuous": "vacuous"}),
+            "timeline": _summary(timeline.run_timeline, {
+                "timeline_checks": "timeline_checks",
+                "timeline_kinds": "timeline_kinds",
+                "timeline_vacuous": "vacuous"}),
+            "memory": _summary(memory.run_memory, {
+                "memory_checks": "memory_checks",
+                "memory_ledgers": "memory_ledgers",
+                "memory_vacuous": "vacuous"}),
+            # the numerics/placement jaxpr halves trace real entry
+            # points — skipped under --lint-only (the AST halves still
+            # run jax-free)
+            "numerics": _summary(numerics.run_numerics, {
+                "numerics_checks": "numerics_checks",
+                "numerics_contracts": "numerics_contracts",
+                "numerics_vacuous": "vacuous"}, trace=not lint_only),
+            "placement": _summary(placement.run_placement, {
+                "placement_checks": "placement_checks",
+                "placement_contracts": "placement_contracts",
+                "placement_vacuous": "vacuous"}, trace=not lint_only),
+            "sem": _sem,
+        }
+        for name in PASS_IDS:
+            if name not in selected:
+                continue
+            if name == "sem" and lint_only:
+                continue
+            t0 = time.perf_counter()
+            fs, frag = table[name]()
+            pass_seconds[name] = round(time.perf_counter() - t0, 3)
+            passes_run.append(name)
+            findings.extend(fs)
+            fragments.update(frag)
     finally:
         if added:
             try:
@@ -113,6 +232,7 @@ def run(root: str = None, lint_only: bool = False,
 
     baseline = load_baseline(baseline_path)
     active, suppressed, stale = split_findings(findings, baseline)
+    audits = stale_audits(baseline_path, root)
     return {
         # strict additionally fails on a VACUOUS locks pass (a lock-
         # constructing module with zero guarded regions means the
@@ -140,51 +260,63 @@ def run(root: str = None, lint_only: bool = False,
         # and on a VACUOUS memory contract (a MEMORY_LEDGER none of
         # whose holdings are registered — the HBM ledger went dark for
         # that module's residency)
+        # and on a VACUOUS placement contract (a PLACEMENT_CONTRACT
+        # none of whose holdings/entries resolve to anything live —
+        # placement discipline stopped seeing that module's mesh)
+        # and on STALE AUDIT TAGS (a baseline justification whose
+        # 'audited: PR<n>' tag is missing or older than the last
+        # core.AUDIT_WINDOW PRs — the re-audit ritual lapsed)
         "ok": (not active and not (strict and stale)
-               and not (strict and locks_summary["vacuous"])
-               and not (strict and scope_summary["vacuous"])
-               and not (strict and faults_summary["vacuous"])
-               and not (strict and slo_summary["vacuous"])
-               and not (strict and fleet_summary["vacuous"])
-               and not (strict and watch_summary["vacuous"])
-               and not (strict and timeline_summary["vacuous"])
-               and not (strict and numerics_summary["vacuous"])
-               and not (strict and memory_summary["vacuous"])),
+               and not (strict and audits)
+               and not any(strict and fragments[k]
+                           for k in _VACUOUS_KEYS)),
         "strict": strict,
         "findings": [f.to_dict() for f in active],
         "suppressed": len(suppressed),
+        # per-row suppressed findings (with their baseline
+        # justifications) ride along for the SARIF emitter, which marks
+        # them as externally suppressed rather than dropping them
+        "suppressed_findings": [
+            {**f.to_dict(), "justification": baseline.get(f.key, "")}
+            for f in suppressed],
         "stale_baseline": sorted("::".join(k[1:]) + f" [{k[0]}]"
                                  for k in stale),
-        "semantic_checks": semantic_checks,
-        "sanitize_checks": sanitize_checks,
-        "locks_checks": locks_summary["locks_checks"],
-        "locks_guarded_regions": locks_summary["guarded_regions"],
-        "locks_vacuous": locks_summary["vacuous"],
-        "fault_checks": faults_summary["fault_checks"],
-        "fault_policies": faults_summary["fault_policies"],
-        "fault_vacuous": faults_summary["vacuous"],
-        "scope_checks": scope_summary["scope_checks"],
-        "scope_profiled_regions": scope_summary["profiled_regions"],
-        "scope_vacuous": scope_summary["vacuous"],
-        "slo_checks": slo_summary["slo_checks"],
-        "slo_policies": slo_summary["slo_policies"],
-        "slo_vacuous": slo_summary["vacuous"],
-        "fleet_checks": fleet_summary["fleet_checks"],
-        "fleet_policies": fleet_summary["fleet_policies"],
-        "fleet_vacuous": fleet_summary["vacuous"],
-        "watch_checks": watch_summary["watch_checks"],
-        "watch_signals": watch_summary["watch_signals"],
-        "watch_vacuous": watch_summary["vacuous"],
-        "timeline_checks": timeline_summary["timeline_checks"],
-        "timeline_kinds": timeline_summary["timeline_kinds"],
-        "timeline_vacuous": timeline_summary["vacuous"],
-        "memory_checks": memory_summary["memory_checks"],
-        "memory_ledgers": memory_summary["memory_ledgers"],
-        "memory_vacuous": memory_summary["vacuous"],
-        "numerics_checks": numerics_summary["numerics_checks"],
-        "numerics_contracts": numerics_summary["numerics_contracts"],
-        "numerics_vacuous": numerics_summary["vacuous"],
-        "recompile_bounds": bounds,
+        "stale_audits": audits,
+        "passes_run": passes_run,
+        "pass_seconds": pass_seconds,
+        "semantic_checks": fragments["semantic_checks"],
+        "sanitize_checks": fragments["sanitize_checks"],
+        "locks_checks": fragments["locks_checks"],
+        "locks_guarded_regions": fragments["locks_guarded_regions"],
+        "locks_vacuous": fragments["locks_vacuous"],
+        "fault_checks": fragments["fault_checks"],
+        "fault_policies": fragments["fault_policies"],
+        "fault_vacuous": fragments["fault_vacuous"],
+        "scope_checks": fragments["scope_checks"],
+        "scope_profiled_regions": fragments["scope_profiled_regions"],
+        "scope_vacuous": fragments["scope_vacuous"],
+        "slo_checks": fragments["slo_checks"],
+        "slo_policies": fragments["slo_policies"],
+        "slo_vacuous": fragments["slo_vacuous"],
+        "fleet_checks": fragments["fleet_checks"],
+        "fleet_policies": fragments["fleet_policies"],
+        "fleet_vacuous": fragments["fleet_vacuous"],
+        "watch_checks": fragments["watch_checks"],
+        "watch_signals": fragments["watch_signals"],
+        "watch_vacuous": fragments["watch_vacuous"],
+        "timeline_checks": fragments["timeline_checks"],
+        "timeline_kinds": fragments["timeline_kinds"],
+        "timeline_vacuous": fragments["timeline_vacuous"],
+        "memory_checks": fragments["memory_checks"],
+        "memory_ledgers": fragments["memory_ledgers"],
+        "memory_vacuous": fragments["memory_vacuous"],
+        "numerics_checks": fragments["numerics_checks"],
+        "numerics_contracts": fragments["numerics_contracts"],
+        "numerics_vacuous": fragments["numerics_vacuous"],
+        "placement_checks": fragments["placement_checks"],
+        "placement_contracts": fragments["placement_contracts"],
+        "placement_vacuous": fragments["placement_vacuous"],
+        "recompile_bounds": fragments["recompile_bounds"],
     }
 
 
@@ -372,6 +504,14 @@ def main(argv=None) -> int:
     ap.add_argument("--baseline", default=None,
                     help="baseline file (default: tools/graftcheck/"
                     "baseline.txt)")
+    ap.add_argument("--sarif", action="store_true",
+                    help="emit a SARIF 2.1.0 document instead of text "
+                    "(baseline-suppressed findings ride along marked "
+                    "suppressed)")
+    ap.add_argument("--passes", default=None,
+                    help="comma list of passes to run (default: all): "
+                    + ",".join(PASS_IDS) + " — --strict requires the "
+                    "full set")
     args = ap.parse_args(argv)
 
     # standalone runs stay off any real accelerator: the semantic pass
@@ -379,9 +519,21 @@ def main(argv=None) -> int:
     # directly and keep their own backend config.
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-    payload = run(root=args.root, lint_only=args.lint_only,
-                  baseline_path=args.baseline, strict=args.strict)
-    if args.json:
+    passes = None
+    if args.passes is not None:
+        passes = tuple(p.strip() for p in args.passes.split(",")
+                       if p.strip())
+    try:
+        payload = run(root=args.root, lint_only=args.lint_only,
+                      baseline_path=args.baseline, strict=args.strict,
+                      passes=passes)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if args.sarif:
+        from .sarif import to_sarif
+        print(json.dumps(to_sarif(payload), indent=2))
+    elif args.json:
         print(json.dumps(payload, indent=2, default=str))
     else:
         for f in payload["findings"]:
@@ -389,6 +541,9 @@ def main(argv=None) -> int:
                   f"  (scope: {f['scope']})")
         for s in payload["stale_baseline"]:
             print(f"stale baseline entry (fixed? delete the line): {s}"
+                  + (" [FAIL under --strict]" if args.strict else ""))
+        for s in payload["stale_audits"]:
+            print(f"stale audit tag: {s}"
                   + (" [FAIL under --strict]" if args.strict else ""))
         n = len(payload["findings"])
         print(f"graftcheck: {n} active finding(s), "
@@ -402,7 +557,8 @@ def main(argv=None) -> int:
               f"{payload['watch_checks']} watch checks, "
               f"{payload['timeline_checks']} timeline checks, "
               f"{payload['memory_checks']} memory checks, "
-              f"{payload['numerics_checks']} numerics checks"
+              f"{payload['numerics_checks']} numerics checks, "
+              f"{payload['placement_checks']} placement checks"
               + ("" if args.lint_only else
                  f", recompile bounds for {len(payload['recompile_bounds'])}"
                  " workload(s)"))

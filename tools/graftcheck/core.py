@@ -11,9 +11,19 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Dict, Iterable, List, Set, Tuple
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 Key = Tuple[str, str, str]
+
+# Suppressions age: every baseline justification must carry a
+# machine-checked ``audited: PR<n>`` tag naming the PR that last
+# re-verified it, and --strict fails entries older than the last
+# AUDIT_WINDOW PRs (the prose "re-audited in ISSUE <n>" comments above
+# existed from the start — this makes the ritual checkable).
+AUDIT_WINDOW = 8
+_AUDIT_RE = re.compile(r"audited:\s*PR(\d+)\b")
+_PR_RE = re.compile(r"^PR (\d+):", re.MULTILINE)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,6 +74,55 @@ def load_baseline(path: str = None) -> Dict[Key, str]:
             rule, loc, why = parts
             fpath, _, scope = loc.rpartition("::")
             out[(rule, fpath, scope)] = why
+    return out
+
+
+def baseline_audits(path: str = None) -> Dict[Key, Optional[int]]:
+    """Per-entry ``audited: PR<n>`` tag from each justification —
+    ``None`` for entries that never got one. Same parse (and the same
+    malformed-line ValueError) as :func:`load_baseline`."""
+    out: Dict[Key, Optional[int]] = {}
+    for key, why in load_baseline(path).items():
+        m = _AUDIT_RE.search(why)
+        out[key] = int(m.group(1)) if m else None
+    return out
+
+
+def current_pr(root: str = None) -> Optional[int]:
+    """This checkout's PR number: one past the highest ``PR <n>:``
+    entry in CHANGES.md (the append-only per-PR log). ``None`` when the
+    log is absent or empty — audit staleness can't be judged then."""
+    root = root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    path = os.path.join(root, "CHANGES.md")
+    try:
+        with open(path, encoding="utf-8") as f:
+            nums = [int(m) for m in _PR_RE.findall(f.read())]
+    except OSError:
+        return None
+    return max(nums) + 1 if nums else None
+
+
+def stale_audits(baseline_path: str = None, root: str = None,
+                 window: int = AUDIT_WINDOW) -> List[str]:
+    """Baseline entries whose audit tag is missing or older than the
+    last ``window`` PRs — one formatted row each (empty = every
+    suppression was re-verified recently enough). --strict fails on
+    any row; the relaxed default stays report-only."""
+    cur = current_pr(root)
+    if cur is None:
+        return []
+    out: List[str] = []
+    for (rule, fpath, scope), pr in sorted(baseline_audits(
+            baseline_path).items()):
+        loc = f"{fpath}::{scope} [{rule}]"
+        if pr is None:
+            out.append(f"{loc}: no 'audited: PR<n>' tag — re-verify the "
+                       f"suppression and tag it (current PR {cur})")
+        elif pr <= cur - window:
+            out.append(f"{loc}: audited PR{pr}, but the window is the "
+                       f"last {window} PRs (current PR {cur}) — "
+                       "re-verify and re-tag")
     return out
 
 

@@ -454,6 +454,49 @@ def tp_decode_comm_bytes(config, batch: int, tp: int) -> int:
     return comm_bytes_program(fn, args, {"tp": tp})
 
 
+def kvp_decode_comm_bytes(config, batch: int, kvp: int) -> int:
+    """Comm bytes of one decode token with the paged pool's kv-head
+    plane sharded over ``kvp``: each device attends the (replicated)
+    query against only its resident kv shard — a flash-style PARTIAL
+    softmax (un-normalized o plus log-sum-exp per query head) — then
+    the partials cross the kvp axis once per block (all_gather of
+    ``o [B, Hq, hd]`` f32 + ``lse [B, Hq]`` f32) and combine with the
+    usual max/exp renormalization. Traced as a shard_map stand-in at
+    real avals over an ``AbstractMesh`` and walked like any other
+    program (the tp/ep rationale: the stand-in declares the schedule
+    the pool-plane sharding provably produces)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import AbstractMesh, PartitionSpec as P
+
+    hq = config.n_head
+    hd = config.head_dim
+    mesh = AbstractMesh((("kvp", kvp),))
+
+    def per_device(o_part, lse_part):
+        def body(carry, _):
+            o, lse = carry
+            o_all = jax.lax.all_gather(o, "kvp")       # [kvp, B, Hq, hd]
+            lse_all = jax.lax.all_gather(lse, "kvp")   # [kvp, B, Hq]
+            m = jnp.max(lse_all, axis=0)
+            w = jnp.exp(lse_all - m[None])
+            norm = jnp.sum(w, axis=0)
+            o = jnp.sum(o_all * w[..., None], axis=0) / norm[..., None]
+            lse = m + jnp.log(norm)
+            return (o, lse), None
+        (o, _), _ = jax.lax.scan(body, (o_part, lse_part), None,
+                                 length=config.n_layer)
+        return o
+
+    from llm_sharding_demo_tpu.parallel._shard_compat import shard_map
+    rep = P()
+    fn = shard_map(per_device, mesh=mesh, in_specs=(rep, rep),
+                   out_specs=rep, axis_names={"kvp"})
+    o = jax.ShapeDtypeStruct((batch, hq, hd), jnp.float32)
+    lse = jax.ShapeDtypeStruct((batch, hq), jnp.float32)
+    return comm_bytes_program(fn, (o, lse), {"kvp": kvp})
+
+
 def ep_decode_comm_bytes(config, batch: int, ep: int) -> int:
     """Comm bytes of one expert-parallel decode token: the expert
     dispatch/combine all-to-alls GSPMD derives from the expert-axis
@@ -498,7 +541,7 @@ class Candidate:
     ``utils.config.ServingConfig`` exposes, so a chosen candidate maps
     1:1 onto env vars / an AUTO_PLAN override."""
 
-    topology: str = "single"          # single | pp | tp | ep
+    topology: str = "single"          # single | pp | tp | ep | kvp | kvp-tp
     boundaries: Tuple[int, ...] = ()  # pp stage split (interior bounds)
     batch_mode: str = "admission"
     max_batch: int = 1
@@ -527,8 +570,10 @@ class Candidate:
             "BATCH_MODE": self.batch_mode,
             "MAX_BATCH": str(self.max_batch),
             "PP_DECODE": "1" if self.topology == "pp" else "0",
-            "TP_DECODE": "1" if self.topology == "tp" else "0",
+            "TP_DECODE": "1" if self.topology in ("tp", "kvp-tp") else "0",
             "EP_DECODE": "1" if self.topology == "ep" else "0",
+            "KVP_DECODE": "1" if self.topology in ("kvp", "kvp-tp")
+                          else "0",
             "KV_POOL_BLOCKS": str(self.kv_pool_blocks),
             "KV_BLOCK_SIZE": str(self.kv_block_size),
         }
@@ -575,6 +620,20 @@ def enumerate_candidates(module, config, mesh_axes: Dict[str, int],
                 mode = "iter" if mb > 1 else "admission"
                 out.append(Candidate(topo, bounds, mode, mb,
                                      kv_pool_blocks, kv_block_size))
+    # kvp: the paged pool's kv-head plane sharded over its own mesh axis
+    # (multi-axis rows — kvp alone with replicated params, or kvp x tp
+    # with the descriptor-derived param sharding on top). There is
+    # nothing to shard without a pool, and the pool composes at
+    # MAX_BATCH=1 admission outside the iter loop, so these rows carry
+    # exactly that shape; divisibility/pspec legality is gate_candidate's
+    # job as always (an indivisible kv-head count shows up as a rejected
+    # row with diagnostics, not a missing one).
+    if mesh_axes.get("kvp", 0) > 1 and kv_pool_blocks:
+        out.append(Candidate("kvp", (), "admission", 1,
+                             kv_pool_blocks, kv_block_size))
+        if mesh_axes.get("tp", 0) > 1 and not hasattr(config, "n_experts"):
+            out.append(Candidate("kvp-tp", (), "admission", 1,
+                                 kv_pool_blocks, kv_block_size))
     return out
 
 
@@ -606,9 +665,10 @@ def gate_candidate(module, config, cand: Candidate,
               f"{type(config).__name__} is window-dependent (capacity "
               "routing); iter scheduling / paged KV serve dense families")
     if cand.kv_pool_blocks:
-        guard(cand.topology == "single",
-              "KV_POOL_BLOCKS drives the single-device engine's paged "
-              "storage; PP/EP/TP_DECODE keep contiguous caches")
+        guard(cand.topology in ("single", "kvp", "kvp-tp"),
+              "KV_POOL_BLOCKS drives the paged engine's storage (single "
+              "or kvp-sharded pool planes); PP/EP/TP_DECODE keep "
+              "contiguous caches")
         guard(cand.max_batch == 1 or cand.batch_mode == "iter",
               "KV_POOL_BLOCKS batches through BATCH_MODE=iter")
         guard(max_seq % cand.kv_block_size == 0,
@@ -633,6 +693,34 @@ def gate_candidate(module, config, cand: Candidate,
             guard(v % ep == 0,
                   f"EP_DECODE: {field}={v} not divisible by the "
                   f"{ep}-device ep axis")
+    if cand.topology in ("kvp", "kvp-tp"):
+        kvp = mesh_axes.get("kvp", 1)
+        guard(cand.kv_pool_blocks > 0,
+              "KVP_DECODE shards the paged pool's kv-head plane; it "
+              "requires KV_POOL_BLOCKS")
+        fields = desc.get("kvp_divisors")
+        if fields is None:
+            # a family that never declared which config field the
+            # kvp axis divides is unreviewable, not implicitly legal
+            guard(False,
+                  f"KVP_DECODE: {type(config).__name__}'s family "
+                  "declares no kvp_divisors in its SHARDING_DESCRIPTOR "
+                  "— the pool-plane sharding is unreviewable")
+        else:
+            for field in fields:
+                v = getattr(config, field)
+                guard(v % kvp == 0,
+                      f"KVP_DECODE: {field}={v} not divisible by the "
+                      f"{kvp}-device kvp axis (pool planes shard whole "
+                      "kv heads)")
+        if cand.topology == "kvp-tp":
+            tp = mesh_axes.get("tp", 1)
+            for field in desc.get("tp_divisors", ()):
+                v = getattr(config, field)
+                guard(v % tp == 0,
+                      f"TP_DECODE: {field}={v} not divisible by the "
+                      f"{tp}-device tp axis (attention shards whole "
+                      "heads)")
     if findings:
         return findings, None
 
@@ -643,10 +731,24 @@ def gate_candidate(module, config, cand: Candidate,
             module, config, cand.boundaries, max_seq=min(max_seq, 32),
             where=where))
         findings.extend(semantic.check_ring_program(cand.n_stages, where))
-    if cand.topology in ("tp", "ep"):
+    if cand.topology in ("tp", "ep", "kvp-tp"):
         pspecs = derive_pspecs(module, config, mesh_axes)
         findings.extend(semantic.check_pspec_tree(
             pspecs, param_avals(module, config), mesh_axes, where))
+    if cand.topology in ("kvp", "kvp-tp"):
+        # the pool-plane spec itself through the SAME pspec validity
+        # checks every hand-written spec goes through (placement.
+        # check_pspec — the relocated single source of truth): the
+        # [L, NB+1, 2, Hkv, bs, hd] planes shard whole kv heads (dim 3)
+        # over kvp and nothing else
+        from jax.sharding import PartitionSpec as P
+        from .placement import check_pspec
+        heads = getattr(config, "n_kv_head", config.n_head)
+        plane = (config.n_layer, cand.kv_pool_blocks + 1, 2, heads,
+                 cand.kv_block_size, config.head_dim)
+        findings.extend(check_pspec(
+            P(None, None, None, "kvp"), plane, mesh_axes,
+            f"{where}:pool-plane"))
     if cand.kv_pool_blocks:
         heads = getattr(config, "n_kv_head", config.n_head)
         findings.extend(semantic.check_paged_contracts(
@@ -759,7 +861,11 @@ def count_programs(cand: Candidate, max_seq: int,
     calls = traffic_calls(traffic, cand.max_batch)
     if cand.kv_pool_blocks:
         paged = R.PagedDesc(max_seq=max_seq, block_size=cand.kv_block_size)
-        return R.certify_paged(desc, paged, calls), True
+        # kvp rows shard the same paged movers: the population is the
+        # certified single-device one, but not yet pinned against a
+        # live kvp-mesh jit cache — estimate, like pp
+        return (R.certify_paged(desc, paged, calls),
+                cand.topology == "single")
     if cand.topology == "pp":
         keys_p, keys_d = set(), set()
         for call in calls:
@@ -785,8 +891,10 @@ def score_candidate(module, config, cand: Candidate,
     eff_batch = max(1, min(cand.max_batch, conc))
     avals = param_avals(module, config)
 
-    # params per device
-    if cand.topology in ("tp", "ep") and pspecs is not None:
+    # params per device (pure kvp leaves params replicated — only the
+    # pool planes shard; kvp-tp layers the descriptor-derived tp
+    # sharding on top)
+    if cand.topology in ("tp", "ep", "kvp-tp") and pspecs is not None:
         row.param_bytes_per_device = per_device_param_bytes(
             avals, pspecs, mesh_axes)
     elif cand.topology == "pp":
@@ -801,9 +909,17 @@ def score_candidate(module, config, cand: Candidate,
 
     # KV state per device (the rows the config keeps resident)
     if cand.kv_pool_blocks:
-        row.kv_bytes_per_device = kv_pool_bytes(
-            config, cand.kv_pool_blocks, cand.kv_block_size)
+        pool = kv_pool_bytes(config, cand.kv_pool_blocks,
+                             cand.kv_block_size)
         kv_row = kv_cache_bytes(config, 1, max_seq)
+        if cand.topology in ("kvp", "kvp-tp"):
+            # pool planes shard whole kv heads over kvp: resident HBM
+            # AND the per-token read stream both divide exactly (the
+            # divisor gate already proved Hkv % kvp == 0)
+            kvp = mesh_axes.get("kvp", 1)
+            pool //= kvp
+            kv_row //= kvp
+        row.kv_bytes_per_device = pool
     else:
         kv_all = kv_cache_bytes(config, eff_batch, max_seq)
         if cand.topology == "pp":
@@ -829,6 +945,15 @@ def score_candidate(module, config, cand: Candidate,
     elif cand.topology == "ep":
         row.comm_bytes_per_token = ep_decode_comm_bytes(
             config, eff_batch, mesh_axes["ep"])
+    elif cand.topology == "kvp":
+        row.comm_bytes_per_token = kvp_decode_comm_bytes(
+            config, eff_batch, mesh_axes["kvp"])
+    elif cand.topology == "kvp-tp":
+        # the two axes' schedules compose additively: per block the tp
+        # psums AND the kvp partial-softmax gather both cross the ICI
+        row.comm_bytes_per_token = (
+            kvp_decode_comm_bytes(config, eff_batch, mesh_axes["kvp"])
+            + tp_decode_comm_bytes(config, eff_batch, mesh_axes["tp"]))
 
     row.act_bytes = peak_activation_bytes(module, config, eff_batch,
                                           min(max_seq, 128))

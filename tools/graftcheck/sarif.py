@@ -1,0 +1,63 @@
+"""SARIF 2.1.0 emitter for the verifier payload (``--sarif``).
+
+One run, one driver (``graftcheck``), one rule per distinct finding
+rule id, one result per finding with a ``file:line`` physical
+location. Baseline-suppressed findings are NOT dropped: they ride
+along as results carrying a ``suppressions`` entry (kind
+``external``, the baseline justification as the note), which is how
+SARIF viewers and code-scanning UIs render "known, accepted" — the
+same information the text mode folds into the ``N baselined``
+counter. The schema pin (``$schema``/``version`` and the result
+shape) is tested in tests/test_graftcheck.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+SARIF_VERSION = "2.1.0"
+
+
+def _result(f: dict, suppressed: bool) -> dict:
+    out = {
+        "ruleId": f["rule"],
+        "level": "note" if suppressed else "error",
+        "message": {"text": f["message"]},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f["path"]},
+                "region": {"startLine": max(1, int(f["line"]))},
+            },
+        }],
+    }
+    if suppressed:
+        out["suppressions"] = [{
+            "kind": "external",
+            "justification": f.get("justification", ""),
+        }]
+    return out
+
+
+def to_sarif(payload: dict) -> dict:
+    """``cli.run``'s payload -> one SARIF 2.1.0 document."""
+    results: List[dict] = []
+    rules: Dict[str, dict] = {}
+    for f in payload.get("findings", ()):
+        rules.setdefault(f["rule"], {"id": f["rule"]})
+        results.append(_result(f, suppressed=False))
+    for f in payload.get("suppressed_findings", ()):
+        rules.setdefault(f["rule"], {"id": f["rule"]})
+        results.append(_result(f, suppressed=True))
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftcheck",
+                "rules": [rules[k] for k in sorted(rules)],
+            }},
+            "results": results,
+        }],
+    }
