@@ -2531,6 +2531,72 @@ def main() -> None:
 
     safe("hbm_attribution", cfg_hbm_attribution)
 
+    def cfg_trend_detection():
+        """grafttrend seeded detection row (ISSUE 19): the plan-switch
+        traffic mix (serial -> open burst -> serial, agentic profile)
+        against the AUTO_PLAN_CONTINUOUS app with a dedicated
+        TrendReducer polling the live registry between phases —
+        journals whether the seeded burst tripped a declared watch
+        (burst_detected, gated higher-better: a reducer that stops
+        seeing its pinned burst went blind) and the alerts fired
+        during the QUIET serial phases (false_positives, gated
+        lower-better: a watch that pages on healthy traffic is worse
+        than no watch). Seed-pinned arrivals make both trajectories,
+        not noise.
+
+        Needs the bench chip: on CPU the decode dominates and the
+        open burst saturates the host, so the quiet phases would trip
+        latency watches on machine noise, not traffic shape.
+        """
+        import jax
+
+        if jax.default_backend() != "tpu":
+            return {"skipped": "trend detection needs the bench chip "
+                               "(on CPU the open burst saturates the "
+                               "host and the quiet phases trip "
+                               "latency watches on machine noise, "
+                               "not traffic shape)"}
+
+        from llm_sharding_demo_tpu import loadgen
+        from llm_sharding_demo_tpu.utils import grafttrend
+        from tools.graftload import build_demo_app
+
+        seed, n_requests = 7, 10
+        prof = loadgen.profile("agentic")
+        sched = loadgen.schedule(prof, seed, n_requests)
+        classes = sorted({(len(a.prompt.encode("utf-8")), a.max_new)
+                          for a in sched})
+        traffic = ",".join(f"{p}/{n}" for p, n in classes)
+        client, recorder, reg = build_demo_app(
+            max_seq=256, max_batch=4,
+            recorder_capacity=max(64, 8 * n_requests),
+            continuous=True, auto_plan_traffic=traffic)
+        red = grafttrend.TrendReducer(registry=reg, blackbox=False)
+
+        def run_phase(mode, rate=1.0):
+            rep = loadgen.run_load(client, prof, seed=seed,
+                                   n=n_requests, mode=mode,
+                                   rate_scale=rate, recorder=recorder,
+                                   trend=red)
+            return rep["trend"]["alerts_fired"]
+
+        red.poll()                    # seed histogram/counter cursors
+        quiet1 = run_phase("serial")          # quiet: stays solo
+        burst_alerts = run_phase("open", rate=60.0)  # the seeded burst
+        quiet2 = run_phase("serial")          # drain: quiet again
+        false_pos = quiet1 + quiet2
+        return {
+            "seed": seed,
+            "requests_per_run": n_requests,
+            "watches_declared": len(grafttrend.WATCH_POLICY),
+            "burst_detected": int(burst_alerts > 0),
+            "burst_alerts": burst_alerts,
+            "false_positives": false_pos,
+            "tripped": sorted({a["watch"] for a in red.alerts()}),
+        }
+
+    safe("trend_detection", cfg_trend_detection)
+
     def cfg_bench_diff():
         """Perf-regression verdict (ISSUE 9, tools/bench_diff.py): THIS
         run's rows so far compared against the committed BENCH_r*.json

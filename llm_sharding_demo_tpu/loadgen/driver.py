@@ -150,12 +150,17 @@ def _join_traces(outcomes: List[Outcome], recorder) -> None:
 def run_load(client, profile: WorkloadProfile, seed: int, n: int,
              rate_scale: float = 1.0, mode: str = "open",
              width: int = 4, recorder=None,
-             join_timeout_s: float = 300.0) -> dict:
+             join_timeout_s: float = 300.0, trend=None) -> dict:
     """Drive ``n`` scheduled arrivals of ``(seed, profile)`` at the
     app behind ``client`` and return the reduced load report (see
     module docstring). ``recorder`` is the app's FlightRecorder (pass
     the instance handed to ``create_app`` so the TTFT/TPOT join sees
-    every request; size it >= n)."""
+    every request; size it >= n). ``trend`` is an optional
+    ``grafttrend.TrendReducer``: the driver polls it once after the
+    run drains and evaluates the declared watches, so a load run
+    doubles as ONE trend observation window — the report gains a
+    ``trend`` block naming the watches THIS run tripped (the bench
+    ``trend_detection`` row's quiet-vs-burst split rides on it)."""
     if mode not in ("open", "closed", "serial"):
         raise ValueError(f"unknown load mode {mode!r}")
     arrivals = schedule(profile, seed, n, rate_scale)
@@ -224,6 +229,11 @@ def run_load(client, profile: WorkloadProfile, seed: int, n: int,
                        width=(1 if mode == "serial" else width),
                        horizon_s=(horizon_s if mode == "open" else None))
     report["occupancy"] = occupancy_summary(since_ms=occ_since)
+    if trend is not None:
+        trend.poll()
+        trips = trend.evaluate()
+        report["trend"] = {"alerts_fired": len(trips),
+                           "tripped": [a["watch"] for a in trips]}
     return report
 
 

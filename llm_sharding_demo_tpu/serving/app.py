@@ -42,7 +42,8 @@ from ..models import gpt2
 from ..parallel import partition as P_
 from ..parallel.pipeline import PipelineRunner
 from ..runtime.engine import REF_TEMPERATURE, REF_TOP_K, SamplingConfig
-from ..utils import graftfault, graftmem, graftshard, grafttime, tracing
+from ..utils import graftfault, graftmem, graftshard, grafttime, \
+    grafttrend, tracing
 from ..utils.config import ServingConfig, from_env
 from ..utils.metrics import REGISTRY
 from ..utils.tracing import timed
@@ -188,6 +189,12 @@ def create_app(cfg: Optional[ServingConfig] = None,
     replica_label = replica or cfg.fleet_role or "solo"
     reg = registry if registry is not None else REGISTRY
     rec = recorder if recorder is not None else tracing.RECORDER
+    # Trend & drift watch (utils/grafttrend): one reducer per app,
+    # folded over THIS app's registry — the poll-on-read loop (every
+    # GET /debug/trend taps the producers and evaluates the declared
+    # WATCH_POLICY), plus the wave-boundary tap when continuous
+    # planning attaches it below.
+    trend_reducer = grafttrend.TrendReducer(registry=reg)
     # multi-host glue sits HERE, where every entry path converges (CLI,
     # `serving.app:app` lazy attribute, tests) — it must run before the
     # first backend use, i.e. before the model loads. No-op when the
@@ -531,6 +538,11 @@ def create_app(cfg: Optional[ServingConfig] = None,
             switcher = graftwatch.PlanSwitcher(
                 plans, plan_cost_map, certified, watcher,
                 weights=weights, registry=reg)
+            # between waves the switcher polls the trend reducer and
+            # sizes the declared SIZING_POLICY knobs from its windowed
+            # occupancy estimate (zero-recompile, byte-equal — see
+            # graftwatch.attach_trend)
+            switcher.attach_trend(trend_reducer)
             log.info('{"event": "auto_plan_continuous", "plans": %s, '
                      '"active": "%s", "weights": "%s"}',
                      sorted(plans), switcher.health_view()["active"],
@@ -849,6 +861,11 @@ def create_app(cfg: Optional[ServingConfig] = None,
             **live,
             "status": "ok",
             "graftshard": shard_status,
+            # trend-watch state (utils/grafttrend): declared watch
+            # count, evaluation count, and any LATCHED trips — a page
+            # that fired is visible on the health probe, not only on
+            # the debug surface
+            "trend": trend_reducer.health_view(),
             **_topology(),
             "devices": [str(d) for d in jax.devices()],
         }
@@ -955,7 +972,12 @@ def create_app(cfg: Optional[ServingConfig] = None,
                 "/debug/timeline": (
                     "grafttime unified causal event stream, one clock "
                     "over spans/dispatches/faults/plan switches "
-                    "(?rid=, ?since=, ?kinds=, ?n=)"),
+                    "(?rid=, ?since=, ?since_seq=, ?kinds=, ?n=)"),
+                "/debug/trend": (
+                    "grafttrend watch state: declared WATCH_POLICY "
+                    "verdicts, windowed series reductions, alert "
+                    "journal, refit history (?eval=0 reads without "
+                    "polling/evaluating)"),
                 "/debug/memory": (
                     "graftmem HBM ledger: per-component live bytes, "
                     "peaks, per-device attribution, pool geometry, "
@@ -974,6 +996,22 @@ def create_app(cfg: Optional[ServingConfig] = None,
         n. Export the payload with ``python -m tools.grafttime
         export`` for chrome://tracing / Perfetto."""
         return grafttime.debug_timeline_payload(query, _topology())
+
+    @app.get("/debug/trend")
+    def debug_trend(query: dict):
+        """Trend & drift watch state (utils/grafttrend): per-watch
+        verdicts against the declared WATCH_POLICY, windowed series
+        reductions (rate, p50/p99 sketch), the bounded alert journal,
+        and the refit history. The default GET is the poll-on-read
+        loop: it taps the live producers (registry histogram buckets,
+        counters, gauges) and EVALUATES the watches — scraping this
+        surface is the alerting cadence (trips latch, so repeated
+        scrapes of a sustained burn alert once). ``?eval=0`` reads
+        the current state without polling or evaluating."""
+        if query.get("eval", "1") != "0":
+            trend_reducer.poll()
+            trend_reducer.evaluate()
+        return {"serving": _topology(), **trend_reducer.describe()}
 
     @app.post("/prefill")
     def prefill(req: PrefillReq, headers: dict):
@@ -1553,6 +1591,7 @@ def create_app(cfg: Optional[ServingConfig] = None,
     # (tests reach the certified plan set and the event journal through
     # the app object; the wire surface is GET /debug/plan)
     app.plan_switcher = switcher
+    app.trend_reducer = trend_reducer
     return app
 
 

@@ -118,6 +118,8 @@ EVENT_KINDS = {
     "mem_alloc":      "a graftmem ledger holding grew (byte delta + "
                       "component total)",
     "mem_free":       "a graftmem ledger holding shrank or retired",
+    "trend_alert":    "a declared grafttrend watch tripped (burn/"
+                      "drift/level)",
 }
 
 # kind -> keyword arguments an emit SITE must spell out (values may be
@@ -143,6 +145,7 @@ KIND_FIELDS = {
     "plan_switch":    ("to_plan",),
     "mem_alloc":      ("component", "bytes"),
     "mem_free":       ("component", "bytes"),
+    "trend_alert":    ("watch", "severity"),
 }
 
 # Replay contract: fields that carry wall-clock/interleaving truth and
@@ -311,14 +314,20 @@ class TimelineBus:
     def events(self, rid: Optional[str] = None,
                since: Optional[float] = None,
                kinds: Optional[Iterable[str]] = None,
-               n: Optional[int] = None) -> List[dict]:
+               n: Optional[int] = None,
+               since_seq: Optional[int] = None) -> List[dict]:
         """Filtered copy of the stream in CLOCK order (ts, seq-broken
         ties), oldest first — producers may backdate an event to an
         already-measured instant (a scheduler stamping a window it
         timed itself), so append order alone is not the causal order;
         the one clock is. ``rid`` matches the event's ``rid`` or
         membership in its ``rids``; ``since`` is an exclusive ``ts``
-        lower bound (ms on the bus clock); ``kinds`` keeps only those
+        lower bound (ms on the bus clock); ``since_seq`` is an
+        exclusive lower bound on the monotonic emission sequence — the
+        incremental-poll cursor: pass the previous payload's
+        ``cursor`` and only events emitted after it come back (a
+        backdated event emitted late is still delivered, which the
+        ts-based ``since`` would skip); ``kinds`` keeps only those
         kinds; ``n`` caps to the NEWEST n after filtering."""
         with self._lock:
             evs = list(self._events)
@@ -331,6 +340,8 @@ class TimelineBus:
                    if e.get("rid") == rid or rid in e.get("rids", ())]
         if since is not None:
             evs = [e for e in evs if e["ts"] > since]
+        if since_seq is not None:
+            evs = [e for e in evs if e["seq"] > since_seq]
         if kinds is not None:
             keep = set(kinds)
             evs = [e for e in evs if e["kind"] in keep]
@@ -342,10 +353,17 @@ class TimelineBus:
     def snapshot(self, rid: Optional[str] = None,
                  since: Optional[float] = None,
                  kinds: Optional[Iterable[str]] = None,
-                 n: Optional[int] = None) -> dict:
+                 n: Optional[int] = None,
+                 since_seq: Optional[int] = None) -> dict:
         """The ``/debug/timeline`` payload body: the filtered stream
-        plus the clock header a consumer needs to join or rebase it."""
-        evs = self.events(rid=rid, since=since, kinds=kinds, n=n)
+        plus the clock header a consumer needs to join or rebase it.
+        ``cursor`` echoes the newest emission sequence at snapshot
+        time — feed it back as ``since_seq`` and the next poll returns
+        only the increment (events whose seq rotated out of the ring
+        between polls are honestly gone; ``dropped`` rising between
+        polls is the gap detector)."""
+        evs = self.events(rid=rid, since=since, kinds=kinds, n=n,
+                          since_seq=since_seq)
         with self._lock:
             emitted = self._seq
             held = len(self._events)
@@ -353,6 +371,8 @@ class TimelineBus:
             "enabled": enabled(),
             "capacity": self.capacity,
             "emitted_total": emitted,
+            "cursor": emitted,
+            "since_seq": since_seq,
             "dropped": max(emitted - held, 0),
             "clock": {
                 "epoch_unix": round(self.epoch_unix, 6),
@@ -617,7 +637,8 @@ def sample_event(kind: str) -> dict:
              "value": 1.0, "wait_ms": 0.1, "site": "mod.site",
              "fault": "kindname", "state": "closed", "blocks": 1,
              "reason": "preempt", "to_plan": "solo", "dur_ms": 0.5,
-             "component": "params", "bytes": 1}
+             "component": "params", "bytes": 1,
+             "watch": "slo_ttft_burn", "severity": "page"}
     for f in KIND_FIELDS.get(kind, ()):
         ev[f] = fills[f]
     if kind in _WINDOW_KINDS:
@@ -630,13 +651,15 @@ def sample_event(kind: str) -> dict:
 
 def debug_timeline_payload(query: dict, serving: dict):
     """The ``GET /debug/timeline`` response body (``?rid=``,
-    ``?since=``, ``?kinds=``, ``?n=``) — ONE implementation shared by
-    the replica surface (serving/app.py) and the fleet router
-    (serving/router.py), the ``tracing.debug_requests_payload``
-    discipline: a new filter cannot land on one debug surface and
-    silently desynchronize the other. ``serving`` is the per-app
-    identity block. Returns ``(422, detail)`` on an unparseable or
-    out-of-vocabulary filter."""
+    ``?since=``, ``?since_seq=``, ``?kinds=``, ``?n=``) — ONE
+    implementation shared by the replica surface (serving/app.py) and
+    the fleet router (serving/router.py), the
+    ``tracing.debug_requests_payload`` discipline: a new filter cannot
+    land on one debug surface and silently desynchronize the other.
+    ``serving`` is the per-app identity block. ``since_seq`` is the
+    incremental-poll cursor: pass the previous payload's ``cursor``
+    back and only newer emissions return. Returns ``(422, detail)`` on
+    an unparseable or out-of-vocabulary filter."""
     since = query.get("since")
     if since is not None:
         try:
@@ -644,6 +667,13 @@ def debug_timeline_payload(query: dict, serving: dict):
         except ValueError:
             return 422, {"detail": "since must be a number (ms on the "
                                    "bus clock)"}
+    since_seq = query.get("since_seq")
+    if since_seq is not None:
+        try:
+            since_seq = int(since_seq)
+        except ValueError:
+            return 422, {"detail": "since_seq must be an integer "
+                                   "(the previous payload's cursor)"}
     kinds = None
     if query.get("kinds"):
         kinds = [k.strip() for k in query["kinds"].split(",")
@@ -661,7 +691,7 @@ def debug_timeline_payload(query: dict, serving: dict):
     return {
         "serving": serving,
         **BUS.snapshot(rid=query.get("rid") or None, since=since,
-                       kinds=kinds, n=n),
+                       kinds=kinds, n=n, since_seq=since_seq),
     }
 
 

@@ -1,0 +1,863 @@
+"""grafttrend: streaming telemetry reducer + declared burn-rate/drift watches.
+
+The dynamic half of the graftcheck trend pass (``tools/graftcheck/
+trend.py`` is the static half — the same static+dynamic split as
+graftsan/graftlock/graftload/graftwatch/graftmem/graftshard, applied at
+the TREND level). The spine *produces* rich telemetry — graftscope
+occupancy series, graftmem ledger drift, the SLO source histograms,
+breaker gauges, grafttime events — and until now consumed it passively:
+``costmodel.calibrate`` read journals only at startup, graftwatch
+routed but did not size, and black-box dumps fired only on typed
+failures. An SLO burn or a measured-vs-modeled byte drift was invisible
+until a bench run. This module closes that loop in-process.
+
+**The reducer** (:class:`TrendReducer`): a bounded, lock-disciplined
+streaming fold over the existing producers. Samples enter either
+through :meth:`TrendReducer.observe` (the seeded/test path — a pure
+``(series, value, weight, t_ms)`` record) or :meth:`TrendReducer.poll`
+(the live tap: registry histogram buckets behind loadgen's
+``SLO_SOURCE_METRICS``, the deadline-miss/request counter pair, the
+``queue_depth``/``hop_breaker_open`` gauges, and graftmem
+``reconcile`` drift when a plan row is supplied). Every series keeps a
+bounded window of ``(t_ms, value, weight)`` points; reductions
+(windowed rate, p50/p99 sketch over the bounded window, EWMA drift)
+are pure functions of the stored samples and the evaluation instant.
+
+**The declared contract**: ``WATCH_POLICY = {watch: (series, window,
+threshold, severity)}`` — a dict literal the static trend pass scans,
+exactly like ``SLO_POLICY``/``FAULT_POLICY``/``GUARDED_STATE``. Three
+watch modes, classified by the series (``watch_mode``):
+
+- **burn** (SLO source series): multi-window burn-rate. ``window`` is
+  ``(short_ms, long_ms)``; the burn rate in a window is the violating
+  fraction divided by the declared error budget (the loosest
+  ``SLO_POLICY`` target/percentile for that series — a burn against
+  the loosest declared promise is a burn under every declared
+  promise), and the watch trips only when BOTH windows burn past
+  ``threshold`` (the SRE multi-window rule: the short window makes the
+  alert fast, the long window keeps a blip from paging).
+- **drift** (derived measured-vs-modeled series, ``DERIVED_SERIES``):
+  EWMA of the drift values inside ``window`` against ``threshold``.
+- **level** (catalog gauges): windowed mean against ``threshold``.
+
+**Alerting**: a trip emits a typed ``trend_alert`` event on the
+grafttime bus, increments ``trend_alerts_total{watch,severity}``, and
+triggers a grafttime black-box dump — the events that LED to the trip
+outlive the ring. Trips LATCH per watch: a sustained burn alerts
+exactly once until the watch evaluates clean again (hysteresis — the
+seeded fixtures pin exactly one alert per episode). Alert evaluation
+is replay-identical: the alert record minus its wall-clock field is a
+pure function of the observed samples and the evaluation instant, so
+two seeded GRAFTSCHED runs serialize byte-identically
+(:meth:`TrendReducer.alerts` with ``strip_time=True``).
+
+**The refit loop** (:func:`refit`): re-fits the cost-model byte
+weights from the LIVE graftscope attribution rings — the exact
+least-squares ``graftwatch.fit_cost_weights`` runs over journal rows,
+fed by :func:`live_attribution_journal` instead of a startup file —
+publishes the fitted weight as the ``costmodel_byte_weight`` gauge,
+and threads it into the switcher's scoring between waves
+(``PlanSwitcher.set_weights``). The PR 11 golden is preserved by
+construction: ``score_plans`` is linear in the ICI weight, so a weight
+change shifts every plan score by exactly ``(w' - w) * comm_bytes``,
+and weights are pure scoring inputs — a refit can never mint a
+compiled program, so plan switches stay inside the pre-certified
+zero-recompile envelope.
+
+Everything is served at ``GET /debug/trend`` (+ the ``/healthz``
+``trend`` block) by serving/app.py.
+"""
+
+from __future__ import annotations
+
+import bisect
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from . import graftsched, graftscope, grafttime
+
+# Lock-discipline contract (tools/graftcheck locks pass): the sample
+# windows, alert ring, per-watch latches, poll cursors, and refit
+# journal are touched from arbitrary handler/poller threads; all live
+# under the owning reducer's ``_lock``. External producers (registry,
+# graftscope, graftmem) are read BEFORE the hold is taken — the
+# reducer's lock never nests inside or around a foreign lock.
+GUARDED_STATE = {"_samples": "_lock", "_alerts": "_lock",
+                 "_latched": "_lock", "_evals": "_lock",
+                 "_cursors": "_lock", "_refits": "_lock"}
+LOCK_ORDER = ("_lock",)
+
+# Timeline contract (tools/graftcheck timeline pass): every watch trip
+# lands on the unified causal stream, so the telemetry that provoked an
+# alert is visible on the same clock as the alert itself.
+TIMELINE_EVENTS = {
+    "trend_alert": "TrendReducer.evaluate",
+}
+
+# The fixed severity vocabulary (the trend pass rejects anything else):
+# "page" wakes a human, "ticket" files work.
+SEVERITIES = ("page", "ticket")
+
+# Derived series: trend inputs that are COMPUTED from producer pairs
+# rather than emitted as catalog metrics. Each entry documents its
+# provenance; the trend pass's watch-without-source rule accepts a
+# watch on a derived series only when it is declared here (and flags a
+# derived series no watch consumes — a dead declaration).
+DERIVED_SERIES = {
+    "graftmem_params_drift":
+        "graftmem.reconcile components.params drift — |measured/"
+        "predicted - 1| of live ledger param bytes vs the cost model's "
+        "aval arithmetic (fed by TrendReducer.poll(plan_row=...))",
+    "graftmem_kv_drift":
+        "graftmem.reconcile components.kv drift — |measured/predicted "
+        "- 1| of live pool/cache bytes vs the planned KV footprint "
+        "(fed by TrendReducer.poll(plan_row=...))",
+    "costmodel_weight_drift":
+        "|fitted ici_byte_weight / a-priori ICI_BYTE_WEIGHT - 1| — how "
+        "far the live refit has moved the cost model off its prior "
+        "(fed by grafttrend.refit)",
+}
+
+# THE declared watch contract: {watch: (series, window, threshold,
+# severity)}. ``series`` is a METRIC_CATALOG name or a DERIVED_SERIES
+# key; ``window`` is (short_ms, long_ms) for burn watches and a single
+# window_ms for drift/level; ``threshold`` is the burn multiple /
+# drift bound / level bound; ``severity`` is from SEVERITIES. The
+# static trend pass verifies every SLO_POLICY metric's source series
+# is covered by a live watch (slo-without-watch), every watch names a
+# known+emitted series (watch-without-source), and every entry is
+# well-formed (malformed-watch); an empty policy fails --strict as
+# vacuous. Thresholds are against the tiny CPU test model and
+# deliberately loose — the contract is the SHAPE (which series, which
+# windows); tightening per deployment is a config edit.
+WATCH_POLICY = {
+    # multi-window SLO burn-rate watches, one per SLO source series
+    # (loadgen.profiles.SLO_SOURCE_METRICS): trip when the violating
+    # fraction burns the declared error budget at >= threshold x in
+    # BOTH windows
+    "slo_ttft_burn": ("ttft_seconds", (10_000.0, 60_000.0), 2.0,
+                      "page"),
+    "slo_tpot_burn": ("tpot_seconds", (10_000.0, 60_000.0), 2.0,
+                      "page"),
+    "slo_e2e_burn": ("generate_request_seconds",
+                     (10_000.0, 60_000.0), 2.0, "page"),
+    "slo_deadline_burn": ("deadline_misses_total",
+                          (10_000.0, 60_000.0), 2.0, "page"),
+    # measured-vs-modeled relative-drift watches over the graftmem
+    # reconcile pairs (bench_diff gates the same drift lower-better in
+    # the hbm_attribution row — this is the live, between-bench watch)
+    "hbm_params_drift": ("graftmem_params_drift", 60_000.0, 0.10,
+                         "ticket"),
+    "hbm_kv_drift": ("graftmem_kv_drift", 60_000.0, 0.25, "ticket"),
+    # the refit loop watching itself: a fitted weight far off the
+    # a-priori prior means the host's byte economics moved (or the
+    # attribution inputs went bad) — either way a human should look
+    "cost_weight_drift": ("costmodel_weight_drift", 300_000.0, 0.50,
+                          "ticket"),
+    # level watches over live-state gauges: a breaker that stays open
+    # across the window, and a queue holding deeper than the declared
+    # surge bound
+    "breaker_stuck_open": ("hop_breaker_open", 30_000.0, 0.5, "page"),
+    "queue_depth_surge": ("queue_depth", 30_000.0, 16.0, "ticket"),
+}
+
+# Declared sizing contract (the ROADMAP item-7 "routes but doesn't
+# size" follow-on): {knob: (source_series, min_scale, max_scale)}.
+# Between waves the switcher reads the reducer's windowed occupancy
+# estimate for the source series and scales the knob's BASE value by
+# estimate/capacity, clamped to [min_scale, max_scale] x base. Both
+# knobs are pure scheduling parameters — neither keys a compiled
+# program (zero-recompile by construction) nor changes any emitted
+# token (greedy decode is batch-wait independent; the byte-equality
+# pin in tests/test_grafttrend.py holds sized == unsized per request).
+SIZING_POLICY = {
+    "batch_wait_ms": ("queue_depth", 0.5, 4.0),
+    "queue_limit": ("queue_depth", 1.0, 4.0),
+}
+
+# bounded state: a ring, never a log
+SAMPLE_CAPACITY = 1024      # points per series
+ALERT_CAPACITY = 128        # alert journal
+REFIT_CAPACITY = 16         # refit journal
+# EWMA smoothing for drift watches (deterministic: folded over the
+# windowed samples in t_ms order)
+DRIFT_ALPHA = 0.3
+
+
+class WatchPolicyError(ValueError):
+    """A malformed watch declaration reached the reducer — the dynamic
+    half of the trend pass's malformed-watch rule."""
+
+
+def watch_mode(series: str) -> str:
+    """'burn' | 'drift' | 'level' for a watched series. SLO source
+    series get multi-window burn-rate, declared derived series get
+    EWMA drift, everything else (catalog gauges) gets a windowed-level
+    check."""
+    from ..loadgen import profiles
+    if series in profiles.SLO_SOURCE_METRICS.values():
+        return "burn"
+    if series in DERIVED_SERIES:
+        return "drift"
+    return "level"
+
+
+def slo_budget(series: str) -> Tuple[float, float]:
+    """``(target, budget_fraction)`` for an SLO source series — the
+    LOOSEST declared target across SLO_POLICY profiles (the reducer is
+    profile-agnostic: a sample stream mixes profiles, and a burn
+    against the loosest declared promise is a burn under every
+    declared promise) and the matching error budget (1 - pct/100 for
+    percentile targets; the declared miss-fraction cap itself for
+    ``deadline_miss``, whose percentile slot is fixed at 100)."""
+    from ..loadgen import profiles
+    metric = {v: k for k, v in profiles.SLO_SOURCE_METRICS.items()
+              }.get(series)
+    if metric is None:
+        raise WatchPolicyError(
+            f"{series!r} is not an SLO source series; burn watches "
+            f"cover {sorted(profiles.SLO_SOURCE_METRICS.values())}")
+    targets: List[float] = []
+    budgets: List[float] = []
+    for policy in profiles.SLO_POLICY.values():
+        if metric in policy:
+            target, pct = policy[metric]
+            targets.append(float(target))
+            budgets.append(float(target) if pct >= 100
+                           else 1.0 - pct / 100.0)
+    if not targets:
+        raise WatchPolicyError(
+            f"no SLO_POLICY profile declares metric {metric!r} — a "
+            "burn watch needs a declared budget to burn")
+    return max(targets), max(budgets)
+
+
+def validate_policy(policy: Dict[str, tuple]) -> None:
+    """Typed validation of a WATCH_POLICY dict (the reducer refuses a
+    malformed contract at construction; the static pass catches the
+    same shapes compile-free)."""
+    if not isinstance(policy, dict) or not policy:
+        raise WatchPolicyError("WATCH_POLICY must be a non-empty dict "
+                               "{watch: (series, window, threshold, "
+                               "severity)}")
+    for watch, entry in policy.items():
+        if not (isinstance(entry, tuple) and len(entry) == 4):
+            raise WatchPolicyError(
+                f"watch {watch!r}: entry must be a 4-tuple (series, "
+                f"window, threshold, severity), got {entry!r}")
+        series, window, threshold, severity = entry
+        if not isinstance(series, str) or not series:
+            raise WatchPolicyError(
+                f"watch {watch!r}: series must be a non-empty string")
+        if severity not in SEVERITIES:
+            raise WatchPolicyError(
+                f"watch {watch!r}: severity {severity!r} outside "
+                f"{SEVERITIES}")
+        if not (isinstance(threshold, (int, float))
+                and not isinstance(threshold, bool) and threshold > 0):
+            raise WatchPolicyError(
+                f"watch {watch!r}: threshold must be a positive number")
+        windows = window if isinstance(window, tuple) else (window,)
+        if not windows or not all(
+                isinstance(w, (int, float)) and not isinstance(w, bool)
+                and w > 0 for w in windows):
+            raise WatchPolicyError(
+                f"watch {watch!r}: window must be a positive ms value "
+                "or a (short_ms, long_ms) tuple")
+        if watch_mode(series) == "burn":
+            if len(windows) != 2 or windows[0] >= windows[1]:
+                raise WatchPolicyError(
+                    f"watch {watch!r}: burn watches need (short_ms, "
+                    f"long_ms) with short < long, got {window!r}")
+            slo_budget(series)   # must have a declared budget to burn
+        elif len(windows) != 1:
+            raise WatchPolicyError(
+                f"watch {watch!r}: {watch_mode(series)} watches take a "
+                f"single window_ms, got {window!r}")
+
+
+# -- pure windowed reductions -------------------------------------------------
+
+
+def _windowed(samples: List[tuple], now_ms: float,
+              window_ms: float) -> List[tuple]:
+    return [s for s in samples if now_ms - s[0] <= window_ms]
+
+
+def burn_rate(samples: List[tuple], now_ms: float, window_ms: float,
+              budget: float) -> Optional[float]:
+    """Violating weight over total weight, divided by the error budget
+    — None when the window carries no weight (insufficient data is not
+    a clean bill, it is silence)."""
+    win = _windowed(samples, now_ms, window_ms)
+    total = sum(s[2] for s in win)
+    if total <= 0:
+        return None
+    return (sum(s[1] for s in win) / total) / budget
+
+
+def windowed_mean(samples: List[tuple], now_ms: float,
+                  window_ms: float) -> Optional[float]:
+    win = _windowed(samples, now_ms, window_ms)
+    if not win:
+        return None
+    return sum(s[1] for s in win) / len(win)
+
+
+def ewma_drift(samples: List[tuple], now_ms: float, window_ms: float,
+               alpha: float = DRIFT_ALPHA) -> Optional[float]:
+    """EWMA of the drift values inside the window, folded in ``t_ms``
+    order (append order inside one series is t_ms order; the fold is a
+    pure function of the windowed values, so seeded runs replay it)."""
+    win = _windowed(samples, now_ms, window_ms)
+    if not win:
+        return None
+    acc = win[0][1]
+    for _, value, _ in win[1:]:
+        acc = alpha * value + (1.0 - alpha) * acc
+    return acc
+
+
+def percentile_sketch(samples: List[tuple], now_ms: float,
+                      window_ms: float) -> dict:
+    """Exact p50/p99 over the bounded window (a sketch in the sense
+    that the window itself is bounded — old points rotated out of the
+    ring are honestly gone, not approximated)."""
+    vals = sorted(s[1] for s in _windowed(samples, now_ms, window_ms))
+    if not vals:
+        return {"points": 0}
+    return {
+        "points": len(vals),
+        "p50": round(vals[(len(vals) - 1) // 2], 6),
+        "p99": round(vals[min(len(vals) - 1,
+                              (len(vals) * 99) // 100)], 6),
+        "last": round(vals[-1] if len(vals) == 1
+                      else samples[-1][1], 6),
+    }
+
+
+# -- the reducer --------------------------------------------------------------
+
+
+class TrendReducer:
+    """Bounded streaming reducer + watch evaluator. One instance per
+    serving app (module-level :data:`REDUCER` is the process default,
+    the graftscope/grafttime pattern)."""
+
+    def __init__(self, policy: Optional[Dict[str, tuple]] = None,
+                 registry=None, blackbox: bool = True,
+                 min_weight: float = 4.0, min_points: int = 3):
+        from .metrics import REGISTRY
+        self.registry = registry if registry is not None else REGISTRY
+        self.policy = dict(policy if policy is not None
+                           else WATCH_POLICY)
+        validate_policy(self.policy)
+        self.blackbox = blackbox
+        # evaluation floors: a burn verdict needs this much windowed
+        # weight in the SHORT window, drift/level this many points —
+        # below the floor the watch reports "insufficient", never trips
+        self.min_weight = float(min_weight)
+        self.min_points = int(min_points)
+        self._lock = graftsched.lock("grafttrend.TrendReducer._lock")
+        self._samples: Dict[str, deque] = {}
+        self._alerts: deque = deque(maxlen=ALERT_CAPACITY)
+        self._latched: Dict[str, bool] = {}
+        self._evals = 0
+        self._cursors: Dict[str, object] = {}
+        self._refits: deque = deque(maxlen=REFIT_CAPACITY)
+
+    # -- ingestion --
+
+    def observe(self, series: str, value: float, weight: float = 1.0,
+                t_ms: Optional[float] = None) -> None:
+        """Record one sample: ``value`` is the series' payload
+        (violating count for burn series, drift for derived series,
+        gauge level otherwise), ``weight`` the denominator weight
+        (total count for burn series; 1 elsewhere). ``t_ms`` defaults
+        to the grafttime bus clock — seeded fixtures pass explicit
+        instants so evaluation replays identically."""
+        t = grafttime.now_ms() if t_ms is None else float(t_ms)
+        with self._lock:
+            ring = self._samples.get(series)
+            if ring is None:
+                ring = self._samples[series] = deque(
+                    maxlen=SAMPLE_CAPACITY)
+            ring.append((t, float(value), float(weight)))
+
+    def poll(self, plan_row=None, now_ms: Optional[float] = None) -> int:
+        """The live tap: fold the in-process producers into samples.
+        Reads registry histogram-bucket deltas for the SLO latency
+        series (violating = new observations in buckets past the
+        loosest declared target), the deadline-miss/request counter
+        pair, the watched catalog gauges, and — when ``plan_row`` (a
+        ``costmodel.PlanRow`` or dict) is supplied and the graftmem
+        ledger is live — the reconcile drift pair. Returns the number
+        of samples ingested. All producer reads happen BEFORE the
+        reducer's hold (lock discipline: no foreign lock nests inside
+        ``_lock``)."""
+        from .metrics import DEFAULT_BUCKETS, METRIC_CATALOG
+        t = grafttime.now_ms() if now_ms is None else float(now_ms)
+        watched = {entry[0] for entry in self.policy.values()}
+
+        # gather phase (no reducer hold): registry + graftmem reads
+        buckets = self.registry.histogram_buckets()
+        flat = self.registry.snapshot()
+        gathered: List[Tuple[str, float, float]] = []
+
+        def _counter_total(name: str) -> float:
+            return sum(v for key, v in flat.items()
+                       if key == name or key.startswith(name + "{"))
+
+        hist_cursors: Dict[str, Tuple[float, float]] = {}
+        for series in sorted(watched):
+            if watch_mode(series) != "burn":
+                continue
+            if METRIC_CATALOG.get(series) == "histogram":
+                target, _ = slo_budget(series)
+                total = 0.0
+                viol = 0.0
+                # bucket i spans (bounds[i-1], bounds[i]]; a bucket
+                # whose LOWER edge is >= target holds only violations
+                # (conservative: the target's own bucket is not charged)
+                cut = bisect.bisect_left(DEFAULT_BUCKETS, target) + 1
+                for key, (counts, _s, _n) in buckets.items():
+                    if key != series and not key.startswith(
+                            series + "{"):
+                        continue
+                    total += sum(counts)
+                    viol += sum(counts[cut:])
+                hist_cursors[series] = (total, viol)
+            else:
+                # the deadline-miss counter burns against the request
+                # counter: one sample per poll carrying the interval's
+                # (misses, requests) delta pair
+                hist_cursors[series] = (
+                    _counter_total("generate_requests_total"),
+                    _counter_total(series))
+        gauge_levels: Dict[str, float] = {}
+        for series in sorted(watched):
+            if watch_mode(series) == "level" \
+                    and METRIC_CATALOG.get(series) == "gauge":
+                vals = [v for key, v in flat.items()
+                        if key == series
+                        or key.startswith(series + "{")]
+                if vals:
+                    # max over label sets: any open breaker / the
+                    # deepest queue is the signal
+                    gauge_levels[series] = max(vals)
+        drift_pair: Dict[str, float] = {}
+        if plan_row is not None:
+            from . import graftmem
+            rec = graftmem.reconcile(plan_row)
+            for comp, series in (("params", "graftmem_params_drift"),
+                                 ("kv", "graftmem_kv_drift")):
+                if series not in watched:
+                    continue
+                d = rec["components"].get(comp, {}).get("drift")
+                if d is not None:
+                    drift_pair[series] = float(d)
+
+        # fold phase (one hold): diff cursors, append samples
+        with self._lock:
+            for series, cur in hist_cursors.items():
+                prev = self._cursors.get(series)
+                self._cursors[series] = cur
+                if prev is None:
+                    continue           # first poll seeds the cursor
+                d_total = cur[0] - prev[0]
+                d_viol = cur[1] - prev[1]
+                if d_total <= 0:
+                    continue
+                ring = self._samples.get(series)
+                if ring is None:
+                    ring = self._samples[series] = deque(
+                        maxlen=SAMPLE_CAPACITY)
+                ring.append((t, max(d_viol, 0.0), d_total))
+                gathered.append((series, d_viol, d_total))
+            for series, level in gauge_levels.items():
+                ring = self._samples.get(series)
+                if ring is None:
+                    ring = self._samples[series] = deque(
+                        maxlen=SAMPLE_CAPACITY)
+                ring.append((t, level, 1.0))
+                gathered.append((series, level, 1.0))
+            for series, d in drift_pair.items():
+                ring = self._samples.get(series)
+                if ring is None:
+                    ring = self._samples[series] = deque(
+                        maxlen=SAMPLE_CAPACITY)
+                ring.append((t, d, 1.0))
+                gathered.append((series, d, 1.0))
+        return len(gathered)
+
+    # -- evaluation --
+
+    def _verdict(self, mode: str, series: str, samples: List[tuple],
+                 now: float, window, threshold: float):
+        """(tripped, value, window_ms) or None for insufficient data.
+        Pure over its inputs — the replay-identity contract."""
+        if mode == "burn":
+            short_ms, long_ms = window
+            _, budget = slo_budget(series)
+            win = _windowed(samples, now, short_ms)
+            if sum(s[2] for s in win) < self.min_weight:
+                return None
+            short = burn_rate(samples, now, short_ms, budget)
+            long = burn_rate(samples, now, long_ms, budget)
+            if short is None or long is None:
+                return None
+            return (short > threshold and long > threshold,
+                    min(short, long), window)
+        win_ms = window if not isinstance(window, tuple) else window[0]
+        win = _windowed(samples, now, win_ms)
+        if len(win) < self.min_points:
+            return None
+        if mode == "drift":
+            value = ewma_drift(samples, now, win_ms)
+        else:
+            value = windowed_mean(samples, now, win_ms)
+        if value is None:
+            return None
+        return value > threshold, value, win_ms
+
+    def evaluate(self, now_ms: Optional[float] = None) -> List[dict]:
+        """Evaluate every declared watch; returns the NEW trips (the
+        latched episodes). The loop body is a pure function of the
+        sample windows + ``now_ms`` — seeded inputs replay to the same
+        alerts — and all emission (timeline event, metric, black-box
+        dump) happens OUTSIDE the hold."""
+        now = grafttime.now_ms() if now_ms is None else float(now_ms)
+        trips: List[dict] = []
+        with self._lock:
+            self._evals += 1
+            for watch in sorted(self.policy):
+                series, window, threshold, severity = self.policy[watch]
+                samples = list(self._samples.get(series, ()))
+                mode = watch_mode(series)
+                v = self._verdict(mode, series, samples, now, window,
+                                  threshold)
+                if v is None or not v[0]:
+                    # clean (or silent) evaluation ends the episode:
+                    # the next trip alerts again
+                    self._latched.pop(watch, None)
+                    continue
+                if self._latched.get(watch):
+                    continue          # already alerted this episode
+                self._latched[watch] = True
+                alert = {
+                    "watch": watch,
+                    "series": series,
+                    "severity": severity,
+                    "mode": mode,
+                    "window_ms": (list(window)
+                                  if isinstance(window, tuple)
+                                  else window),
+                    "value": round(v[1], 6),
+                    "threshold": threshold,
+                    # wall-clock context only — replay identity is over
+                    # the alert MINUS this field (alerts(strip_time=True))
+                    "t_ms": round(now, 3),
+                }
+                self._alerts.append(alert)
+                trips.append(alert)
+        for alert in trips:
+            grafttime.emit("trend_alert", watch=alert["watch"],
+                           severity=alert["severity"],
+                           series=alert["series"],
+                           mode=alert["mode"],
+                           value=alert["value"],
+                           threshold=alert["threshold"])
+            self.registry.inc("trend_alerts_total",
+                              watch=alert["watch"],
+                              severity=alert["severity"])
+            if self.blackbox:
+                grafttime.blackbox(f"trend_alert:{alert['watch']}")
+        return trips
+
+    # -- sizing input (graftwatch's between-waves hook) --
+
+    def occupancy_estimate(self, series: str = "queue_depth",
+                           window_ms: float = 30_000.0,
+                           now_ms: Optional[float] = None
+                           ) -> Optional[float]:
+        """Windowed mean of an occupancy series — what
+        ``PlanSwitcher.resize_from_trend`` scales the SIZING_POLICY
+        knobs from. None when the window is empty (the sizer then
+        leaves the knob at its base: silence never resizes)."""
+        now = grafttime.now_ms() if now_ms is None else float(now_ms)
+        with self._lock:
+            samples = list(self._samples.get(series, ()))
+        return windowed_mean(samples, now, window_ms)
+
+    # -- observability --
+
+    def alerts(self, n: Optional[int] = None,
+               strip_time: bool = False) -> List[dict]:
+        """The bounded alert journal (oldest first).
+        ``strip_time=True`` drops the wall-clock field — what the
+        replay-identity pins compare."""
+        with self._lock:
+            rows = list(self._alerts)
+        if n is not None:
+            rows = rows[-n:]
+        if strip_time:
+            rows = [{k: v for k, v in r.items() if k != "t_ms"}
+                    for r in rows]
+        return rows
+
+    def refit_history(self) -> List[dict]:
+        with self._lock:
+            return [dict(r) for r in self._refits]
+
+    def note_refit(self, row: dict) -> None:
+        with self._lock:
+            self._refits.append(dict(row))
+
+    def describe(self, now_ms: Optional[float] = None) -> dict:
+        """The GET /debug/trend payload body: per-watch state (mode,
+        window, threshold, latest value, latched), per-series windowed
+        reductions (rate, p50/p99 sketch), the alert journal, and the
+        declared contracts."""
+        now = grafttime.now_ms() if now_ms is None else float(now_ms)
+        with self._lock:
+            samples = {s: list(ring)
+                       for s, ring in self._samples.items()}
+            latched = dict(self._latched)
+            evals = self._evals
+            alerts = list(self._alerts)
+            refits = [dict(r) for r in self._refits]
+        watches = {}
+        for watch in sorted(self.policy):
+            series, window, threshold, severity = self.policy[watch]
+            mode = watch_mode(series)
+            v = self._verdict(mode, series,
+                              samples.get(series, []), now, window,
+                              threshold)
+            watches[watch] = {
+                "series": series,
+                "mode": mode,
+                "window_ms": (list(window) if isinstance(window, tuple)
+                              else window),
+                "threshold": threshold,
+                "severity": severity,
+                "state": ("insufficient" if v is None
+                          else "tripped" if v[0] else "ok"),
+                "value": None if v is None else round(v[1], 6),
+                "latched": bool(latched.get(watch)),
+            }
+        series_view = {}
+        for series, pts in sorted(samples.items()):
+            win_ms = max(
+                (max(w[1]) if isinstance(w[1], tuple) else w[1])
+                for w in self.policy.values() if w[0] == series
+            ) if any(w[0] == series for w in self.policy.values()) \
+                else 60_000.0
+            win = _windowed(pts, now, win_ms)
+            series_view[series] = {
+                "points": len(pts),
+                "window_points": len(win),
+                "rate_per_s": round(
+                    sum(s[2] for s in win) / (win_ms / 1e3), 6),
+                "sketch": percentile_sketch(pts, now, win_ms),
+            }
+        return {
+            "now_ms": round(now, 3),
+            "evaluations": evals,
+            "watches": watches,
+            "series": series_view,
+            "alerts": alerts,
+            "refits": refits,
+            "policy": {w: {"series": e[0],
+                           "window_ms": (list(e[1])
+                                         if isinstance(e[1], tuple)
+                                         else e[1]),
+                           "threshold": e[2], "severity": e[3]}
+                       for w, e in sorted(self.policy.items())},
+            "sizing": {k: {"source": v[0], "min_scale": v[1],
+                           "max_scale": v[2]}
+                       for k, v in sorted(SIZING_POLICY.items())},
+            "derived_series": dict(DERIVED_SERIES),
+        }
+
+    def health_view(self) -> dict:
+        """The /healthz ``trend`` block: watch count, live trip state,
+        alert totals — small enough for a probe, loud enough that a
+        latched page is visible without the debug surface."""
+        with self._lock:
+            latched = sorted(w for w, on in self._latched.items()
+                             if on)
+            alerts = len(self._alerts)
+            evals = self._evals
+        return {"watches": len(self.policy),
+                "evaluations": evals,
+                "alerts_journaled": alerts,
+                "latched": latched}
+
+    # -- test isolation (tests/conftest.py) --
+
+    def dump_state(self) -> tuple:
+        with self._lock:
+            return ({s: list(r) for s, r in self._samples.items()},
+                    list(self._alerts), dict(self._latched),
+                    self._evals, dict(self._cursors),
+                    list(self._refits))
+
+    def restore_state(self, state: tuple) -> None:
+        samples, alerts, latched, evals, cursors, refits = state
+        with self._lock:
+            self._samples = {s: deque(r, maxlen=SAMPLE_CAPACITY)
+                             for s, r in samples.items()}
+            self._alerts = deque(alerts, maxlen=ALERT_CAPACITY)
+            self._latched = dict(latched)
+            self._evals = evals
+            self._cursors = dict(cursors)
+            self._refits = deque(refits, maxlen=REFIT_CAPACITY)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._samples = {}
+            self._alerts = deque(maxlen=ALERT_CAPACITY)
+            self._latched = {}
+            self._evals = 0
+            self._cursors = {}
+            self._refits = deque(maxlen=REFIT_CAPACITY)
+
+
+# process-wide default reducer (what serving.app uses; tests
+# snapshot/restore it via the conftest fixture)
+REDUCER = TrendReducer()
+
+
+# -- the live refit loop ------------------------------------------------------
+
+
+def live_attribution_journal(costs=None) -> dict:
+    """Assemble a ``graftscope_attribution``-shaped journal from the
+    LIVE graftscope dispatch rings — the in-process analog of the
+    startup bench journal ``graftwatch.fit_cost_weights`` was built
+    for. Each profiled scope with recorded dispatches contributes its
+    measured seconds; the modeled byte terms come from the switcher's
+    static plan costs (``costs`` — a ``{label: PlanCost}`` map). With
+    no dispatches or no costs the journal carries no workload rows and
+    the fit honestly falls back to the a-priori weights
+    (``rows_used == 0``), never a fabricated number."""
+    snap = graftscope.snapshot(n=0)
+    workloads: List[dict] = []
+    dispatch = snap.get("dispatch") or {}
+    if costs:
+        from tools.graftcheck.costmodel import ICI_BYTE_WEIGHT
+        total_secs = 0.0
+        total_calls = 0
+        entry_points: Dict[str, dict] = {}
+        for scope, ring in sorted(dispatch.items()):
+            secs = float(ring.get("seconds_total", 0.0) or 0.0)
+            calls = int(ring.get("calls", 0) or 0)
+            if calls <= 0 or secs <= 0:
+                continue
+            total_secs += secs
+            total_calls += calls
+            entry_points[scope] = {"seconds_total": round(secs, 6),
+                                   "calls": calls}
+        if total_calls > 0:
+            measured = total_secs / total_calls
+            for label, pc in sorted(costs.items()):
+                cost = pc.to_dict() if hasattr(pc, "to_dict") \
+                    else dict(pc)
+                comm = float(cost.get("comm_bytes", 0) or 0)
+                # the same scored total the planner ranks on: static
+                # byte terms with comm priced at the a-priori weight
+                # (fit_cost_weights removes that weighting again)
+                modeled = (float(cost.get("param_bytes", 0))
+                           + float(cost.get("kv_bytes_per_row", 0))
+                           + float(cost.get("paged_overhead", 0))
+                           + ICI_BYTE_WEIGHT * comm)
+                if modeled <= 0:
+                    continue
+                workloads.append({
+                    "workload": f"live_{label}",
+                    "measured_decode_seconds_per_token": measured,
+                    "modeled_cost_bytes_per_token": modeled,
+                    "modeled_comm_bytes_per_token": comm,
+                    "entry_points": entry_points,
+                })
+    return {"name": "graftscope_attribution",
+            "source": "grafttrend.live_attribution_journal",
+            "workloads": workloads}
+
+
+def refit(journal=None, switcher=None, registry=None,
+          reducer: Optional[TrendReducer] = None):
+    """Re-fit the cost-model byte weights live and thread them into
+    plan scoring. ``journal`` defaults to
+    :func:`live_attribution_journal` over the current graftscope rings
+    (using ``switcher.costs`` for the modeled terms); the fit itself
+    is ``graftwatch.fit_cost_weights`` — the SAME least-squares the
+    startup journal path runs, on live inputs. Publishes the resolved
+    ICI weight as the ``costmodel_byte_weight`` gauge (+ occupancy
+    series), feeds the ``costmodel_weight_drift`` derived series, and
+    installs the weights on ``switcher`` between waves
+    (``PlanSwitcher.set_weights`` — scoring-only: linear in the
+    weight, zero recompiles by construction). Returns the fitted
+    ``CostWeights``."""
+    from . import graftwatch
+    from .metrics import REGISTRY
+    from tools.graftcheck.costmodel import ICI_BYTE_WEIGHT
+    if journal is None:
+        journal = live_attribution_journal(
+            getattr(switcher, "costs", None))
+    weights = graftwatch.fit_cost_weights(journal)
+    w = weights.ici_byte_weight
+    if not w:
+        w = ICI_BYTE_WEIGHT
+    reg = registry if registry is not None else REGISTRY
+    reg.gauge("costmodel_byte_weight", float(w))
+    graftscope.sample("costmodel_byte_weight", float(w))
+    red = reducer if reducer is not None else REDUCER
+    red.observe("costmodel_weight_drift",
+                abs(float(w) / ICI_BYTE_WEIGHT - 1.0))
+    red.note_refit({"ici_byte_weight": float(w),
+                    "rows_used": weights.rows_used,
+                    "source": weights.source})
+    if switcher is not None:
+        switcher.set_weights(weights)
+    return weights
+
+
+# -- module-level conveniences (the call-site API) ----------------------------
+
+
+def observe(series: str, value: float, weight: float = 1.0,
+            t_ms: Optional[float] = None) -> None:
+    REDUCER.observe(series, value, weight=weight, t_ms=t_ms)
+
+
+def poll(plan_row=None, now_ms: Optional[float] = None) -> int:
+    return REDUCER.poll(plan_row=plan_row, now_ms=now_ms)
+
+
+def evaluate(now_ms: Optional[float] = None) -> List[dict]:
+    return REDUCER.evaluate(now_ms=now_ms)
+
+
+def alerts(**kw) -> List[dict]:
+    return REDUCER.alerts(**kw)
+
+
+def describe(**kw) -> dict:
+    return REDUCER.describe(**kw)
+
+
+def health_view() -> dict:
+    return REDUCER.health_view()
+
+
+def dump_state() -> tuple:
+    return REDUCER.dump_state()
+
+
+def restore_state(state: tuple) -> None:
+    REDUCER.restore_state(state)
+
+
+def clear() -> None:
+    REDUCER.clear()

@@ -76,7 +76,8 @@ from . import graftsched, graftscope, grafttime
 # beyond the single name.
 GUARDED_STATE = {"_window": "_lock", "_admitted": "_lock",
                  "_active": "_lock", "_inflight": "_lock",
-                 "_events": "_lock", "_switches": "_lock"}
+                 "_events": "_lock", "_switches": "_lock",
+                 "_sizings": "_lock"}
 LOCK_ORDER = ("_lock",)
 
 # Timeline contract (tools/graftcheck timeline pass): every wave
@@ -626,6 +627,12 @@ class PlanSwitcher:
         self._inflight = 0
         self._switches = 0
         self._events: deque = deque(maxlen=self.HISTORY)
+        # trend-driven sizing (grafttrend.SIZING_POLICY): attached via
+        # attach_trend; base knob values are captured at attach so a
+        # resize is always BASE x scale, never compounding drift
+        self._trend = None
+        self._sizing_base: Dict[str, tuple] = {}
+        self._sizings: deque = deque(maxlen=self.HISTORY)
         self._announce(start)
 
     # -- admission routing --
@@ -704,6 +711,91 @@ class PlanSwitcher:
                                wave=admitted // self.wave)
         if switched_from is not None:
             self._announce(decision, previous=switched_from)
+        self._resize(admitted // self.wave)
+
+    # -- re-fitted weights (grafttrend.refit's threading hook) --
+
+    def set_weights(self, weights: CostWeights) -> CostWeights:
+        """Install re-fitted cost weights between waves — what
+        ``grafttrend.refit`` calls after a live fit over the
+        attribution rings. Scoring-only by construction:
+        ``score_plans`` is linear in the ICI weight, so a change from
+        w to w' shifts every plan score by exactly (w' - w) x that
+        plan's ``comm_bytes`` (the calibration golden), and weights
+        never key a compiled program — the pre-certified
+        zero-recompile envelope is untouched. A missing ici weight
+        resolves to the a-priori constant exactly as at construction.
+        Returns the previous weights."""
+        if not weights.ici_byte_weight:
+            from tools.graftcheck.costmodel import ICI_BYTE_WEIGHT
+            weights = dataclasses.replace(
+                weights, ici_byte_weight=ICI_BYTE_WEIGHT)
+        with self._lock:
+            prev, self.weights = self.weights, weights
+        return prev
+
+    # -- trend-driven sizing (the ROADMAP item-7 "routes but doesn't
+    # size" follow-on) --
+
+    def attach_trend(self, reducer) -> None:
+        """Attach a grafttrend reducer: between waves the switcher
+        reads its windowed occupancy estimate and scales the declared
+        ``grafttrend.SIZING_POLICY`` knobs — the batched plan's
+        ``batch_wait_ms`` (``max_wait_s``) and admission watermark
+        (``queue_limit``) — as BASE x clamp(estimate / max_batch,
+        min_scale, max_scale). Both knobs are pure scheduling
+        parameters: neither keys a compiled program (zero-recompile)
+        nor changes any emitted token (byte-equal per request — the
+        pinned contract in tests/test_grafttrend.py). Base values are
+        captured HERE, once, so repeated resizes never compound."""
+        self._trend = reducer
+        self._sizing_base = {
+            label: (runner.max_wait_s, runner.queue_limit,
+                    runner.max_batch)
+            for label, runner in self.plans.items()
+            if hasattr(runner, "max_wait_s")
+            and hasattr(runner, "queue_limit")}
+
+    def _resize(self, wave: int) -> None:
+        trend = self._trend
+        if trend is None or not self._sizing_base:
+            return
+        from . import grafttrend
+        # wave boundaries drive the reducer's ingestion too (bounded:
+        # one registry fold per wave, OUTSIDE every switcher hold), so
+        # sizing sees live occupancy without an external scraper
+        trend.poll()
+        series, lo, hi = grafttrend.SIZING_POLICY["batch_wait_ms"]
+        q_series, q_lo, q_hi = grafttrend.SIZING_POLICY["queue_limit"]
+        est = trend.occupancy_estimate(series)
+        q_est = est if q_series == series \
+            else trend.occupancy_estimate(q_series)
+        if est is None and q_est is None:
+            return   # silence never resizes: knobs stay where they are
+        row = {"wave": wave, "estimate": None if est is None
+               else round(est, 3), "knobs": {}}
+        for label, (base_wait, base_limit, max_batch) in sorted(
+                self._sizing_base.items()):
+            runner = self.plans[label]
+            if est is not None:
+                scale = min(max(est / max(max_batch, 1), lo), hi)
+                runner.max_wait_s = base_wait * scale
+            if q_est is not None:
+                q_scale = min(max(q_est / max(max_batch, 1), q_lo),
+                              q_hi)
+                runner.queue_limit = max(1, int(round(
+                    base_limit * q_scale)))
+            row["knobs"][label] = {
+                "batch_wait_ms": round(runner.max_wait_s * 1e3, 4),
+                "queue_limit": runner.queue_limit}
+        with self._lock:
+            self._sizings.append(row)
+
+    def sizings(self, n: Optional[int] = None) -> List[dict]:
+        """The journaled trend-driven resizes (oldest first, bounded)."""
+        with self._lock:
+            rows = [dict(r) for r in self._sizings]
+        return rows if n is None else rows[-n:]
 
     def _announce(self, label: str, previous: Optional[str] = None):
         # metric emission stays OUTSIDE every hold (graftlock's
@@ -784,6 +876,7 @@ class PlanSwitcher:
             "calibrated_weights": self.weights.to_dict(),
             "plans": rows,
             "events": self.events(n=n),
+            "sizings": self.sizings(n=n),
             "signals": dict(PLAN_SIGNALS),
             "signal_values": self.watcher.signals(),
         }

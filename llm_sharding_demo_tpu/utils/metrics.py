@@ -171,6 +171,16 @@ METRIC_CATALOG: Dict[str, str] = {
     # beside queue depth and pool blocks; /debug/memory serves the
     # full per-holding table.
     "hbm_bytes": "gauge",
+    # trend & drift watch (utils/grafttrend.py): one increment per
+    # WATCH_POLICY trip, labeled watch x severity (both drawn from the
+    # declared policy, so the label space is bounded by construction);
+    # and the live-refit output — the ICI byte weight currently
+    # threaded into plan scoring (a-priori costmodel.ICI_BYTE_WEIGHT
+    # until the first grafttrend.refit, the fitted value after). The
+    # gauge doubles as a graftscope occupancy series, so weight moves
+    # sit on the same timeline as queue depth and plan switches.
+    "trend_alerts_total": "counter",
+    "costmodel_byte_weight": "gauge",
 }
 
 # Metric names that USED to exist and were replaced: a call site (or a
@@ -259,6 +269,19 @@ class MetricsRegistry:
             self._gauges = dict(gauges)
             self._histograms = {k: [list(v[0]), v[1], v[2]]
                                 for k, v in histograms.items()}
+
+    def histogram_buckets(self) -> Dict[str, tuple]:
+        """``{name{k=v,...}: (bucket_counts, sum, count)}`` — the raw
+        per-label-set bucket counts behind each histogram (bucket ``i``
+        spans ``(DEFAULT_BUCKETS[i-1], DEFAULT_BUCKETS[i]]``, plus the
+        +Inf overflow slot). ``snapshot()`` deliberately flattens
+        histograms to count/sum/avg; the grafttrend burn-rate poller
+        needs the bucket resolution to count observations past a
+        declared SLO target without storing per-sample values."""
+        with self._lock:
+            return {_fmt_name(name, labels): (list(counts), total, n)
+                    for (name, labels), (counts, total, n)
+                    in self._histograms.items()}
 
     def snapshot(self) -> Dict[str, object]:
         with self._lock:

@@ -83,6 +83,7 @@ _QUICK_MODULES = {
     "test_grafttime",       # unified causal timeline: bus, export, pass
     "test_graftnum",        # numerics discipline: contracts + oracle
     "test_graftmem",        # HBM ledger: attribution, reconcile, pass
+    "test_grafttrend",      # trend watches: reducer, refit, pass
 }
 
 
@@ -110,7 +111,8 @@ def _metrics_isolation():
     dispatch rings). ``create_app`` additionally accepts an injected
     registry/recorder for tests that want full isolation."""
     from llm_sharding_demo_tpu.utils import (graftmem, graftscope,
-                                             grafttime, metrics, tracing)
+                                             grafttime, grafttrend,
+                                             metrics, tracing)
     state = metrics.REGISTRY.dump_state()
     scope_state = graftscope.dump_state()
     scope_flags = (graftscope.enabled(), graftscope.sync_enabled())
@@ -118,6 +120,7 @@ def _metrics_isolation():
     time_enabled = grafttime.enabled()
     blackbox_saved = grafttime.blackbox_dumps()
     mem_state = graftmem.dump_state()
+    trend_state = grafttrend.dump_state()
     with tracing.RECORDER._lock:
         saved = list(tracing.RECORDER._traces)
     yield
@@ -128,6 +131,7 @@ def _metrics_isolation():
     grafttime.restore_state(time_state)
     grafttime.set_enabled(time_enabled)
     graftmem.restore_state(mem_state)
+    grafttrend.restore_state(trend_state)
     grafttime.clear_blackbox()
     with grafttime._DUMPS_LOCK:
         grafttime._DUMPS.extend(blackbox_saved)

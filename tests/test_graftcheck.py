@@ -203,6 +203,20 @@ def test_repo_passes_graftcheck():
         assert tl.get(mod, 0) >= floor, (
             f"{mod}: fewer than {floor} live timeline kind(s) — a "
             "declared producer stopped publishing")
+    assert payload["trend_checks"] >= 15, (
+        "grafttrend trend pass went vacuous — a new slo-without-watch "
+        "/ watch-without-source / malformed-watch finding anywhere in "
+        "the tree fails this strict run (rule fixtures in "
+        "tests/test_grafttrend.py)")
+    assert payload["trend_vacuous"] == [], (
+        "WATCH_POLICY declarations covering zero SLO source series "
+        "(the declared promises stopped being watched): "
+        f"{payload['trend_vacuous']}")
+    # every declared SLO promise keeps a live burn watch
+    assert payload["trend_policies"].get(
+        "llm_sharding_demo_tpu/utils/grafttrend.py", 0) >= 8, (
+        "utils/grafttrend.py: WATCH_POLICY no longer resolves its "
+        "declared watches against emitted series + declared budgets")
     assert payload["numerics_checks"] >= 10, (
         "graftnum numerics pass went vacuous — a new undeclared-cast / "
         "unstable-reduction / silent-downcast / approx-without-oracle "

@@ -615,6 +615,8 @@ def test_verifier_json_schema_shape():
                             "numerics_vacuous",
                             "memory_checks", "memory_ledgers",
                             "memory_vacuous",
+                            "trend_checks", "trend_policies",
+                            "trend_vacuous",
                             "placement_checks", "placement_contracts",
                             "placement_vacuous",
                             "recompile_bounds"}
@@ -650,6 +652,9 @@ def test_verifier_json_schema_shape():
     assert isinstance(payload["placement_checks"], int)
     assert isinstance(payload["placement_contracts"], dict)
     assert isinstance(payload["placement_vacuous"], list)
+    assert isinstance(payload["trend_checks"], int)
+    assert isinstance(payload["trend_policies"], dict)
+    assert isinstance(payload["trend_vacuous"], list)
     assert isinstance(payload["stale_audits"], list)
     assert isinstance(payload["passes_run"], list)
     assert isinstance(payload["pass_seconds"], dict)
@@ -668,9 +673,11 @@ def test_plan_json_schema_shape():
     top-level keys, per-row keys, and the chosen row's env mapping."""
     payload = CM.plan(gpt2, GPT2_CFG, {}, max_seq=64)
     assert set(payload) == {"model", "mesh", "ici_byte_weight",
+                            "ici_byte_weight_source",
                             "max_seq", "traffic", "plan", "chosen",
                             "rejected"}
     assert payload["ici_byte_weight"] == CM.ICI_BYTE_WEIGHT
+    assert payload["ici_byte_weight_source"] == "a-priori"
     row_keys = {"config", "label", "ok", "cost_per_token",
                 "comm_bytes_per_token", "param_bytes_per_device",
                 "kv_bytes_per_device", "peak_activation_bytes",

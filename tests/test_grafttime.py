@@ -84,6 +84,53 @@ def test_bus_bounded_under_flood():
     assert ts == sorted(ts)
 
 
+def test_since_seq_cursor_incremental_poll_and_wraparound():
+    """Satellite (ISSUE 19): ``?since_seq=`` turns /debug/timeline
+    into an incremental poll — the payload echoes a ``cursor`` (the
+    newest emission sequence) and feeding it back returns only the
+    events emitted after it. Pinned through ring wraparound: seq keeps
+    climbing while old events rotate out, the increment never
+    re-delivers, and events that rotated away between polls surface as
+    a rising ``dropped`` count, never as silent gaps presented as
+    complete streams."""
+    grafttime.clear()
+    for i in range(3):
+        grafttime.emit("occupancy", name="queue_depth", value=float(i))
+    first = grafttime.snapshot()
+    assert first["cursor"] == 3
+    assert first["since_seq"] is None
+    # the increment: only events past the cursor come back
+    grafttime.emit("admission", rid="inc-1")
+    inc = grafttime.snapshot(since_seq=first["cursor"])
+    assert [e["kind"] for e in inc["events"]] == ["admission"]
+    assert inc["since_seq"] == first["cursor"]
+    assert inc["cursor"] == 4
+    # an empty increment is honestly empty, cursor unchanged
+    again = grafttime.snapshot(since_seq=inc["cursor"])
+    assert again["events"] == [] and again["cursor"] == inc["cursor"]
+    # wraparound: flood past RING_CAPACITY from the cursor; seq stays
+    # monotonic, the ring holds only the newest capacity events, and
+    # the dropped counter carries the honest gap
+    cursor = inc["cursor"]
+    flood = grafttime.BUS.capacity + 50
+    for i in range(flood):
+        grafttime.emit("occupancy", name="queue_depth",
+                       value=float(i & 1))
+    wrap = grafttime.snapshot(since_seq=cursor)
+    assert wrap["cursor"] == cursor + flood
+    assert len(wrap["events"]) == grafttime.BUS.capacity
+    seqs = [e["seq"] for e in wrap["events"]]
+    assert min(seqs) > cursor                  # nothing re-delivered
+    assert seqs == sorted(seqs)
+    assert wrap["dropped"] == wrap["emitted_total"] \
+        - grafttime.BUS.capacity
+    # the oldest held seq shows exactly what rotated away
+    assert min(seqs) == wrap["cursor"] - grafttime.BUS.capacity + 1
+    # a cursor in the future of the stream returns nothing (a consumer
+    # that over-advanced fails empty, not wrong)
+    assert grafttime.snapshot(since_seq=10 ** 9)["events"] == []
+
+
 def test_correlate_and_ambient_resolution():
     grafttime.clear()
     # explicit rid wins
@@ -246,7 +293,7 @@ def test_debug_index_pinned_to_healthz_topology(demo):
     body = idx.json()
     assert sorted(body["surfaces"]) == [
         "/debug/memory", "/debug/plan", "/debug/profile",
-        "/debug/requests", "/debug/timeline"]
+        "/debug/requests", "/debug/timeline", "/debug/trend"]
     for surface, desc in body["surfaces"].items():
         assert isinstance(desc, str) and desc
         assert client.get(surface).status_code == 200, surface
@@ -293,9 +340,22 @@ def test_debug_timeline_filters_and_422s(demo):
     assert len(client.get(
         "/debug/timeline?n=3").json()["events"]) == 3
     assert client.get("/debug/timeline?n=0").json()["events"] == []
+    # since_seq: the echoed cursor feeds the next incremental poll
+    # (the ?since= ts filter would skip a backdated late emission;
+    # the seq cursor cannot)
+    head = client.get("/debug/timeline").json()
+    assert head["cursor"] == head["emitted_total"]
+    grafttime.emit("occupancy", name="queue_depth", value=1.0)
+    inc = client.get(
+        f"/debug/timeline?since_seq={head['cursor']}").json()
+    assert [e["kind"] for e in inc["events"]] == ["occupancy"]
+    assert inc["since_seq"] == head["cursor"]
     # typed 422s
     assert client.get("/debug/timeline?since=abc").status_code == 422
     assert client.get("/debug/timeline?n=abc").status_code == 422
+    r = client.get("/debug/timeline?since_seq=abc")
+    assert r.status_code == 422
+    assert "cursor" in r.json()["detail"]
     bad = client.get("/debug/timeline?kinds=admission,bogus")
     assert bad.status_code == 422
     assert "bogus" in bad.json()["detail"]

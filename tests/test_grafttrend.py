@@ -1,0 +1,743 @@
+"""grafttrend in-suite driver (ISSUE 19 tentpole).
+
+Five layers of pinning:
+
+1. **the declared contract**: ``WATCH_POLICY`` validation is typed
+   (``WatchPolicyError`` for every malformed shape), ``slo_budget``
+   resolves the LOOSEST declared SLO target/budget, and the severity
+   vocabulary is ONE thing across the runtime and the static pass;
+2. **seeded replay-identical alert fixtures**: a burn trip, a drift
+   trip, and a level trip each produce exactly ONE typed alert with
+   watch/series/window provenance; a quiet run produces zero; the
+   latch pops on a clean evaluation and the next episode alerts again;
+   two fresh reducers fed the same seeded samples serialize
+   byte-identical alert journals (``strip_time=True``) — the
+   GRAFTSCHED replay-identity contract;
+3. **the live tap**: ``poll`` folds registry histogram-bucket deltas
+   (violations counted past the loosest declared target), the
+   deadline-miss/request counter pair, and the watched gauges into
+   samples — first poll seeds the cursor, never fabricates one;
+4. **the refit golden**: ``grafttrend.refit`` fits the live journal
+   through the SAME least-squares as the startup path and a weight
+   change w -> w' shifts every plan score by exactly
+   ``(w' - w) * comm_bytes`` (``score_plans`` linearity — the PR 11
+   golden preserved), with the empty-journal fallback honestly
+   a-priori; trend-driven sizing scales the declared knobs from base
+   (never compounds), silence never resizes, and the sized serving
+   path is byte-equal to the unsized one under GRAFTSAN=1 GRAFTSCHED=1
+   with a clean quiesce;
+5. **the trend static pass** (tools/graftcheck/trend.py): rule
+   fixtures (malformed-watch, watch-without-source, slo-without-watch,
+   vacuous policies) each produce findings with file:line, and the
+   repo itself passes non-vacuously — every declared SLO metric's
+   source series has a live watch.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from llm_sharding_demo_tpu import loadgen
+from llm_sharding_demo_tpu.loadgen import profiles
+from llm_sharding_demo_tpu.utils import graftscope, grafttime, grafttrend, \
+    graftwatch
+from llm_sharding_demo_tpu.utils.metrics import (METRIC_CATALOG,
+                                                 MetricsRegistry)
+from tools.graftcheck import costmodel as CM
+from tools.graftcheck import trend
+from tools.graftload import build_demo_app
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _reducer(**kw):
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("blackbox", False)
+    return grafttrend.TrendReducer(**kw)
+
+
+# -- 1. the declared contract -------------------------------------------------
+
+
+def test_severity_vocabulary_is_one_thing():
+    assert tuple(grafttrend.SEVERITIES) == tuple(trend.TREND_SEVERITIES)
+    for watch, (_s, _w, _t, severity) in grafttrend.WATCH_POLICY.items():
+        assert severity in grafttrend.SEVERITIES, watch
+
+
+def test_slo_budget_resolves_loosest_declared_target():
+    # percentile targets: loosest target across profiles, budget from
+    # the loosest percentile (all ttft declarations ride p95)
+    target, budget = grafttrend.slo_budget("ttft_seconds")
+    assert target == max(p["ttft"][0]
+                         for p in profiles.SLO_POLICY.values()
+                         if "ttft" in p)
+    assert budget == pytest.approx(0.05)
+    # deadline_miss: the declared miss-fraction cap IS the budget
+    # (percentile slot fixed at 100)
+    d_target, d_budget = grafttrend.slo_budget("deadline_misses_total")
+    assert d_target == d_budget \
+        == profiles.SLO_POLICY["abandonment"]["deadline_miss"][0]
+    # a non-SLO series cannot burn a budget
+    with pytest.raises(grafttrend.WatchPolicyError, match="SLO source"):
+        grafttrend.slo_budget("queue_depth")
+
+
+def test_validate_policy_typed_errors():
+    ok = dict(grafttrend.WATCH_POLICY)
+    grafttrend.validate_policy(ok)          # the shipped contract holds
+    for bad, match in (
+        ({}, "non-empty dict"),
+        ({"w": ("ttft_seconds", 1.0, 2.0)}, "4-tuple"),
+        ({"w": ("", 1000.0, 2.0, "page")}, "non-empty string"),
+        ({"w": ("queue_depth", 1000.0, 2.0, "email")}, "severity"),
+        ({"w": ("queue_depth", 1000.0, -1.0, "page")}, "positive"),
+        ({"w": ("queue_depth", 1000.0, True, "page")}, "positive"),
+        ({"w": ("queue_depth", -5.0, 2.0, "page")}, "positive ms"),
+        # burn watches need (short, long) with short < long
+        ({"w": ("ttft_seconds", 1000.0, 2.0, "page")}, "short < long"),
+        ({"w": ("ttft_seconds", (9.0, 2.0), 2.0, "page")},
+         "short < long"),
+        # drift/level watches take a single window
+        ({"w": ("queue_depth", (1.0, 2.0), 2.0, "page")},
+         "single window"),
+    ):
+        with pytest.raises(grafttrend.WatchPolicyError, match=match):
+            grafttrend.validate_policy(bad)
+    # the reducer refuses a malformed contract at construction
+    with pytest.raises(grafttrend.WatchPolicyError):
+        _reducer(policy={"w": ("q", 1.0, 2.0)})
+
+
+def test_pure_windowed_reductions():
+    s = [(1000.0, 1.0, 1.0), (2000.0, 0.0, 1.0), (3000.0, 1.0, 2.0)]
+    # burn: violating weight over total weight, over the budget
+    assert grafttrend.burn_rate(s, 3000.0, 2500.0, 0.5) \
+        == pytest.approx((2.0 / 4.0) / 0.5)
+    # windowing is exclusive of older points
+    assert grafttrend.burn_rate(s, 3000.0, 500.0, 0.5) \
+        == pytest.approx((1.0 / 2.0) / 0.5)
+    # silence is None, not a clean bill
+    assert grafttrend.burn_rate(s, 9000.0, 100.0, 0.5) is None
+    assert grafttrend.windowed_mean([], 0.0, 100.0) is None
+    assert grafttrend.windowed_mean(s, 3000.0, 2500.0) \
+        == pytest.approx(2.0 / 3.0)
+    # EWMA folds in t_ms order: newest value dominates at alpha=0.5
+    drift = grafttrend.ewma_drift(
+        [(1.0, 0.0, 1.0), (2.0, 1.0, 1.0)], 2.0, 10.0, alpha=0.5)
+    assert drift == pytest.approx(0.5)
+    sk = grafttrend.percentile_sketch(s, 3000.0, 10_000.0)
+    assert sk["points"] == 3 and sk["p50"] == 1.0 and sk["p99"] == 1.0
+    assert grafttrend.percentile_sketch([], 0.0, 1.0) == {"points": 0}
+
+
+# -- 2. seeded replay-identical alert fixtures --------------------------------
+
+
+def _burn_episode(red, t0=0.0, clean=False):
+    """Four seeded ttft samples starting at t0 (value = violating
+    count, weight = total count): all-violating unless ``clean``."""
+    for i in range(4):
+        red.observe("ttft_seconds", 0.0 if clean else 1.0, weight=1.0,
+                    t_ms=t0 + 1000.0 * (i + 1))
+
+
+def test_seeded_burn_trip_exactly_one_alert_and_latch_lifecycle():
+    red = _reducer()
+    _burn_episode(red, t0=0.0)
+    trips = red.evaluate(now_ms=5000.0)
+    assert len(trips) == 1
+    a = trips[0]
+    # full provenance: watch, series, window, mode, severity
+    assert a["watch"] == "slo_ttft_burn"
+    assert a["series"] == "ttft_seconds"
+    assert a["severity"] == "page"
+    assert a["mode"] == "burn"
+    assert a["window_ms"] == [10_000.0, 60_000.0]
+    assert a["threshold"] == 2.0
+    # all-violating burns the 5% budget at exactly 20x
+    assert a["value"] == pytest.approx(20.0)
+    # the latch: a sustained burn alerts exactly once
+    assert red.evaluate(now_ms=5100.0) == []
+    assert red.health_view()["latched"] == ["slo_ttft_burn"]
+    # a clean evaluation ends the episode (windows hold only clean
+    # samples at the later instant)...
+    _burn_episode(red, t0=100_000.0, clean=True)
+    assert red.evaluate(now_ms=164_000.0) == []
+    assert red.health_view()["latched"] == []
+    # ...and the NEXT burn alerts again — one alert per episode
+    _burn_episode(red, t0=200_000.0)
+    assert len(red.evaluate(now_ms=205_000.0)) == 1
+    assert len(red.alerts()) == 2
+
+
+def test_burn_needs_min_weight_floor():
+    red = _reducer(min_weight=4.0)
+    # two violating samples: burn is 20x but the short window carries
+    # weight 2 < 4 — insufficient evidence never pages
+    for i in range(2):
+        red.observe("ttft_seconds", 1.0, weight=1.0,
+                    t_ms=1000.0 * (i + 1))
+    assert red.evaluate(now_ms=3000.0) == []
+    state = red.describe(now_ms=3000.0)["watches"]["slo_ttft_burn"]
+    assert state["state"] == "insufficient"
+
+
+def test_seeded_drift_and_level_trips_and_quiet_run():
+    red = _reducer()
+    # drift: EWMA of the graftmem params drift over its 60s window
+    for i in range(3):
+        red.observe("graftmem_params_drift", 0.2,
+                    t_ms=1000.0 * (i + 1))
+    # level: a breaker held open across the 30s window
+    for i in range(3):
+        red.observe("hop_breaker_open", 1.0, t_ms=1000.0 * (i + 1))
+    trips = red.evaluate(now_ms=4000.0)
+    assert [(a["watch"], a["mode"], a["severity"]) for a in trips] == [
+        ("breaker_stuck_open", "level", "page"),
+        ("hbm_params_drift", "drift", "ticket"),
+    ]
+    assert trips[1]["series"] == "graftmem_params_drift"
+    assert trips[1]["value"] == pytest.approx(0.2)
+    assert trips[1]["window_ms"] == 60_000.0
+    # the quiet run: in-budget samples on every series, zero alerts
+    quiet = _reducer()
+    _burn_episode(quiet, t0=0.0, clean=True)
+    for i in range(3):
+        quiet.observe("graftmem_params_drift", 0.01,
+                      t_ms=1000.0 * (i + 1))
+        quiet.observe("hop_breaker_open", 0.0, t_ms=1000.0 * (i + 1))
+        quiet.observe("queue_depth", 2.0, t_ms=1000.0 * (i + 1))
+    assert quiet.evaluate(now_ms=4000.0) == []
+    assert quiet.alerts() == []
+    assert quiet.health_view()["alerts_journaled"] == 0
+
+
+def test_seeded_fixtures_replay_byte_identical():
+    """The replay-identity contract: two fresh reducers fed the same
+    seeded samples and evaluated at the same instants serialize
+    byte-identical alert journals minus the wall-clock field."""
+    journals = []
+    for _ in range(2):
+        red = _reducer()
+        _burn_episode(red, t0=0.0)
+        for i in range(3):
+            red.observe("graftmem_kv_drift", 0.4, t_ms=1000.0 * (i + 1))
+            red.observe("queue_depth", 40.0, t_ms=1000.0 * (i + 1))
+        red.evaluate(now_ms=5000.0)
+        red.evaluate(now_ms=5500.0)           # latched: no duplicates
+        journals.append(json.dumps(red.alerts(strip_time=True),
+                                   sort_keys=True))
+        tripped = [a["watch"] for a in red.alerts()]
+        assert tripped == ["hbm_kv_drift", "queue_depth_surge",
+                           "slo_ttft_burn"]
+    assert journals[0] == journals[1]
+
+
+def test_trip_emission_timeline_metric_blackbox():
+    """A trip emits the typed ``trend_alert`` timeline event,
+    increments ``trend_alerts_total{watch,severity}``, and journals a
+    black-box dump — all OUTSIDE the reducer's hold."""
+    reg = MetricsRegistry()
+    red = grafttrend.TrendReducer(registry=reg)   # blackbox on
+    base_events = len(grafttime.events(kinds=["trend_alert"]))
+    _burn_episode(red, t0=0.0)
+    assert len(red.evaluate(now_ms=5000.0)) == 1
+    evs = grafttime.events(kinds=["trend_alert"])
+    assert len(evs) == base_events + 1
+    ev = evs[-1]
+    assert ev["watch"] == "slo_ttft_burn" and ev["severity"] == "page"
+    assert ev["series"] == "ttft_seconds" and ev["mode"] == "burn"
+    snap = reg.snapshot()
+    assert sum(v for k, v in snap.items()
+               if k.startswith("trend_alerts_total")) == 1
+    assert any("watch=slo_ttft_burn" in k and "severity=page" in k
+               for k in snap if k.startswith("trend_alerts_total"))
+    assert any(d["reason"] == "trend_alert:slo_ttft_burn"
+               for d in grafttime.blackbox_dumps())
+    # the event kind is declared vocabulary, not ad-hoc
+    assert "trend_alert" in grafttime.EVENT_KINDS
+    assert grafttime.KIND_FIELDS["trend_alert"] == ("watch", "severity")
+
+
+# -- 3. the live tap ----------------------------------------------------------
+
+
+def test_poll_histogram_counter_and_gauge_taps():
+    reg = MetricsRegistry()
+    red = _reducer(registry=reg)
+    # the first poll only SEEDS the histogram/counter cursors (a
+    # fabricated baseline sample would charge pre-reducer history)
+    reg.observe("ttft_seconds", 45.0)     # violating (target 20s)
+    reg.observe("ttft_seconds", 0.01)
+    reg.inc("generate_requests_total", 2.0)
+    assert red.poll(now_ms=1000.0) == 0
+    # interval deltas become one (violating, total) sample per poll
+    for _ in range(2):
+        reg.observe("ttft_seconds", 45.0)
+    reg.observe("ttft_seconds", 0.01)
+    reg.inc("generate_requests_total", 4.0)
+    reg.inc("deadline_misses_total", 2.0)
+    reg.gauge("queue_depth", 5.0)
+    n = red.poll(now_ms=2000.0)
+    assert n >= 3     # ttft delta + deadline pair + queue_depth gauge
+    desc = red.describe(now_ms=2000.0)
+    ttft = desc["series"]["ttft_seconds"]
+    assert ttft["points"] == 1
+    # 2 of 3 new observations past the 20s target
+    assert ttft["sketch"]["last"] == pytest.approx(2.0)
+    dl = desc["series"]["deadline_misses_total"]
+    assert dl["points"] == 1
+    assert dl["sketch"]["last"] == pytest.approx(2.0)   # misses delta
+    assert desc["series"]["queue_depth"]["sketch"]["last"] \
+        == pytest.approx(5.0)
+    # sustained violation across polls trips the burn watch live
+    for k in range(3, 6):
+        for _ in range(2):
+            reg.observe("ttft_seconds", 45.0)
+        red.poll(now_ms=1000.0 * k)
+    trips = red.evaluate(now_ms=6000.0)
+    assert "slo_ttft_burn" in [a["watch"] for a in trips]
+    # observations inside the bucket STRADDLING the target are NOT
+    # charged (conservative bucket-edge accounting: the 20s ttft
+    # target falls inside the (10, 30] bucket)
+    reg2 = MetricsRegistry()
+    red2 = _reducer(registry=reg2)
+    reg2.observe("ttft_seconds", 0.01)
+    red2.poll(now_ms=1000.0)
+    reg2.observe("ttft_seconds", 0.01)    # ok
+    reg2.observe("ttft_seconds", 25.0)    # in (10, 30]: straddles
+    reg2.observe("ttft_seconds", 45.0)    # in (30, 60]: violating
+    red2.poll(now_ms=2000.0)
+    row = red2.describe(now_ms=2000.0)["series"]["ttft_seconds"]
+    assert row["sketch"]["last"] == pytest.approx(1.0)
+
+
+# -- 4. the refit golden + trend-driven sizing --------------------------------
+
+
+def _refit_journal():
+    """Two attribution rows generated at w_hbm=2e-9 s/B and an ICI
+    rate 8x that — the 1-D projections are exact, so the fit recovers
+    ici_byte_weight == 8.0 (vs the a-priori 4.0)."""
+    return {"name": "graftscope_attribution", "workloads": [
+        {"workload": "solo",
+         "measured_decode_seconds_per_token": 2e-3,
+         "modeled_cost_bytes_per_token": 1e6,
+         "modeled_comm_bytes_per_token": 0},
+        {"workload": "pp2",
+         "measured_decode_seconds_per_token": 4.8e-3,
+         "modeled_cost_bytes_per_token": 1.6e6 + 4.0 * 1e5,
+         "modeled_comm_bytes_per_token": 1e5},
+    ]}
+
+
+def _comm_costs():
+    mk = lambda label, mode, mb, comm: graftwatch.PlanCost(
+        label=label, batch_mode=mode, max_batch=mb, param_bytes=1000,
+        kv_bytes_per_row=100, paged_overhead=0.0, comm_bytes=comm)
+    return {"solo": mk("solo", "admission", 1, 0),
+            "batched": mk("batched", "iter", 4, 100_000)}
+
+
+def _comm_switcher(reg):
+    costs = _comm_costs()
+    certified = {lb: {"programs": {"_prefill": 1}, "program_total": 1,
+                      "programs_exact": lb == "solo"}
+                 for lb in costs}
+    return graftwatch.PlanSwitcher(
+        {lb: object() for lb in costs}, costs, certified,
+        graftwatch.TelemetryWatcher(registry=reg),
+        weights=graftwatch.CostWeights(ici_byte_weight=4.0),
+        registry=reg)
+
+
+def test_refit_golden_shifts_scores_by_exactly_delta_w_comm_bytes():
+    """THE refit golden: ``score_plans`` is linear in the ICI weight,
+    so installing re-fitted weights shifts every plan's score by
+    exactly ``(w' - w) * comm_bytes`` — the PR 11 calibration golden
+    preserved under live refit, and scoring-only by construction (the
+    switcher's plans are never touched, no program can be minted)."""
+    reg = MetricsRegistry()
+    red = _reducer(registry=reg)
+    sw = _comm_switcher(reg)
+    costs = sw.costs
+    est = graftwatch.TrafficEstimate(requests=8, concurrency=1)
+    w_before = sw.weights.ici_byte_weight
+    before = graftwatch.score_plans(est, costs, sw.weights)
+
+    fitted = grafttrend.refit(journal=_refit_journal(), switcher=sw,
+                              registry=reg, reducer=red)
+    assert fitted.ici_byte_weight == pytest.approx(8.0)
+    assert fitted.rows_used == 2
+    assert fitted.source == "graftscope_attribution"
+    assert sw.weights is fitted                 # threaded into scoring
+
+    after = graftwatch.score_plans(est, costs, sw.weights)
+    for label in costs:
+        assert after[label] - before[label] == pytest.approx(
+            (fitted.ici_byte_weight - w_before)
+            * costs[label].comm_bytes, rel=1e-12)
+    # zero-comm plans are untouched; the comm-moving plan shifts 4e5
+    assert after["solo"] == before["solo"]
+    assert after["batched"] - before["batched"] \
+        == pytest.approx(4.0e5, rel=1e-12)
+
+    # published: the gauge, the refit journal, the derived drift series
+    assert reg.snapshot()["costmodel_byte_weight"] == pytest.approx(8.0)
+    hist = red.refit_history()
+    assert hist[-1]["rows_used"] == 2
+    assert hist[-1]["ici_byte_weight"] == pytest.approx(8.0)
+    # |8/4 - 1| = 1.0 feeds cost_weight_drift: three refits trip it
+    for _ in range(2):
+        grafttrend.refit(journal=_refit_journal(), switcher=sw,
+                         registry=reg, reducer=red)
+    trips = red.evaluate()
+    assert [a["watch"] for a in trips] == ["cost_weight_drift"]
+    assert trips[0]["severity"] == "ticket"
+
+
+def test_refit_empty_journal_falls_back_a_priori():
+    reg = MetricsRegistry()
+    red = _reducer(registry=reg)
+    w = grafttrend.refit(journal={}, registry=reg, reducer=red)
+    assert w.rows_used == 0 and w.source == "a-priori"
+    # the resolved gauge is the a-priori constant, honestly labeled,
+    # and the drift series reads zero (no fabricated movement)
+    assert reg.snapshot()["costmodel_byte_weight"] \
+        == pytest.approx(CM.ICI_BYTE_WEIGHT)
+    assert red.refit_history()[-1]["source"] == "a-priori"
+    assert red.evaluate() == []
+
+
+def test_live_attribution_journal_shapes(monkeypatch):
+    costs = _comm_costs()
+    # no dispatches: no workload rows, the fit is honestly a-priori
+    monkeypatch.setattr(grafttrend.graftscope, "snapshot",
+                        lambda n=0: {"dispatch": {}})
+    j = grafttrend.live_attribution_journal(costs)
+    assert j["name"] == "graftscope_attribution"
+    assert j["workloads"] == []
+    assert graftwatch.fit_cost_weights(j).rows_used == 0
+    # recorded dispatches: one row per plan label with the measured
+    # per-call seconds and the statically modeled byte terms
+    monkeypatch.setattr(grafttrend.graftscope, "snapshot", lambda n=0: {
+        "dispatch": {
+            "engine._decode_seg": {"calls": 10, "seconds_total": 0.5},
+            "kv_pool._gather": {"calls": 0, "seconds_total": 0.0},
+        }})
+    j2 = grafttrend.live_attribution_journal(costs)
+    assert [w["workload"] for w in j2["workloads"]] \
+        == ["live_batched", "live_solo"]
+    for row in j2["workloads"]:
+        assert row["measured_decode_seconds_per_token"] \
+            == pytest.approx(0.05)
+        assert set(row["entry_points"]) == {"engine._decode_seg"}
+    by = {w["workload"]: w for w in j2["workloads"]}
+    assert by["live_solo"]["modeled_cost_bytes_per_token"] \
+        == pytest.approx(1100.0)
+    assert by["live_batched"]["modeled_cost_bytes_per_token"] \
+        == pytest.approx(1100.0 + CM.ICI_BYTE_WEIGHT * 1e5)
+    # no costs: an empty journal, never a fabricated row
+    assert grafttrend.live_attribution_journal(None)["workloads"] == []
+
+
+class _SizableRunner:
+    def __init__(self):
+        self.max_wait_s = 0.005
+        self.queue_limit = 4
+        self.max_batch = 4
+
+
+def test_trend_sizing_scales_from_base_and_never_compounds():
+    reg = MetricsRegistry()
+    red = _reducer(registry=reg)
+    costs = _comm_costs()
+    certified = {lb: {"programs": {"_prefill": 1}, "program_total": 1,
+                      "programs_exact": lb == "solo"}
+                 for lb in costs}
+    runner = _SizableRunner()
+    sw = graftwatch.PlanSwitcher(
+        {"solo": object(), "batched": runner}, costs, certified,
+        graftwatch.TelemetryWatcher(registry=reg), registry=reg)
+    sw.attach_trend(red)
+    # only the runner exposing the sizing seam is captured
+    assert set(sw._sizing_base) == {"batched"}
+    # silence never resizes: no samples, no journal row, knobs as-built
+    sw._resize(1)
+    assert sw.sizings() == [] and runner.max_wait_s == 0.005
+    # deep occupancy scales BOTH knobs from base, clamped
+    now = grafttime.now_ms()
+    for i in range(3):
+        red.observe("queue_depth", 12.0, t_ms=now - 10.0 * i)
+    sw._resize(2)
+    series, lo, hi = grafttrend.SIZING_POLICY["batch_wait_ms"]
+    assert series == "queue_depth"
+    scale = min(max(12.0 / runner.max_batch, lo), hi)     # 3.0
+    assert runner.max_wait_s == pytest.approx(0.005 * scale)
+    assert runner.queue_limit == 12
+    rows = sw.sizings()
+    assert len(rows) == 1 and rows[0]["wave"] == 2
+    assert rows[0]["knobs"]["batched"]["queue_limit"] == 12
+    assert rows[0]["estimate"] == pytest.approx(12.0)
+    # a second resize at the same estimate reproduces, never compounds
+    sw._resize(3)
+    assert runner.max_wait_s == pytest.approx(0.005 * scale)
+    assert runner.queue_limit == 12
+    # extreme occupancy clamps at max_scale x base
+    red.observe("queue_depth", 1e6, t_ms=grafttime.now_ms())
+    sw._resize(4)
+    assert runner.max_wait_s <= 0.005 * hi + 1e-12
+    assert runner.queue_limit <= round(4 * grafttrend.SIZING_POLICY[
+        "queue_limit"][2])
+    # the switcher's describe payload journals the resizes
+    assert sw.describe(n=4)["sizings"] == sw.sizings()
+
+
+def test_trend_smoke_sized_serving_byte_equal(monkeypatch):
+    """The acceptance smoke: a seeded loadgen mix against the
+    AUTO_PLAN_CONTINUOUS app (trend reducer attached by create_app)
+    under GRAFTSAN=1 GRAFTSCHED=1 — per-request outputs byte-equal to
+    the SAME schedule against an unsized switcher, every journaled
+    resize inside the declared clamp bounds, /debug/trend and the
+    /healthz trend block live, clean quiesce."""
+    from llm_sharding_demo_tpu.runtime import kv_pool
+    from llm_sharding_demo_tpu.utils import graftsched
+    monkeypatch.setenv("GRAFTSAN", "1")
+    monkeypatch.setenv("GRAFTSCHED", "1")
+    monkeypatch.setenv("GRAFTSCHED_SEED", "5")
+    graftsched.clear()
+
+    SEED, N = 7, 8
+    prof = loadgen.profile("agentic")
+    sched = loadgen.schedule(prof, SEED, N)
+    classes = sorted({(len(a.prompt.encode("utf-8")), a.max_new)
+                      for a in sched})
+    traffic = ",".join(f"{p}/{n}" for p, n in classes)
+
+    def run(sized):
+        client, recorder, reg = build_demo_app(
+            max_seq=64, max_batch=3, recorder_capacity=128,
+            continuous=True, auto_plan_traffic=traffic)
+        sw = client.app.plan_switcher
+        red = client.app.trend_reducer
+        assert sw._trend is red        # create_app attached the reducer
+        if not sized:
+            sw._trend = None           # the unsized comparison path
+        outs = []
+        for mode, rate in (("serial", 1.0), ("open", 60.0)):
+            rep = loadgen.run_load(client, prof, seed=SEED, n=N,
+                                   mode=mode, rate_scale=rate,
+                                   recorder=recorder,
+                                   trend=(red if sized else None))
+            assert rep["completed"] == N, rep["error_codes"]
+            # the driver's trend tap: each load run is ONE observation
+            # window — the report names what it tripped (bench's
+            # trend_detection quiet-vs-burst split rides this block)
+            if sized:
+                assert rep["trend"]["alerts_fired"] == \
+                    len(rep["trend"]["tripped"])
+            else:
+                assert "trend" not in rep
+            outs.append([(o.status, o.generated)
+                         for o in rep["outcomes"]])
+        return client, sw, red, outs
+
+    client, sw, red, sized_outs = run(sized=True)
+    _c2, _sw2, _r2, unsized_outs = run(sized=False)
+    # byte-equal per request: sizing changed WHEN batches form, never
+    # WHAT any request decodes
+    assert sized_outs == unsized_outs
+    assert _sw2.sizings() == []
+
+    # every journaled resize stays inside the declared clamp bounds
+    base = sw._sizing_base.get("batched")
+    for row in sw.sizings():
+        knobs = row["knobs"]["batched"]
+        _s, lo, hi = grafttrend.SIZING_POLICY["batch_wait_ms"]
+        assert base[0] * lo * 1e3 - 1e-9 <= knobs["batch_wait_ms"] \
+            <= base[0] * hi * 1e3 + 1e-9
+        _qs, q_lo, q_hi = grafttrend.SIZING_POLICY["queue_limit"]
+        assert 1 <= knobs["queue_limit"] <= round(base[1] * q_hi)
+
+    # the debug surface polls + evaluates by default; ?eval=0 is a
+    # pure read (scrapes must not double-evaluate monitoring state)
+    t1 = client.get("/debug/trend").json()
+    for key in ("watches", "series", "alerts", "refits", "policy",
+                "sizing", "derived_series", "serving"):
+        assert key in t1, key
+    assert set(t1["policy"]) == set(grafttrend.WATCH_POLICY)
+    t2 = client.get("/debug/trend?eval=0").json()
+    assert t2["evaluations"] == t1["evaluations"]
+    h = client.get("/healthz").json()
+    assert h["trend"]["watches"] == len(grafttrend.WATCH_POLICY)
+    assert h["trend"]["evaluations"] >= 1
+    assert "/debug/trend" in client.get("/debug").json()["surfaces"]
+
+    # clean quiesce: no held locks, no sanitizer leaks, no findings
+    kv_pool.graftsan_sweep(timeout=10.0)
+    assert graftsched.findings() == [], \
+        [f.format() for f in graftsched.findings()]
+
+
+# -- 5. the trend static pass -------------------------------------------------
+
+
+def _trend_fixture(tmp_path, source: str, **kw):
+    p = tmp_path / "utils" / "grafttrend.py"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    kw.setdefault("catalog", {"queue_depth": "gauge",
+                              "ttft_seconds": "histogram",
+                              "tpot_seconds": "histogram",
+                              "silent_series": "gauge"})
+    kw.setdefault("emitted", {"queue_depth", "ttft_seconds",
+                              "tpot_seconds"})
+    kw.setdefault("retired", {"old_series": "new_series"})
+    return trend.run_trend(str(tmp_path), paths=[str(p)], **kw)
+
+
+# NOTE: indented to match the in-test source literals — the fixture
+# helper dedents the CONCATENATED source once, so both halves must
+# share the same leading whitespace.
+_SLO_DECLS = """\
+        SLO_SOURCE_METRICS = {"ttft": "ttft_seconds",
+                              "tpot": "tpot_seconds"}
+        SLO_POLICY = {"prof": {"ttft": (1.0, 95), "tpot": (0.5, 95)}}
+"""
+
+
+def test_fixture_malformed_watch_rules(tmp_path):
+    findings, summary = _trend_fixture(tmp_path, _SLO_DECLS + """\
+        WATCH_POLICY = {
+            "ok_burn": ("ttft_seconds", (1000.0, 5000.0), 2.0, "page"),
+            "ok_burn2": ("tpot_seconds", (1000.0, 5000.0), 2.0, "page"),
+            "ok_level": ("queue_depth", 1000.0, 4.0, "ticket"),
+            "short_tuple": ("queue_depth", 1000.0, 2.0),
+            "bad_sev": ("queue_depth", 1000.0, 2.0, "email"),
+            "bool_thresh": ("queue_depth", 1000.0, True, "page"),
+            "burn_single": ("tpot_seconds", 1000.0, 2.0, "page"),
+            "burn_inverted": ("ttft_seconds", (9.0, 2.0), 2.0, "page"),
+            "level_pair": ("queue_depth", (1.0, 2.0), 2.0, "page"),
+        }
+        """)
+    assert all(f.rule == "malformed-watch" for f in findings)
+    by_scope = {f.scope: f.message for f in findings}
+    assert "4-tuple" in by_scope["short_tuple"]
+    assert "vocabulary" in by_scope["bad_sev"]
+    assert "4-tuple" in by_scope["bool_thresh"]
+    assert "short < long" in by_scope["burn_single"]
+    assert "short < long" in by_scope["burn_inverted"]
+    assert "single window_ms" in by_scope["level_pair"]
+    assert set(by_scope) == {"short_tuple", "bad_sev", "bool_thresh",
+                             "burn_single", "burn_inverted",
+                             "level_pair"}
+    assert all(f.path == "utils/grafttrend.py" and f.line >= 1
+               for f in findings)
+    # valid entries cover both SLO source series -> not vacuous
+    assert summary["trend_policies"]["utils/grafttrend.py"] == 3
+    assert summary["vacuous"] == []
+
+
+def test_fixture_watch_without_source_rules(tmp_path):
+    findings, summary = _trend_fixture(tmp_path, _SLO_DECLS + """\
+        WATCH_POLICY = {
+            "ok_b1": ("ttft_seconds", (1000.0, 5000.0), 2.0, "page"),
+            "ok_b2": ("tpot_seconds", (1000.0, 5000.0), 2.0, "page"),
+            "stale": ("old_series", 1000.0, 2.0, "page"),
+            "ghost": ("nonexistent_series", 1000.0, 2.0, "page"),
+            "silent": ("silent_series", 1000.0, 2.0, "ticket"),
+        }
+        """)
+    assert all(f.rule == "watch-without-source" for f in findings)
+    by_scope = {f.scope: f.message for f in findings}
+    assert "RETIRED" in by_scope["stale"]
+    assert "new_series" in by_scope["stale"]
+    assert "neither in METRIC_CATALOG" in by_scope["ghost"]
+    assert "no production call site emits" in by_scope["silent"]
+    assert set(by_scope) == {"stale", "ghost", "silent"}
+    assert summary["vacuous"] == []
+
+
+def test_fixture_slo_without_watch_and_dead_declarations(tmp_path):
+    findings, summary = _trend_fixture(tmp_path, _SLO_DECLS + """\
+        DERIVED_SERIES = {"dead_drift": "declared, never watched"}
+        SIZING_POLICY = {"knob": ("ghost_source", 0.5, 4.0)}
+        WATCH_POLICY = {
+            "only_ttft": ("ttft_seconds", (1000.0, 5000.0), 2.0,
+                          "page"),
+        }
+        """)
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    # the tpot promise has no live watch
+    uncovered = by_rule["slo-without-watch"]
+    assert {f.scope for f in uncovered} == {"tpot", "dead_drift"}
+    msgs = {f.scope: f.message for f in uncovered}
+    assert "nobody watches burn" in msgs["tpot"]
+    assert "no WATCH_POLICY entry consumes" in msgs["dead_drift"]
+    # the sizer reads a series that does not exist
+    assert [f.scope for f in by_rule["watch-without-source"]] == ["knob"]
+    assert summary["vacuous"] == []       # ttft IS covered
+
+
+def test_fixture_non_dict_policy_is_vacuous(tmp_path):
+    findings, summary = _trend_fixture(tmp_path, """\
+        WATCH_POLICY = dict(w=("queue_depth", 1000.0, 2.0, "page"))
+        """)
+    assert any(f.rule == "malformed-watch"
+               and "dict literal" in f.message for f in findings)
+    assert summary["vacuous"] == ["utils/grafttrend.py"]
+    # a policy whose valid entries cover zero SLO series is vacuous too
+    _f2, summary2 = _trend_fixture(tmp_path, _SLO_DECLS + """\
+        WATCH_POLICY = {
+            "levels_only": ("queue_depth", 1000.0, 4.0, "ticket"),
+        }
+        """)
+    assert summary2["vacuous"] == ["utils/grafttrend.py"]
+
+
+def test_repo_trend_pass_clean_and_nonvacuous():
+    findings, summary = trend.run_trend(REPO)
+    assert findings == [], [f.format() for f in findings]
+    assert summary["trend_checks"] >= 15
+    assert summary["vacuous"] == []
+    # every shipped watch is valid, and the policy module is live
+    assert summary["trend_policies"][
+        "llm_sharding_demo_tpu/utils/grafttrend.py"] \
+        == len(grafttrend.WATCH_POLICY)
+    # the runtime-side mirror of what the pass proves statically:
+    # every watched series exists, every SLO source series is watched
+    watched = {e[0] for e in grafttrend.WATCH_POLICY.values()}
+    for series in watched:
+        assert series in METRIC_CATALOG \
+            or series in grafttrend.DERIVED_SERIES, series
+    for metric, series in profiles.SLO_SOURCE_METRICS.items():
+        assert series in watched, (metric, series)
+    for series in grafttrend.DERIVED_SERIES:
+        assert series in watched, series
+    for knob, (series, lo, hi) in grafttrend.SIZING_POLICY.items():
+        assert lo < hi and (series in METRIC_CATALOG
+                            or series in grafttrend.DERIVED_SERIES)
+
+
+def test_bench_diff_classifies_trend_detection_metrics():
+    """The trend_detection bench row's gates point the right way: a
+    reducer that stops tripping on its pinned seeded burst went blind
+    (detection regresses DOWNWARD), and a watch that pages on healthy
+    quiet-phase traffic is worse than no watch (false positives
+    regress UPWARD). Context fields ride the row report-only."""
+    import sys
+    tools = os.path.join(REPO, "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    import bench_diff as bd
+    assert bd.classify("burst_detected") == "higher"
+    assert bd.classify("false_positives") == "lower"
+    # context, not performance: watch-count and raw alert tallies
+    assert bd.classify("watches_declared") is None
+    assert bd.classify("burst_alerts") is None
+    assert bd.classify("tripped") is None

@@ -78,7 +78,11 @@ _HIGHER_BETTER = ("tokens_per_sec", "tokens_per_second", "speedup",
                   # prefix-store depth all regress DOWNWARD — fewer
                   # resident rows per HBM byte
                   "before_first_preemption", "capacity_ratio",
-                  "prefix_store_depth")
+                  "prefix_store_depth",
+                  # trend_detection row (grafttrend): the seeded burst
+                  # is pinned, so a reducer that stops tripping on it
+                  # went blind — detection regresses DOWNWARD
+                  "burst_detected")
 _LOWER_BETTER = ("_ms", "latency", "step_ms", "prefill_ms",
                  # traffic_mix occupancy join: deeper queues at the
                  # same offered rate = the serving stack fell behind
@@ -105,7 +109,12 @@ _LOWER_BETTER = ("_ms", "latency", "step_ms", "prefill_ms",
                  # 0.0 and the int8 pool's designed savings is constant
                  # for fixed geometry, so ANY upward movement means the
                  # ledger lost an allocation or the model lost a term
-                 "drift")
+                 "drift",
+                 # trend_detection row (grafttrend): alerts fired
+                 # during the QUIET serial phases of the pinned mix —
+                 # a watch that pages on healthy traffic is worse than
+                 # no watch at all
+                 "false_positive")
 # environment properties, not code performance: the tunnel's RTT, the
 # reference CPU's own rate, and the attribution run's host-dependent
 # byte rates vary by machine/route — comparing them across rounds would

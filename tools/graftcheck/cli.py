@@ -40,8 +40,8 @@ from .core import load_baseline, split_findings, stale_audits
 # covers the semantic contract checks AND the recompile certifier —
 # they share the jax-tracing stage --lint-only gates off.
 PASS_IDS = ("lint", "sanitize", "locks", "faults", "scope", "slo",
-            "fleet", "watch", "timeline", "memory", "numerics",
-            "placement", "sem")
+            "fleet", "watch", "timeline", "trend", "memory",
+            "numerics", "placement", "sem")
 
 # payload keys each pass owns, with the value a SKIPPED pass reports:
 # every key is always present whatever --passes selected (the schema
@@ -63,6 +63,8 @@ _PASS_DEFAULTS = {
               "watch_vacuous": []},
     "timeline": {"timeline_checks": 0, "timeline_kinds": {},
                  "timeline_vacuous": []},
+    "trend": {"trend_checks": 0, "trend_policies": {},
+              "trend_vacuous": []},
     "memory": {"memory_checks": 0, "memory_ledgers": {},
                "memory_vacuous": []},
     "numerics": {"numerics_checks": 0, "numerics_contracts": {},
@@ -78,8 +80,9 @@ _PASS_DEFAULTS = {
 # strict pass can never go green by not looking
 _VACUOUS_KEYS = ("locks_vacuous", "scope_vacuous", "fault_vacuous",
                  "slo_vacuous", "fleet_vacuous", "watch_vacuous",
-                 "timeline_vacuous", "numerics_vacuous",
-                 "memory_vacuous", "placement_vacuous")
+                 "timeline_vacuous", "trend_vacuous",
+                 "numerics_vacuous", "memory_vacuous",
+                 "placement_vacuous")
 
 
 def _repo_root() -> str:
@@ -125,7 +128,7 @@ def run(root: str = None, lint_only: bool = False,
         sys.path.insert(0, root)
     try:
         from . import faults, fleet, lint, locks, memory, numerics, \
-            placement, sanitize, scope, slo, timeline, watch
+            placement, sanitize, scope, slo, timeline, trend, watch
 
         def _summary(runner, keymap, **kw):
             def thunk():
@@ -195,6 +198,10 @@ def run(root: str = None, lint_only: bool = False,
                 "timeline_checks": "timeline_checks",
                 "timeline_kinds": "timeline_kinds",
                 "timeline_vacuous": "vacuous"}),
+            "trend": _summary(trend.run_trend, {
+                "trend_checks": "trend_checks",
+                "trend_policies": "trend_policies",
+                "trend_vacuous": "vacuous"}),
             "memory": _summary(memory.run_memory, {
                 "memory_checks": "memory_checks",
                 "memory_ledgers": "memory_ledgers",
@@ -307,6 +314,9 @@ def run(root: str = None, lint_only: bool = False,
         "timeline_checks": fragments["timeline_checks"],
         "timeline_kinds": fragments["timeline_kinds"],
         "timeline_vacuous": fragments["timeline_vacuous"],
+        "trend_checks": fragments["trend_checks"],
+        "trend_policies": fragments["trend_policies"],
+        "trend_vacuous": fragments["trend_vacuous"],
         "memory_checks": fragments["memory_checks"],
         "memory_ledgers": fragments["memory_ledgers"],
         "memory_vacuous": fragments["memory_vacuous"],
@@ -556,6 +566,7 @@ def main(argv=None) -> int:
               f"{payload['fleet_checks']} fleet checks, "
               f"{payload['watch_checks']} watch checks, "
               f"{payload['timeline_checks']} timeline checks, "
+              f"{payload['trend_checks']} trend checks, "
               f"{payload['memory_checks']} memory checks, "
               f"{payload['numerics_checks']} numerics checks, "
               f"{payload['placement_checks']} placement checks"

@@ -1077,6 +1077,12 @@ def plan(module, config, mesh_axes: Dict[str, int], max_seq: int = 64,
         "mesh": dict(mesh_axes),
         "ici_byte_weight": (ICI_BYTE_WEIGHT if ici_byte_weight is None
                             else float(ici_byte_weight)),
+        # provenance for the weight above: a payload scored with a
+        # measured weight (startup calibration or a live grafttrend
+        # refit) must be distinguishable from one priced a-priori —
+        # two plan files can disagree on ranking for THIS reason alone
+        "ici_byte_weight_source": ("a-priori" if ici_byte_weight is None
+                                   else "provided"),
         "max_seq": max_seq,
         "traffic": [r.to_dict() for r in traffic],
         "plan": [r.to_dict() for r in rows],

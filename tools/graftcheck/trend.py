@@ -1,0 +1,325 @@
+"""graftcheck trend pass: declared-watch static analysis (compile-free).
+
+The grafttrend reducer (``llm_sharding_demo_tpu/utils/grafttrend.py``)
+evaluates DECLARED ``WATCH_POLICY = {watch: (series, window, threshold,
+severity)}`` contracts over the live telemetry — and a declared SLO is
+only a live promise if something watches its burn, while a declared
+watch is only an alarm if its series actually exists and is emitted.
+This pass (the static half of grafttrend, riding ``python -m
+tools.graftcheck`` and the strict in-suite driver — the same
+static+dynamic split as graftsan/graftlock/graftload/graftwatch/
+graftmem/graftshard, applied at the TREND level) holds the two
+declarations to each other:
+
+In-file declarations (the registration-annotation idiom):
+
+- ``WATCH_POLICY``: ``{watch: (series, window, threshold, severity)}``
+  — the live watch contract (``utils/grafttrend.py``). ``window`` is a
+  ``(short_ms, long_ms)`` pair for burn watches (SLO source series)
+  and a single ``window_ms`` for drift/level watches; ``severity`` is
+  from the fixed ``page``/``ticket`` vocabulary.
+- ``DERIVED_SERIES``: ``{series: provenance}`` — trend inputs COMPUTED
+  from producer pairs (graftmem measured-vs-modeled drift, refit
+  weight drift) rather than emitted as catalog metrics. The same
+  drift class bench_diff gates between runs; a declared derived
+  series is only honest if a live watch consumes it.
+- ``SIZING_POLICY``: ``{knob: (source_series, min_scale, max_scale)}``
+  — the between-waves sizing contract the switcher applies.
+- ``SLO_POLICY`` / ``SLO_SOURCE_METRICS`` (``loadgen/profiles.py``):
+  read for coverage — every declared SLO metric's source series must
+  be watched live.
+
+Rules (ids in brackets; suppressions ride the shared baseline):
+
+- [slo-without-watch]     an SLO_POLICY metric whose source series no
+                          WATCH_POLICY entry covers (a declared
+                          service promise nobody watches burn on), or
+                          a declared DERIVED_SERIES / SIZING_POLICY
+                          source no watch consumes (a dead derived
+                          declaration — the bench_diff-gated drift
+                          class with no live watch).
+- [watch-without-source]  a watch on a series that is neither in
+                          METRIC_CATALOG nor declared in
+                          DERIVED_SERIES (unknown), one on a RETIRED
+                          metric (stale — the replacement is spelled
+                          out), or one on a catalog series no
+                          production call site ever emits — an alarm
+                          wired to a wire nobody energizes.
+- [malformed-watch]       a WATCH_POLICY that is not a dict literal,
+                          an entry that is not a (series, window,
+                          threshold, severity) literal 4-tuple, a burn
+                          watch without a (short < long) window pair,
+                          a drift/level watch without a single
+                          positive window, a non-positive threshold,
+                          or a severity outside the vocabulary.
+
+``--strict`` additionally fails a VACUOUS pass (a module declaring
+WATCH_POLICY whose valid entries cover zero SLO source series — the
+contract stopped seeing the promises); ``cli.run --json`` carries
+``trend_checks`` / ``trend_policies`` / ``trend_vacuous``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import lint as L
+from .core import Finding
+from .locks import _module_assign
+from .slo import _emitted_metric_names, _str_dict_keys
+
+TREND_RULE_IDS = ("slo-without-watch", "watch-without-source",
+                  "malformed-watch")
+
+# the fixed severity vocabulary (utils/grafttrend.py SEVERITIES mirrors
+# this — tests pin the two stay equal)
+TREND_SEVERITIES = ("page", "ticket")
+
+
+def _num(node: ast.AST) -> Optional[float]:
+    """Positive-number constant value, else None."""
+    if isinstance(node, ast.Constant) \
+            and isinstance(node.value, (int, float)) \
+            and not isinstance(node.value, bool) and node.value > 0:
+        return float(node.value)
+    return None
+
+
+def _watch_entry(node: ast.AST):
+    """``(series, window, threshold, severity)`` literal 4-tuple ->
+    parsed values (window as a float or (short, long) tuple), else
+    None. Shape only — mode-dependent window arity is checked by the
+    caller, which knows the series classification."""
+    if not isinstance(node, (ast.Tuple, ast.List)) \
+            or len(node.elts) != 4:
+        return None
+    series_n, window_n, thresh_n, sev_n = node.elts
+    if not (isinstance(series_n, ast.Constant)
+            and isinstance(series_n.value, str) and series_n.value):
+        return None
+    if isinstance(window_n, (ast.Tuple, ast.List)):
+        parts = [_num(e) for e in window_n.elts]
+        if len(parts) != 2 or any(p is None for p in parts):
+            return None
+        window: object = (parts[0], parts[1])
+    else:
+        window = _num(window_n)
+        if window is None:
+            return None
+    threshold = _num(thresh_n)
+    if threshold is None:
+        return None
+    if not (isinstance(sev_n, ast.Constant)
+            and isinstance(sev_n.value, str)):
+        return None
+    return series_n.value, window, threshold, sev_n.value
+
+
+def run_trend(root: str, paths: Optional[List[str]] = None,
+              catalog: Optional[Dict[str, str]] = None,
+              emitted: Optional[Set[str]] = None,
+              retired: Optional[Dict[str, str]] = None,
+              ) -> Tuple[List[Finding], dict]:
+    """The whole static pass -> (findings, summary). ``summary``
+    carries ``trend_checks`` (declarations + coverage resolutions
+    validated — the vacuity guard on the pass itself),
+    ``trend_policies`` (per-module valid watch count) and ``vacuous``
+    (modules whose WATCH_POLICY covers no SLO source series — the
+    strict driver fails these). ``catalog``/``emitted``/``retired``
+    are injectable for rule fixtures; by default the real
+    METRIC_CATALOG / RETIRED_METRICS and the scanned production
+    emission sites."""
+    if catalog is None:
+        from llm_sharding_demo_tpu.utils.metrics import METRIC_CATALOG
+        catalog = METRIC_CATALOG
+    if retired is None:
+        from llm_sharding_demo_tpu.utils.metrics import RETIRED_METRICS
+        retired = RETIRED_METRICS
+    if emitted is None:
+        emitted = _emitted_metric_names(root, paths=paths)
+
+    findings: List[Finding] = []
+    checks = 0
+    policies: Dict[str, int] = {}
+    vacuous: List[str] = []
+
+    # pass 1: collect every declaration (watches may live in one
+    # module, the SLO promises they must cover in another)
+    slo_sources: Dict[str, str] = {}          # metric -> series
+    slo_metrics: Dict[str, Tuple[str, int]] = {}   # metric -> decl site
+    watches: Dict[str, Tuple[str, str, int, object, float, str]] = {}
+    watched_series: Set[str] = set()
+    derived: Dict[str, Tuple[str, int]] = {}  # series -> decl site
+    sizing: Dict[str, Tuple[str, str, int]] = {}   # knob -> (series, site)
+    watch_modules: List[Tuple[object, ast.stmt]] = []
+
+    for path in (paths if paths is not None else L.iter_sources(root)):
+        mod = L.index_module(path, root)
+        if mod is None:
+            continue
+        src_stmt = _module_assign(mod, "SLO_SOURCE_METRICS")
+        if src_stmt is not None:
+            entries = _str_dict_keys(src_stmt.value) or []
+            for metric, v in entries:
+                if isinstance(v, ast.Constant) \
+                        and isinstance(v.value, str):
+                    slo_sources[metric] = v.value
+        slo_stmt = _module_assign(mod, "SLO_POLICY")
+        if slo_stmt is not None:
+            for _profile, policy_node in (
+                    _str_dict_keys(slo_stmt.value) or []):
+                for metric, _tgt in _str_dict_keys(policy_node) or []:
+                    slo_metrics.setdefault(
+                        metric, (mod.relpath, slo_stmt.lineno))
+        der_stmt = _module_assign(mod, "DERIVED_SERIES")
+        if der_stmt is not None:
+            for series, _prov in _str_dict_keys(der_stmt.value) or []:
+                derived.setdefault(
+                    series, (mod.relpath, der_stmt.lineno))
+        siz_stmt = _module_assign(mod, "SIZING_POLICY")
+        if siz_stmt is not None:
+            for knob, v in _str_dict_keys(siz_stmt.value) or []:
+                if isinstance(v, (ast.Tuple, ast.List)) and v.elts \
+                        and isinstance(v.elts[0], ast.Constant) \
+                        and isinstance(v.elts[0].value, str):
+                    sizing[knob] = (v.elts[0].value, mod.relpath,
+                                    siz_stmt.lineno)
+        watch_stmt = _module_assign(mod, "WATCH_POLICY")
+        if watch_stmt is not None:
+            watch_modules.append((mod, watch_stmt))
+
+    slo_series = {slo_sources[m] for m in slo_metrics
+                  if m in slo_sources}
+
+    # pass 2: validate each WATCH_POLICY declaration
+    for mod, stmt in watch_modules:
+        checks += 1
+        line = stmt.lineno
+        decl = _str_dict_keys(stmt.value)
+        if decl is None:
+            findings.append(Finding(
+                "malformed-watch", mod.relpath, line, "<module>",
+                "WATCH_POLICY must be a dict literal {watch: (series, "
+                "window, threshold, severity)} — the trend pass reads "
+                "it statically"))
+            policies[mod.relpath] = 0
+            vacuous.append(mod.relpath)
+            continue
+        valid = 0
+        covered: Set[str] = set()
+        for watch, entry_node in decl:
+            checks += 1
+            parsed = _watch_entry(entry_node)
+            if parsed is None:
+                findings.append(Finding(
+                    "malformed-watch", mod.relpath, line, watch,
+                    f"watch {watch!r}: entry must be a literal "
+                    "(series, window, threshold, severity) 4-tuple "
+                    "with a non-empty series string, positive "
+                    "window(s)/threshold, and a string severity"))
+                continue
+            series, window, threshold, severity = parsed
+            if severity not in TREND_SEVERITIES:
+                findings.append(Finding(
+                    "malformed-watch", mod.relpath, line, watch,
+                    f"watch {watch!r}: severity {severity!r} outside "
+                    f"the vocabulary {TREND_SEVERITIES}"))
+                continue
+            is_burn = series in set(slo_sources.values())
+            if is_burn:
+                if not (isinstance(window, tuple)
+                        and window[0] < window[1]):
+                    findings.append(Finding(
+                        "malformed-watch", mod.relpath, line, watch,
+                        f"watch {watch!r}: a burn watch on SLO source "
+                        f"series {series!r} needs a (short_ms, "
+                        "long_ms) window pair with short < long — "
+                        "multi-window burn-rate is the declared "
+                        "alerting rule"))
+                    continue
+            elif isinstance(window, tuple):
+                findings.append(Finding(
+                    "malformed-watch", mod.relpath, line, watch,
+                    f"watch {watch!r}: {series!r} is not an SLO "
+                    "source series; drift/level watches take a single "
+                    "window_ms, not a window pair"))
+                continue
+            if series in retired:
+                findings.append(Finding(
+                    "watch-without-source", mod.relpath, line, watch,
+                    f"watch {watch!r} names RETIRED metric {series!r} "
+                    f"(stale declaration) — use {retired[series]}"))
+                continue
+            if series not in catalog and series not in derived:
+                findings.append(Finding(
+                    "watch-without-source", mod.relpath, line, watch,
+                    f"watch {watch!r} names series {series!r}, which "
+                    "is neither in METRIC_CATALOG nor declared in "
+                    "DERIVED_SERIES — an alarm on a series that does "
+                    "not exist"))
+                continue
+            if series in catalog and series not in emitted:
+                findings.append(Finding(
+                    "watch-without-source", mod.relpath, line, watch,
+                    f"watch {watch!r} names catalog series {series!r}, "
+                    "which no production call site emits — a watch on "
+                    "a silent series can never trip OR clear"))
+                continue
+            valid += 1
+            covered.add(series)
+            watches[watch] = (mod.relpath, series, line, window,
+                              threshold, severity)
+            watched_series.add(series)
+        policies[mod.relpath] = valid
+        if not covered & slo_series:
+            vacuous.append(mod.relpath)
+
+    # pass 3: coverage — every declared SLO metric's source series must
+    # have a live watch; every derived/sizing source must be consumed
+    anchor = (watch_modules[0][0].relpath, watch_modules[0][1].lineno) \
+        if watch_modules else None
+    for metric in sorted(slo_metrics):
+        source = slo_sources.get(metric)
+        if source is None:
+            continue   # the slo pass owns the missing-mapping finding
+        checks += 1
+        if source in watched_series:
+            continue
+        where = anchor if anchor is not None else slo_metrics[metric]
+        findings.append(Finding(
+            "slo-without-watch", where[0], where[1], metric,
+            f"SLO metric {metric!r} (source series {source!r}) has no "
+            "live WATCH_POLICY entry — a declared service promise "
+            "nobody watches burn on is only discovered at the next "
+            "bench run"))
+    for series in sorted(derived):
+        checks += 1
+        if series in watched_series:
+            continue
+        where = derived[series]
+        findings.append(Finding(
+            "slo-without-watch", where[0], where[1], series,
+            f"DERIVED_SERIES declares {series!r} but no WATCH_POLICY "
+            "entry consumes it — a dead measured-vs-modeled "
+            "declaration (the bench_diff-gated drift class must be "
+            "watched live)"))
+    for knob in sorted(sizing):
+        series, relpath, line = sizing[knob]
+        checks += 1
+        if series in catalog or series in derived:
+            continue
+        findings.append(Finding(
+            "watch-without-source", relpath, line, knob,
+            f"SIZING_POLICY knob {knob!r} reads series {series!r}, "
+            "which is neither in METRIC_CATALOG nor declared in "
+            "DERIVED_SERIES — the sizer would scale from a series "
+            "that does not exist"))
+
+    summary = {
+        "trend_checks": checks,
+        "trend_policies": policies,
+        "vacuous": sorted(vacuous),
+    }
+    return (sorted(findings, key=lambda f: (f.path, f.line, f.rule)),
+            summary)
