@@ -81,6 +81,13 @@ class WorkloadProfile:
     # shared prefixes exercise the prefix store + CoW machinery.
     shared_prefix_len: int = 0
     prefix_pool: int = 1
+    # prefix depth: > 0 overrides prefix_pool as the count of distinct
+    # deterministic shared prefixes the schedule draws from — the
+    # grafttier driver, letting a run touch a prefix population deeper
+    # than the device pool can hold so cold entries demote to the host
+    # tier. 0 keeps the prefix_pool draw: schedules are byte-identical
+    # to before the knob existed (replay purity pin).
+    prefix_depth: int = 0
     # cache busting: every request gets a UNIQUE leading prefix, so any
     # content-keyed reuse (prefix store) whiffs by construction
     cache_busting: bool = False

@@ -86,8 +86,15 @@ def arrival_fields(profile: WorkloadProfile, seed: int, k: int,
         # unique leading bytes: content-keyed reuse whiffs on purpose
         parts.append(f"bust-{seed}-{k}-")
     elif profile.shared_prefix_len > 0:
+        # prefix_depth > 0 widens the draw past prefix_pool so a run
+        # can touch more distinct prefixes than the device pool holds
+        # (the grafttier spill driver); 0 keeps the historical draw,
+        # and either way it is ONE randrange call so the rest of the
+        # per-arrival draw sequence is byte-identical (replay pin).
         parts.append(shared_prefix(
-            profile, rng.randrange(max(profile.prefix_pool, 1))))
+            profile,
+            rng.randrange(profile.prefix_depth
+                          or max(profile.prefix_pool, 1))))
     need = max(plen - sum(len(p) for p in parts), 1)
     parts.append("".join(rng.choice(_ALPHABET) for _ in range(need)))
     abandoned = rng.random() < profile.abandon_rate

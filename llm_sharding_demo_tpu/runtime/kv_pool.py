@@ -210,6 +210,13 @@ DONATED_ARGS = {"_scatter": (0,), "_scatter_row": (0,), "_copy": (0,),
 POOL_MOVER_SCOPES = ("PagedKVRunner._prefill_tables",
                      "PagedKVRunner._decode")
 
+# Tier-movement contract (tools/graftcheck tier pass): the ONLY scope
+# here allowed to invoke tier movement is the pressure hook wired by
+# attach_tier — the allocator calls it OUTSIDE ``_lock``, and every
+# other demotion/promotion site lives in kv_tier/prefix_cache behind
+# their own SPILL_SCOPES declarations.
+SPILL_SCOPES = ("KVBlockPool.attach_tier",)
+
 # Lock-discipline contract (tools/graftcheck locks pass): every shared
 # mutable attribute, by guarding lock. The allocator's accounting
 # (free list, refcounts, prefix registry, sanitizer provenance,
@@ -391,6 +398,12 @@ class BlockAllocator:
         self._san_grants = 0
         self._san_drops = 0
         self._on_free: Optional[Callable[[List[int]], None]] = None
+        # grafttier demotion hook (runtime/kv_tier.py, wired by
+        # KVBlockPool.attach_tier): called OUTSIDE ``_lock`` when
+        # allocation pressure would otherwise LRU-evict prefix entries;
+        # returns True when it moved one entry down a tier. None means
+        # no tier — plain eviction is the only relief valve.
+        self._tier_demote: Optional[Callable[[], bool]] = None
         if self.sanitize:
             _SAN_ALLOCATORS.add(self)
 
@@ -545,12 +558,35 @@ class BlockAllocator:
         # live again — only the remainder gets poisoned
         return out, [b for b in evict_freed if b not in self._ref]
 
+    def _demote_pressure(self, n: int) -> None:
+        """Best-effort demotion pre-pass, OUTSIDE ``_lock``: while
+        satisfying ``n`` would force LRU eviction and a tier is
+        attached, ask it to demote the LRU prefix entry to host RAM
+        instead. The hook does device reads (``spill_blocks`` under
+        ``_dev_lock``), so it cannot run under ``_lock`` — this is a
+        pre-pass by construction, and ``_alloc_locked``'s plain
+        eviction remains the in-lock fallback when the tier refuses
+        (budget exhausted, entry too large, or a concurrent race).
+        Each successful demotion removes one registry entry, so the
+        loop terminates."""
+        hook = self._tier_demote
+        if hook is None:
+            return
+        while True:
+            with self._lock:
+                pressed = len(self._free) < n and bool(self._prefix)
+            if not pressed or not hook():
+                return
+
     def alloc(self, n: int) -> List[int]:
         """Allocate ``n`` blocks at ref=1, LRU-evicting zero-ref prefix
-        entries as needed. All-or-nothing: raises ``PoolExhausted``
-        without taking anything when ``n`` cannot be satisfied."""
+        entries as needed (demoting them to the attached grafttier host
+        tier first, when one is wired). All-or-nothing: raises
+        ``PoolExhausted`` without taking anything when ``n`` cannot be
+        satisfied."""
         if n == 0:
             return []
+        self._demote_pressure(n)
         with self._lock:
             site = _call_site() if self.sanitize else ""
             out, evict_freed = self._alloc_locked(n, site)
@@ -575,6 +611,7 @@ class BlockAllocator:
         # replayable under a pinned seed
         if graftfault.inject("kv_pool.admit_alloc", "pool_spike"):
             return None
+        self._demote_pressure(n)
         evict_freed: List[int] = []
         with self._lock:
             if self.sanitize:
@@ -704,6 +741,50 @@ class BlockAllocator:
         with self._lock:
             return len(self._prefix)
 
+    # -- grafttier demotion surgery (runtime/kv_tier.py) ---------------------
+
+    def lease_lru_prefix(self) -> Optional[Tuple[bytes, Tuple[int, ...]]]:
+        """Peek the LRU prefix entry and take one caller ref per block
+        WITHOUT refreshing recency — the tier's demote lease. The refs
+        keep the blocks alive (and their contents immutable: registry
+        blocks are shared, so the CoW trap guards them) while the tier
+        copies them to host OUTSIDE this lock; release with ``free``
+        after ``demote_pop_prefix``. None when the registry is empty."""
+        with self._lock:
+            if not self._prefix:
+                return None
+            key = next(iter(self._prefix))
+            ids = self._prefix[key]
+            site = f"tier:{_call_site()}" if self.sanitize else ""
+            for b in ids:
+                self._ref[b] += 1
+                if self.sanitize:
+                    self._san_grant_locked(b, site)
+            if self.sanitize:
+                self._san_check_locked("tier_lease")
+            return key, ids
+
+    def demote_pop_prefix(self, key: bytes, expect_ids) -> bool:
+        """Drop the registry entry for ``key`` as a DEMOTION: the tier
+        captured the blocks' bytes and now owns the entry's cold copy,
+        so this is a tier move, not an eviction-to-oblivion (neither
+        ``evictions`` nor the eviction event fires — the tier emits
+        ``tier_demote`` once the host entry is installed). Returns
+        False without touching anything when the entry vanished or was
+        re-registered with different blocks since the lease (the tier
+        discards its stale host copy)."""
+        expect = tuple(expect_ids)
+        freed: List[int] = []
+        with self._lock:
+            if self._prefix.get(key) != expect:
+                return False
+            del self._prefix[key]
+            freed = self._deref_prefix_locked(expect)
+            if self.sanitize:
+                self._san_check_locked("tier_demote")
+        self._notify_freed(freed)
+        return True
+
     def _deref_prefix_locked(self, ids) -> List[int]:
         freed: List[int] = []
         site = _call_site() if self.sanitize else ""
@@ -832,6 +913,10 @@ class KVBlockPool:
         graftmem.track(self, "data", "pool_codes", self.data)
         if self.scales is not None:
             graftmem.track(self, "scales", "pool_scales", self.scales)
+        # grafttier host spill tier (runtime/kv_tier.py), attached via
+        # attach_tier — None means cold prefix entries LRU-evict to
+        # oblivion exactly as before
+        self.tier = None
 
         # per-instance defs (not the module-level ops directly): each
         # pool owns its jitted-program caches, so ``_cache_size()`` is
@@ -1099,6 +1184,53 @@ class KVBlockPool:
                 self.data = self._scatter_row(
                     self.data, cache.k, cache.v, row_j, roll_j)
 
+    def attach_tier(self, tier) -> None:
+        """Wire a grafttier host tier (runtime/kv_tier.py) below this
+        pool: allocation pressure demotes cold prefix entries into it
+        (``BlockAllocator._demote_pressure``) instead of evicting them
+        to oblivion, and the prefix store promotes demoted entries back
+        on an affinity hit."""
+        self.tier = tier
+        self.allocator._tier_demote = lambda: tier.demote_lru(self)
+
+    def spill_blocks(self, ids) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """Host copies of the RAW storage planes for ``ids`` — the
+        tier's demote reader: ``[L, n, 2, Hkv, bs, hd]`` codes plus the
+        ``[L, n, 2, Hkv]`` f32 scales for quantized pools (None for
+        full-precision pools). Codes spill AS codes, never dequantized
+        f32 — a quantized spill moves the narrow bytes (~4x fewer at
+        int8) and a demote/promote round trip is bit-exact at the code
+        level for every storage regime (no re-quantization drift)."""
+        idx = np.asarray(ids, dtype=np.int32)
+        with self._dev_lock:
+            if self.allocator.sanitize:
+                self._graftsan_check_tables(idx, "spill_blocks")
+            codes = np.asarray(self.data[:, jnp.asarray(idx)])
+            scales = (None if self.scales is None
+                      else np.asarray(self.scales[:, jnp.asarray(idx)]))
+        return codes, scales
+
+    def fill_blocks(self, ids, codes: np.ndarray,
+                    scales: Optional[np.ndarray] = None) -> None:
+        """Write spilled raw blocks back into freshly-allocated ids —
+        the tier's promote writer: the host copy returns through
+        ``jax.device_put`` into the SAME plane slots a scatter would
+        fill, byte-identical to the content ``spill_blocks`` captured.
+        The target blocks must be privately owned (the promote path
+        allocates them at ref=1 before registering the prefix entry) —
+        under GRAFTSAN a shared target trips the CoW write trap."""
+        idx_np = np.asarray(ids, dtype=np.int32)
+        with self._dev_lock:
+            if self.allocator.sanitize:
+                self._graftsan_check_tables(idx_np, "fill_blocks",
+                                            write=True)
+            idx = jnp.asarray(idx_np)
+            self.data = self.data.at[:, idx].set(
+                jax.device_put(codes).astype(self.data.dtype))
+            if self.scales is not None:
+                self.scales = self.scales.at[:, idx].set(
+                    jax.device_put(scales).astype(self.scales.dtype))
+
     def cow_copy(self, src: int) -> int:
         """Copy-on-write: allocate a private block, copy ``src`` into
         it, and return the new id. The caller retargets its table entry
@@ -1147,14 +1279,19 @@ class KVBlockPool:
         graftscope.sample("kv_cache_blocks_in_use", in_use,
                           component=component,
                           block_dtype=self.block_regime)
+        if self.tier is not None:
+            self.tier.note_gauges(component=component)
 
     def stats(self) -> dict:
-        return {**self.allocator.stats().as_dict(),
-                "block_size": self.block_size,
-                "blocks_per_row": self.nbm,
-                "block_dtype": self.block_regime,
-                "bytes_per_block": self._bytes_per_block,
-                "graftsan": self.allocator.sanitize}
+        out = {**self.allocator.stats().as_dict(),
+               "block_size": self.block_size,
+               "blocks_per_row": self.nbm,
+               "block_dtype": self.block_regime,
+               "bytes_per_block": self._bytes_per_block,
+               "graftsan": self.allocator.sanitize}
+        if self.tier is not None:
+            out["tier"] = self.tier.stats()
+        return out
 
 
 class PagedKVRunner:

@@ -1,0 +1,352 @@
+"""grafttier: the host-RAM KV spill tier below the device pool.
+
+Helix-style interactive serving is KV-capacity-bound: the content-keyed
+prefix registry (runtime/kv_pool.py) is worth far more than one
+device's HBM, yet before this module a cold zero-ref prefix entry was
+simply LRU-evicted and re-prefilled from scratch on its next hit. The
+tier turns that cliff into a ladder:
+
+- **demote** (``HostKVTier.demote_lru``): when allocation pressure
+  would LRU-evict a prefix entry (``BlockAllocator._demote_pressure``)
+  or the store's capacity trim fires (``PrefixCachingEngine``), the
+  entry's blocks are copied to bounded host-RAM numpy buffers as RAW
+  plane bytes — quantized pools spill codes + per-block scales, never
+  dequantized f32, so an int8 spill moves ~4x fewer bytes — and the
+  registry entry moves down a tier under its ORIGINAL content key.
+- **promote** (``HostKVTier.promote``): an affinity hit on a demoted
+  key (the prefix store's ``_lookup`` walk) allocates fresh device
+  blocks, ``device_put``s the host bytes back, and re-registers the
+  entry under the same key — so ``prefill_shared``'s zero-copy
+  reference semantics hold unchanged after a round trip, and a
+  promoted block's decode output is byte-identical to a never-demoted
+  run (pinned by tests/test_kv_tier.py for every storage regime).
+- **LRU-to-oblivion**: the host budget (``KV_HOST_BLOCKS``, the
+  serving knob) is a hard bound; admitting a new demotion discards the
+  host tier's own LRU entries, and an entry too large for the whole
+  budget falls back to plain device eviction (typed, never an error).
+
+Tier conservation (the blocks_in_use+blocks_free==blocks_total
+discipline, per tier): ``host_blocks_in_use == sum(entry blocks)``,
+``entries == demotions - promotions - discards``, occupancy never
+exceeds the budget — checked at every tier boundary when the owning
+allocator sanitizes (GRAFTSAN=1), raising ``GraftsanError`` with the
+numbers. Byte conservation rides graftmem: each host entry is a
+tracked ``host_spill`` holding (bytes MEASURED from the numpy buffers,
+never shape arithmetic), so a demote's ``mem_alloc`` and the matching
+promote/discard ``mem_free`` conserve ledger bytes pairwise and
+``/debug/memory``'s ``host_spill`` component equals the
+``/healthz kv_pool_stats`` tier block (pinned).
+
+Lock discipline: the tier's ``_lock`` is a LEAF — never held across
+allocator (``_lock``) or device (``_dev_lock``) work. Demote sequences
+lease (allocator lock) -> spill (device lock) -> pop (allocator lock)
+-> install (tier lock); promote pops the host entry first, then does
+device/allocator work with the tier lock released. A promote-triggered
+allocation may recursively demote OTHER entries without deadlock
+precisely because of this ordering.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import graftmem, graftsched, grafttime
+from ..utils.metrics import REGISTRY
+from .kv_pool import GraftsanError, PoolExhausted
+
+# Tier contract (tools/graftcheck tier pass): the declared tier
+# topology, one entry per tier below "device". ``budget`` names the
+# serving knob that bounds it, ``holding`` the graftmem-tracked store
+# attribute (must appear in MEMORY_LEDGER — the tier-ledger-gap rule),
+# ``eviction`` the final-tier policy, and the two events are the
+# timeline kinds its demote/promote scopes must emit (the
+# tier-event-drift rule).
+TIER_POLICY = {
+    "host": {
+        "below": "device",
+        "budget": "KV_HOST_BLOCKS",
+        "eviction": "lru-to-oblivion",
+        "holding": "_entries",
+        "component": "host_spill",
+        "demote_event": "tier_demote",
+        "promote_event": "tier_promote",
+    },
+}
+
+# The only scopes allowed to move block bytes BETWEEN tiers (call
+# ``spill_blocks``/``fill_blocks`` or pop/install host entries) — the
+# tier pass flags tier movement outside them, and a declared scope
+# that stopped moving anything is a stale finding.
+SPILL_SCOPES = ("HostKVTier.demote_lru", "HostKVTier.promote")
+
+# Registry-handoff contract (tools/graftcheck fleet pass): promotion
+# re-registers the demoted entry under its ORIGINAL content key (and
+# answers a lost promote race from the device registry), so the tier
+# is a consumer of the adoption surface — the only one here.
+HANDOFF_SCOPES = ("HostKVTier.promote",)
+
+# The tier never moves blocks through the gather/scatter movers: its
+# device traffic is the pool's raw-plane ``spill_blocks`` /
+# ``fill_blocks``, which carry their own graftsan table checks under
+# ``_dev_lock`` (declared empty on purpose — the fleet pass requires
+# the adoption boundary to state its mover contract explicitly).
+POOL_MOVER_SCOPES = ()
+
+# Timeline contract (tools/graftcheck timeline pass): tier movements
+# land on the unified causal stream — a demotion storm is only
+# diagnosable beside the admissions/evictions that provoked it, and a
+# promote's dur_ms IS the affinity hit's stall.
+TIMELINE_EVENTS = {
+    "tier_demote": "HostKVTier.demote_lru",
+    "tier_promote": "HostKVTier.promote",
+}
+
+# Memory-ledger contract (tools/graftcheck memory pass +
+# utils/graftmem): every demoted entry's host buffers are tracked
+# ``host_spill`` holdings under the ``_entries`` store — bytes
+# measured from the actual numpy buffers at demote time, released at
+# promote/discard, so the ledger conserves across every tier move.
+MEMORY_LEDGER = {"_entries": "host_spill"}
+
+# Lock-discipline contract (tools/graftcheck locks pass): the entry
+# store, occupancy, and movement counters are written by demoting
+# allocator threads and promoting lookup threads concurrently — all
+# under the tier's own ``_lock`` (a leaf: see the module docstring).
+GUARDED_STATE = {
+    "_entries": "_lock", "_blocks_in_use": "_lock",
+    "demotions": "_lock", "promotions": "_lock", "discards": "_lock",
+    "_promote_ms": "_lock",
+}
+LOCK_ORDER = ("_lock",)
+
+
+@dataclasses.dataclass
+class _HostEntry:
+    """One demoted prefix entry: the raw plane bytes of its blocks
+    (codes, plus scales for quantized pools), the device block count
+    they stand for, and the graftmem handle measuring them."""
+    codes: np.ndarray
+    scales: Optional[np.ndarray]
+    n_blocks: int
+    mem_handle: int
+
+
+class HostKVTier:
+    """Bounded host-RAM store of demoted prefix entries, LRU-ordered
+    (insertion order IS the LRU order; promotes pop). Attach below a
+    ``KVBlockPool`` with ``pool.attach_tier(tier)``."""
+
+    def __init__(self, host_blocks: int):
+        if host_blocks < 1:
+            raise ValueError(
+                f"host_blocks={host_blocks} must be >= 1 (a zero-block "
+                "tier is 'no tier' — leave it unattached instead)")
+        self.host_blocks = host_blocks
+        self._lock = graftsched.rlock("kv_tier.HostKVTier._lock")
+        # content-key -> _HostEntry; OrderedDict insertion order is the
+        # LRU order of the HOST tier (oldest demotion discards first)
+        self._entries: "OrderedDict[bytes, _HostEntry]" = OrderedDict()
+        self._blocks_in_use = 0
+        self.demotions = 0
+        self.promotions = 0
+        self.discards = 0
+        self._promote_ms = 0.0
+
+    # -- conservation (per-tier graftsan) ------------------------------------
+
+    def _check_locked(self, boundary: str) -> None:
+        """Per-tier conservation at a boundary (GRAFTSAN discipline):
+        occupancy equals the sum of live entries' blocks, the entry
+        count equals the movement ledger, and occupancy respects the
+        budget. A violation is an accounting bug — raise with the
+        numbers, not a silent drift."""
+        held = sum(e.n_blocks for e in self._entries.values())
+        if held != self._blocks_in_use:
+            raise GraftsanError(
+                f"[tier:{boundary}] host-block conservation broken: "
+                f"{held} blocks held by entries != {self._blocks_in_use} "
+                "in use")
+        moved = self.demotions - self.promotions - self.discards
+        if len(self._entries) != moved:
+            raise GraftsanError(
+                f"[tier:{boundary}] entry conservation broken: "
+                f"{len(self._entries)} entries != {self.demotions} "
+                f"demotions - {self.promotions} promotions - "
+                f"{self.discards} discards")
+        if self._blocks_in_use > self.host_blocks:
+            raise GraftsanError(
+                f"[tier:{boundary}] budget broken: {self._blocks_in_use}"
+                f" blocks in use > {self.host_blocks} budget")
+
+    # -- demotion ------------------------------------------------------------
+
+    def demote_lru(self, pool) -> bool:
+        """Move the device pool's LRU prefix entry down to this tier.
+        Returns True when an entry moved (its device blocks freed);
+        False when there is nothing to demote, the entry exceeds the
+        whole host budget (caller falls back to plain eviction — typed,
+        never an error), or the entry changed under the lease (the
+        stale host copy is discarded). Sequencing per the module
+        docstring: allocator lease -> device spill -> allocator pop ->
+        tier install, no lock held across stages."""
+        alloc = pool.allocator
+        lease = alloc.lease_lru_prefix()
+        if lease is None:
+            return False
+        key, ids = lease
+        n = len(ids)
+        if n > self.host_blocks:
+            alloc.free(ids)
+            return False
+        codes, scales = pool.spill_blocks(ids)
+        if not alloc.demote_pop_prefix(key, ids):
+            # raced: the entry was dropped/evicted/re-registered since
+            # the lease — our host copy is stale, discard it
+            alloc.free(ids)
+            return False
+        alloc.free(ids)
+        handle = graftmem.track(self, "_entries", "host_spill",
+                                (codes, scales))
+        dropped: List[_HostEntry] = []
+        sanitize = alloc.sanitize
+        with self._lock:
+            prior = self._entries.pop(key, None)
+            if prior is not None:
+                # same content demoted twice (re-prefilled between the
+                # moves): the newer bytes replace the stale copy, which
+                # leaves as a discard so the movement ledger balances
+                self._blocks_in_use -= prior.n_blocks
+                self.discards += 1
+                dropped.append(prior)
+            # LRU-to-oblivion: the budget is hard — admitting this
+            # entry discards the host tier's own coldest entries
+            while (self._blocks_in_use + n > self.host_blocks
+                   and self._entries):
+                _, old = self._entries.popitem(last=False)
+                self._blocks_in_use -= old.n_blocks
+                self.discards += 1
+                dropped.append(old)
+            self._entries[key] = _HostEntry(codes, scales, n, handle)
+            self._blocks_in_use += n
+            self.demotions += 1
+            in_use = self._blocks_in_use
+            n_entries = len(self._entries)
+            if sanitize:
+                self._check_locked("demote")
+        # ledger + bus emission outside the hold (the graftmem
+        # discipline: the apparatus stays off its own critical section)
+        for old in dropped:
+            graftmem.release(old.mem_handle)
+        REGISTRY.inc("tier_demotions_total")
+        grafttime.emit("tier_demote", blocks=n, host_blocks=in_use,
+                       host_entries=n_entries)
+        return True
+
+    # -- promotion -----------------------------------------------------------
+
+    def has(self, key: bytes) -> bool:
+        """Is ``key`` demoted here? (No LRU effect — peeking is free.)"""
+        with self._lock:
+            return key in self._entries
+
+    def promote(self, pool, key: bytes) -> Optional[Tuple[int, ...]]:
+        """Promote a demoted entry back into the device pool ahead of
+        admission: allocate fresh blocks (which may recursively demote
+        OTHER cold entries — the tier lock is not held), ``device_put``
+        the host bytes back, and re-register under the SAME content
+        key. Returns the block ids with one caller ref per block (the
+        ``lookup_prefix`` contract — release with ``free``), or None
+        when the key is not demoted here or the device pool cannot
+        host it right now (the entry stays demoted; the caller walks
+        on to shallower depths)."""
+        alloc = pool.allocator
+        if alloc.has_prefix(key):
+            # already resident (a concurrent promote or re-prefill won
+            # the race): the host copy is redundant — drop it and
+            # answer from the device registry
+            with self._lock:
+                entry = self._entries.pop(key, None)
+                if entry is not None:
+                    self._blocks_in_use -= entry.n_blocks
+                    self.discards += 1
+                    if alloc.sanitize:
+                        self._check_locked("promote_redundant")
+            if entry is not None:
+                graftmem.release(entry.mem_handle)
+            return alloc.lookup_prefix(key)
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is not None:
+                self._blocks_in_use -= entry.n_blocks
+        if entry is None:
+            return None
+        t0 = time.perf_counter()
+        try:
+            ids = alloc.alloc(entry.n_blocks)
+        except PoolExhausted:
+            # the device pool cannot host the entry even after demoting
+            # everything demotable: put the host copy back (front of
+            # the LRU — it just missed, it is warm) and report a miss
+            with self._lock:
+                self._entries[key] = entry
+                self._entries.move_to_end(key, last=False)
+                self._blocks_in_use += entry.n_blocks
+                if alloc.sanitize:
+                    self._check_locked("promote_refused")
+            return None
+        pool.fill_blocks(ids, entry.codes, entry.scales)
+        alloc.register_prefix(key, ids)
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        sanitize = alloc.sanitize
+        with self._lock:
+            self.promotions += 1
+            self._promote_ms += dur_ms
+            in_use = self._blocks_in_use
+            if sanitize:
+                self._check_locked("promote")
+        graftmem.release(entry.mem_handle)
+        REGISTRY.inc("tier_promotions_total")
+        grafttime.emit("tier_promote", blocks=entry.n_blocks,
+                       host_blocks=in_use, dur_ms=round(dur_ms, 3))
+        return tuple(ids)
+
+    # -- observability -------------------------------------------------------
+
+    def note_gauges(self, component: str = "pool") -> None:
+        with self._lock:
+            in_use = self._blocks_in_use
+        REGISTRY.gauge("kv_host_blocks_in_use", in_use,
+                       component=component)
+        REGISTRY.gauge("kv_host_blocks_total", self.host_blocks,
+                       component=component)
+
+    def graftsan_check(self, boundary: str = "explicit") -> None:
+        """Run the per-tier conservation check on demand (tests and
+        the /healthz handler's tier drift assert)."""
+        with self._lock:
+            self._check_locked(boundary)
+
+    def stats(self) -> Dict[str, object]:
+        """The tier block ``KVBlockPool.stats`` merges (and therefore
+        what ``/healthz kv_pool_stats`` serves): occupancy in the
+        device pool's block denomination, the movement ledger, and the
+        MEASURED host bytes (``graftmem.holding_bytes`` over the
+        ``host_spill`` entries — the same single bookkeeping path
+        ``/debug/memory`` reads, so the two surfaces cannot drift)."""
+        with self._lock:
+            out = {
+                "host_blocks_total": self.host_blocks,
+                "host_blocks_in_use": self._blocks_in_use,
+                "host_entries": len(self._entries),
+                "demotions": self.demotions,
+                "promotions": self.promotions,
+                "discards": self.discards,
+                "promote_ms_total": round(self._promote_ms, 3),
+            }
+        out["host_bytes"] = graftmem.holding_bytes(self, "_entries")
+        return out

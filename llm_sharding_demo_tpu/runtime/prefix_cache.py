@@ -95,6 +95,13 @@ POOL_MOVER_SCOPES = ("PrefixCachingEngine._gather_entry",
 HANDOFF_SCOPES = ("PrefixCachingEngine._lookup",
                   "PrefixCachingEngine._insert_pool")
 
+# Tier-movement contract (tools/graftcheck tier pass): the store's two
+# grafttier touch points — the depth walk promotes a demoted entry on
+# an affinity hit, and the capacity trim demotes the device LRU before
+# falling back to plain eviction.
+SPILL_SCOPES = ("PrefixCachingEngine._lookup",
+                "PrefixCachingEngine._insert_pool")
+
 # HBM-ledger contract (tools/graftcheck memory pass + utils/graftmem):
 # the store's deep-copied cache pytrees (non-pool mode) are the
 # module's long-lived device holdings — one handle-keyed ledger entry
@@ -247,9 +254,19 @@ class PrefixCachingEngine:
         with ``allocator.free``)."""
         m_max = (len(prompt) - 1) // self.chunk  # leave >=1 token to forward
         if self._pool is not None:
+            tier = self._pool.tier
             for m in range(m_max, 0, -1):
-                ids = self._pool.allocator.lookup_prefix(
-                    self._key(prompt, m, self.chunk))
+                key = self._key(prompt, m, self.chunk)
+                ids = self._pool.allocator.lookup_prefix(key)
+                if ids is None and tier is not None and tier.has(key):
+                    # demoted entry (grafttier): promote its blocks back
+                    # into the pool ahead of admission. The entry kept
+                    # its content key through the round trip, so the
+                    # zero-copy reference semantics downstream
+                    # (prefill_shared re-walking this very key) hold
+                    # unchanged; a refused promote (pool full even
+                    # after demoting) just walks on to shallower depths.
+                    ids = tier.promote(self._pool, key)
                 if ids is not None:
                     return m, ids
             return 0, None
@@ -304,7 +321,13 @@ class PrefixCachingEngine:
             alloc.free(fresh)  # entry refs (if registered) keep them;
             # on a scatter/register failure this is the leak guard
         while alloc.prefix_len() > self.capacity:
-            alloc.evict_lru()
+            # capacity trim prefers the tier ladder: demote the LRU
+            # entry to host RAM when a grafttier is attached, and only
+            # evict to oblivion when there is no tier (or it refused —
+            # budget exhausted / race)
+            tier = self._pool.tier
+            if tier is None or not tier.demote_lru(self._pool):
+                alloc.evict_lru()
 
     def _insert(self, prompt: np.ndarray, m_chunks: int, cache) -> None:
         """Store a COPY of ``cache`` as the state after ``m_chunks`` full

@@ -676,6 +676,15 @@ def create_app(cfg: Optional[ServingConfig] = None,
             raise ValueError("kv_pool injected but KV_POOL_BLOCKS=0 — "
                              "a silently unused pool would misreport "
                              "the serving composition")
+        if (kv_pool is not None and cfg.kv_host_blocks > 0
+                and kv_pool.tier is None):
+            # grafttier host spill tier (runtime.kv_tier): cold prefix
+            # entries demote to bounded host RAM instead of LRU-evicting
+            # to oblivion, and promote back on an affinity hit. An
+            # injected pool may arrive with its tier already attached
+            # (graftfleet replicas share the pool AND its tier).
+            from ..runtime.kv_tier import HostKVTier
+            kv_pool.attach_tier(HostKVTier(cfg.kv_host_blocks))
         prefix_runner = None
         if cfg.prefix_cache > 0:
             # cross-request KV reuse (runtime.prefix_cache): wraps the
@@ -766,6 +775,7 @@ def create_app(cfg: Optional[ServingConfig] = None,
             "kv_pool_blocks": cfg.kv_pool_blocks,
             "kv_block_size": cfg.kv_block_size,
             "kv_pool_dtype": cfg.kv_pool_dtype,
+            "kv_host_blocks": cfg.kv_host_blocks,
             # graftfleet (llm_sharding_demo_tpu/fleet): this replica's
             # declared role and the prefix-store alignment width the
             # router's affinity keys must match
@@ -833,6 +843,15 @@ def create_app(cfg: Optional[ServingConfig] = None,
             st["pool_bytes"] = (
                 graftmem.holding_bytes(kv_pool, "data")
                 + graftmem.holding_bytes(kv_pool, "scales"))
+            if kv_pool.tier is not None:
+                # Per-tier conservation (the grafttier analog of the
+                # block assert above): entries, occupancy, and the
+                # movement ledger must agree, and the tier block's
+                # measured host_bytes is the graftmem host_spill
+                # component's own bookkeeping (holding_bytes) — drift
+                # turns the health check red, not a silently wrong
+                # capacity report.
+                kv_pool.tier.graftsan_check("healthz")
             live["kv_pool_stats"] = st
         # Byte-conservation invariant (the blocks_in_use + blocks_free
         # == blocks_total pattern, applied to the HBM ledger): the

@@ -109,6 +109,14 @@ class ServingConfig:
     # TOLERANCE_POLICY). "" (default) keeps full-precision blocks and
     # every byte-equality pin. Requires KV_POOL_BLOCKS > 0.
     kv_pool_dtype: str = ""
+    # Host-RAM KV spill tier (runtime.kv_tier — grafttier): >0 attaches
+    # a bounded host tier of that many blocks below the device pool.
+    # Cold zero-ref prefix entries demote (raw codes + scales as numpy)
+    # instead of LRU-evicting to oblivion, and promote back through
+    # device_put on an affinity hit — the prefix store's effective
+    # depth becomes device + host at the cost of a promote's host->HBM
+    # copy. 0 = off. Requires KV_POOL_BLOCKS > 0.
+    kv_host_blocks: int = 0
     # Prefix-store alignment width (runtime.prefix_cache): >0 overrides
     # the store's chunk (default: PREFILL_CHUNK, else 64). The fleet
     # router's affinity keys are content keys at THIS width, so every
@@ -223,6 +231,15 @@ class ServingConfig:
                     f"full-precision regime {regime!r} — the pool "
                     "already stores full-precision blocks by default; "
                     "quantized storage takes 'int8' or 'fp8'")
+        if self.kv_host_blocks < 0:
+            raise ValueError(
+                f"KV_HOST_BLOCKS={self.kv_host_blocks} must be >= 0 "
+                "(0 disables the host tier, >0 is its block budget)")
+        if self.kv_host_blocks > 0 and self.kv_pool_blocks == 0:
+            raise ValueError(
+                "KV_HOST_BLOCKS sizes the host spill tier below the "
+                "paged pool; it needs KV_POOL_BLOCKS > 0 (a silently "
+                "ignored knob would misreport the serving composition)")
         if self.prefix_chunk < 0:
             raise ValueError(
                 f"PREFIX_CHUNK={self.prefix_chunk} must be >= 0 "
@@ -361,6 +378,7 @@ def from_env() -> ServingConfig:
         kv_pool_blocks=_env_int("KV_POOL_BLOCKS", 0),
         kv_block_size=_env_int("KV_BLOCK_SIZE", 16),
         kv_pool_dtype=os.environ.get("KV_POOL_DTYPE", ""),
+        kv_host_blocks=_env_int("KV_HOST_BLOCKS", 0),
         prefix_chunk=_env_int("PREFIX_CHUNK", 0),
         fleet_role=os.environ.get("FLEET_ROLE", ""),
         auto_plan=_env_bool("AUTO_PLAN"),

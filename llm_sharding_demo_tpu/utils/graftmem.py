@@ -88,6 +88,8 @@ MEMORY_COMPONENTS = {
     "spec_buffers": "speculative-decode device token buffers",
     "prefix_store": "prefix-cache store holdings (non-pool mode "
                     "deep-copied cache pytrees)",
+    "host_spill":   "grafttier host-RAM spill store (demoted prefix "
+                    "entries' raw block codes + scales as numpy)",
 }
 
 # snapshot() holdings-table bound: hottest entries first, truncation

@@ -120,6 +120,10 @@ EVENT_KINDS = {
     "mem_free":       "a graftmem ledger holding shrank or retired",
     "trend_alert":    "a declared grafttrend watch tripped (burn/"
                       "drift/level)",
+    "tier_demote":    "grafttier spilled a cold prefix entry's blocks "
+                      "to the host-RAM tier",
+    "tier_promote":   "grafttier promoted a demoted entry's blocks "
+                      "back into the device pool",
 }
 
 # kind -> keyword arguments an emit SITE must spell out (values may be
@@ -146,6 +150,12 @@ KIND_FIELDS = {
     "mem_alloc":      ("component", "bytes"),
     "mem_free":       ("component", "bytes"),
     "trend_alert":    ("watch", "severity"),
+    # tier movements are REPLAY-PINNED (like eviction): under a pinned
+    # schedule the same entries demote/promote at the same points —
+    # only the dur_ms a promote carries is wall-clock (already exempt
+    # via REPLAY_EXEMPT_FIELDS)
+    "tier_demote":    ("blocks",),
+    "tier_promote":   ("blocks",),
 }
 
 # Replay contract: fields that carry wall-clock/interleaving truth and

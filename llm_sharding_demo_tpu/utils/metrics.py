@@ -153,6 +153,18 @@ METRIC_CATALOG: Dict[str, str] = {
     "kv_cache_blocks_in_use": "gauge",
     "kv_cache_blocks_total": "gauge",
     "kv_pool_bytes_per_block": "gauge",
+    # host-RAM KV spill tier (runtime/kv_tier.py — grafttier): demotions
+    # move a cold zero-ref prefix entry's raw blocks (codes + scales for
+    # quantized pools) to bounded host buffers instead of evicting to
+    # oblivion; promotions device_put them back on an affinity hit. The
+    # gauge pair is the host tier's block occupancy in the SAME block
+    # denomination as the device pair above (host blocks hold the same
+    # bytes a device block does), so prefix-store depth across tiers is
+    # one query.
+    "tier_demotions_total": "counter",
+    "tier_promotions_total": "counter",
+    "kv_host_blocks_in_use": "gauge",
+    "kv_host_blocks_total": "gauge",
     "jit_program_cache_size": "gauge",      # compiled programs per component
     "spec_acceptance_rate": "gauge",        # emitted tokens per verify
     # continuous planning (utils/graftwatch.py): one increment per live

@@ -67,6 +67,8 @@ _QUICK_MODULES = {
     "test_prefix_cache",    # cross-request KV reuse byte-exactness
     "test_kv_pool",         # paged KV pool: paged ≡ contiguous, CoW,
                             # preempt/resume recompute exactness
+    "test_kv_tier",         # grafttier host spill: demote/promote
+                            # byte-identity, ledgers, tier pass
     "test_paged_attention", # block gather/scatter + paged attention ops
     "test_chunked_prefill", # chunked ≡ monolithic prefill
     "test_subproc",         # watchdog attribution (bench/CI harness)

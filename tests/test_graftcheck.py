@@ -256,6 +256,19 @@ def test_repo_passes_graftcheck():
         assert ml.get(rel, 0) >= 1, (
             f"{rel}: no live MEMORY_LEDGER holding — its device "
             "allocations stopped registering with the byte ledger")
+    assert payload["tier_checks"] >= 10, (
+        "grafttier tier pass went vacuous — a new "
+        "undeclared-tier-movement / tier-ledger-gap / "
+        "tier-event-drift finding anywhere in the tree fails this "
+        "strict run (rule fixtures in tests/test_kv_tier.py)")
+    assert payload["tier_vacuous"] == [], (
+        "TIER_POLICY declarations with no live spill scope (the tier "
+        f"boundary went dark): {payload['tier_vacuous']}")
+    # the tier module's demote AND promote scopes both move blocks
+    assert payload["tier_policies"].get(
+        "llm_sharding_demo_tpu/runtime/kv_tier.py", 0) >= 2, (
+        "runtime/kv_tier.py: SPILL_SCOPES no longer resolves both "
+        "movement scopes against live demote/promote call sites")
     assert payload["placement_checks"] >= 10, (
         "graftshard placement pass went vacuous — a new placement-drift"
         " / undeclared-collective / replicated-large-buffer / "

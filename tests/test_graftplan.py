@@ -615,6 +615,8 @@ def test_verifier_json_schema_shape():
                             "numerics_vacuous",
                             "memory_checks", "memory_ledgers",
                             "memory_vacuous",
+                            "tier_checks", "tier_policies",
+                            "tier_vacuous",
                             "trend_checks", "trend_policies",
                             "trend_vacuous",
                             "placement_checks", "placement_contracts",
@@ -649,6 +651,9 @@ def test_verifier_json_schema_shape():
     assert isinstance(payload["memory_checks"], int)
     assert isinstance(payload["memory_ledgers"], dict)
     assert isinstance(payload["memory_vacuous"], list)
+    assert isinstance(payload["tier_checks"], int)
+    assert isinstance(payload["tier_policies"], dict)
+    assert isinstance(payload["tier_vacuous"], list)
     assert isinstance(payload["placement_checks"], int)
     assert isinstance(payload["placement_contracts"], dict)
     assert isinstance(payload["placement_vacuous"], list)

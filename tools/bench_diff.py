@@ -79,6 +79,13 @@ _HIGHER_BETTER = ("tokens_per_sec", "tokens_per_second", "speedup",
                   # resident rows per HBM byte
                   "before_first_preemption", "capacity_ratio",
                   "prefix_store_depth",
+                  # tiered_kv_depth row (grafttier): the ledger-measured
+                  # host/device depth ratio and the replayed-epoch
+                  # prefix/promoted hit rates all regress DOWNWARD —
+                  # less prefix state resident per device byte, or a
+                  # tier that stopped answering affinity hits (the
+                  # promote-stall side is the _ms suffix, lower-better)
+                  "depth_ratio", "prefix_hit_rate", "promoted_hit_rate",
                   # trend_detection row (grafttrend): the seeded burst
                   # is pinned, so a reducer that stops tripping on it
                   # went blind — detection regresses DOWNWARD

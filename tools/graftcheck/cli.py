@@ -40,7 +40,7 @@ from .core import load_baseline, split_findings, stale_audits
 # covers the semantic contract checks AND the recompile certifier —
 # they share the jax-tracing stage --lint-only gates off.
 PASS_IDS = ("lint", "sanitize", "locks", "faults", "scope", "slo",
-            "fleet", "watch", "timeline", "trend", "memory",
+            "fleet", "watch", "timeline", "trend", "memory", "tier",
             "numerics", "placement", "sem")
 
 # payload keys each pass owns, with the value a SKIPPED pass reports:
@@ -67,6 +67,8 @@ _PASS_DEFAULTS = {
               "trend_vacuous": []},
     "memory": {"memory_checks": 0, "memory_ledgers": {},
                "memory_vacuous": []},
+    "tier": {"tier_checks": 0, "tier_policies": {},
+             "tier_vacuous": []},
     "numerics": {"numerics_checks": 0, "numerics_contracts": {},
                  "numerics_vacuous": []},
     "placement": {"placement_checks": 0, "placement_contracts": {},
@@ -81,7 +83,7 @@ _PASS_DEFAULTS = {
 _VACUOUS_KEYS = ("locks_vacuous", "scope_vacuous", "fault_vacuous",
                  "slo_vacuous", "fleet_vacuous", "watch_vacuous",
                  "timeline_vacuous", "trend_vacuous",
-                 "numerics_vacuous", "memory_vacuous",
+                 "numerics_vacuous", "memory_vacuous", "tier_vacuous",
                  "placement_vacuous")
 
 
@@ -128,7 +130,8 @@ def run(root: str = None, lint_only: bool = False,
         sys.path.insert(0, root)
     try:
         from . import faults, fleet, lint, locks, memory, numerics, \
-            placement, sanitize, scope, slo, timeline, trend, watch
+            placement, sanitize, scope, slo, tier, timeline, trend, \
+            watch
 
         def _summary(runner, keymap, **kw):
             def thunk():
@@ -206,6 +209,10 @@ def run(root: str = None, lint_only: bool = False,
                 "memory_checks": "memory_checks",
                 "memory_ledgers": "memory_ledgers",
                 "memory_vacuous": "vacuous"}),
+            "tier": _summary(tier.run_tier, {
+                "tier_checks": "tier_checks",
+                "tier_policies": "tier_policies",
+                "tier_vacuous": "vacuous"}),
             # the numerics/placement jaxpr halves trace real entry
             # points — skipped under --lint-only (the AST halves still
             # run jax-free)
@@ -320,6 +327,9 @@ def run(root: str = None, lint_only: bool = False,
         "memory_checks": fragments["memory_checks"],
         "memory_ledgers": fragments["memory_ledgers"],
         "memory_vacuous": fragments["memory_vacuous"],
+        "tier_checks": fragments["tier_checks"],
+        "tier_policies": fragments["tier_policies"],
+        "tier_vacuous": fragments["tier_vacuous"],
         "numerics_checks": fragments["numerics_checks"],
         "numerics_contracts": fragments["numerics_contracts"],
         "numerics_vacuous": fragments["numerics_vacuous"],
@@ -568,6 +578,7 @@ def main(argv=None) -> int:
               f"{payload['timeline_checks']} timeline checks, "
               f"{payload['trend_checks']} trend checks, "
               f"{payload['memory_checks']} memory checks, "
+              f"{payload['tier_checks']} tier checks, "
               f"{payload['numerics_checks']} numerics checks, "
               f"{payload['placement_checks']} placement checks"
               + ("" if args.lint_only else

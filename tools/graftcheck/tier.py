@@ -1,0 +1,324 @@
+"""graftcheck tier pass: storage-tier movement discipline
+(compile-free).
+
+grafttier (``llm_sharding_demo_tpu/runtime/kv_tier.py``) moves KV
+blocks between storage tiers — device pool down to host RAM on cold
+pressure, host back to device on an affinity hit. Every movement is a
+custody transfer across THREE bookkeeping systems at once: the
+graftsan refcount tables, the graftmem byte ledger, and the grafttime
+causal stream. A movement site outside the declared boundary can be
+individually correct and still leave one of the three silently wrong
+— which is why the boundary is a declaration this pass can hold the
+tree to, not a convention.
+
+In-file declarations (the registration-annotation idiom of
+``POOL_MOVER_SCOPES`` / ``HANDOFF_SCOPES`` / ``MEMORY_LEDGER``):
+
+- ``TIER_POLICY``: ``{tier: {below, budget, eviction, holding,
+  component, demote_event, promote_event}}`` — the storage tiers a
+  module owns: what each sits below, the env knob bounding it, its
+  final-eviction policy, the attribute holding spilled bytes, the
+  graftmem component those bytes attribute to, and the grafttime
+  event kinds its movements emit. A nested dict literal on purpose —
+  statically readable, like ``FAULT_POLICY``.
+- ``SPILL_SCOPES``: tuple of function qualnames allowed to invoke
+  tier movement (``demote_lru`` / ``promote`` / ``spill_blocks`` /
+  ``fill_blocks`` on a tier/pool receiver). Declared per module, the
+  way ``HANDOFF_SCOPES`` enumerates the adoption boundary.
+
+Rules (ids in brackets; suppressions ride the shared baseline):
+
+- [undeclared-tier-movement] a tier-movement call in a runtime/
+                             module outside any declared SPILL_SCOPES
+                             scope (or in a module declaring none) —
+                             custody moved between tiers off the
+                             reviewed boundary; plus a declared scope
+                             invoking no movement (stale).
+- [tier-ledger-gap]          a malformed TIER_POLICY; a tier missing
+                             a required key; a declared component
+                             outside ``graftmem.MEMORY_COMPONENTS``;
+                             a tier whose ``holding`` is absent from
+                             the module's MEMORY_LEDGER or attributed
+                             to a different component there — host
+                             bytes the /debug/memory ledger cannot
+                             see or double-books.
+- [tier-event-drift]         a declared demote/promote event kind
+                             outside the grafttime ``EVENT_KINDS``
+                             vocabulary, or one with no
+                             ``grafttime.emit`` site inside the
+                             module's declared SPILL_SCOPES — tier
+                             movement invisible to the causal stream.
+
+``--strict`` additionally fails a VACUOUS pass (a module declaring
+TIER_POLICY none of whose spill scopes make a live movement call —
+the tier boundary went dark); ``cli.run --json`` carries
+``tier_checks`` / ``tier_policies`` / ``tier_vacuous``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import lint as L
+from .core import Finding
+from .locks import _dotted, _module_assign, _parents, _scope_of
+from .memory import _declared_dict
+
+TIER_RULE_IDS = ("undeclared-tier-movement", "tier-ledger-gap",
+                 "tier-event-drift")
+
+# movement calls are only meaningful where tiers live; serving/ wires
+# tiers up (attach_tier) but never moves blocks itself
+_RUNTIME_PREFIX = "llm_sharding_demo_tpu/runtime/"
+
+# the movement vocabulary: demote/promote are the tier's own verbs,
+# spill/fill are the pool's raw-plane halves they are built from
+_MOVEMENT_NAMES = ("demote_lru", "promote", "spill_blocks",
+                   "fill_blocks")
+
+# every TIER_POLICY tier must answer all of these (a tier with no
+# declared budget or eviction policy is an unbounded cache with extra
+# steps)
+_REQUIRED_KEYS = ("below", "budget", "eviction", "holding",
+                  "component", "demote_event", "promote_event")
+
+
+def _tierish(recv: Optional[str]) -> bool:
+    """Receiver filter: ``tier`` / ``self.tier`` / ``pool`` /
+    ``self._pool`` — movement verbs on unrelated receivers (a queue's
+    ``promote``) are not tier traffic."""
+    if not recv:
+        return False
+    last = recv.rpartition(".")[2].lstrip("_")
+    return "tier" in last or "pool" in last
+
+
+def _policy_dict(stmt: ast.Assign
+                 ) -> Optional[Dict[str, Tuple[Dict[str, str], int]]]:
+    """TIER_POLICY nested dict literal ->
+    {tier: ({key: value}, line)}; None when not statically readable
+    string->dict-of-strings."""
+    node = stmt.value
+    if not isinstance(node, ast.Dict):
+        return None
+    out: Dict[str, Tuple[Dict[str, str], int]] = {}
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(k, ast.Constant)
+                and isinstance(k.value, str)
+                and isinstance(v, ast.Dict)):
+            return None
+        entry = _declared_dict(ast.Assign(targets=[], value=v))
+        if entry is None:
+            return None
+        out[k.value] = ({key: val for key, val, _ in entry}, k.lineno)
+    return out
+
+
+def _movement_calls(mod: L.ModuleInfo,
+                    parents) -> List[Tuple[int, str, str]]:
+    """[(line, enclosing scope, verb)] for tier-movement calls on
+    tier/pool receivers."""
+    out: List[Tuple[int, str, str]] = []
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MOVEMENT_NAMES):
+            continue
+        if _tierish(_dotted(node.func.value)):
+            out.append((node.lineno, _scope_of(node, parents, mod),
+                        node.func.attr))
+    return out
+
+
+def _emit_sites(mod: L.ModuleInfo, parents) -> List[Tuple[int, str, str]]:
+    """[(line, enclosing scope, kind)] for ``grafttime.emit("<kind>",
+    ...)`` sites with a literal kind."""
+    out: List[Tuple[int, str, str]] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if d != "grafttime.emit":
+            continue
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            out.append((node.lineno, _scope_of(node, parents, mod),
+                        node.args[0].value))
+    return out
+
+
+def run_tier(root: str, paths: Optional[List[str]] = None,
+             components: Optional[Dict[str, str]] = None,
+             event_kinds: Optional[Dict[str, str]] = None,
+             ) -> Tuple[List[Finding], dict]:
+    """The whole static pass -> (findings, summary). ``summary``
+    carries ``tier_checks`` (declarations + movement/emit sites
+    examined — the vacuity guard on the pass itself),
+    ``tier_policies`` (per-module count of declared spill scopes with
+    a live movement call) and ``vacuous`` (modules whose TIER_POLICY
+    matches no live spill scope — the strict driver fails these).
+    ``components`` / ``event_kinds`` are injectable for rule fixtures;
+    by default the real ``graftmem.MEMORY_COMPONENTS`` /
+    ``grafttime.EVENT_KINDS``."""
+    if components is None:
+        from llm_sharding_demo_tpu.utils import graftmem as GM
+        components = GM.MEMORY_COMPONENTS
+    if event_kinds is None:
+        from llm_sharding_demo_tpu.utils import grafttime as GT
+        event_kinds = GT.EVENT_KINDS
+
+    findings: List[Finding] = []
+    checks = 0
+    policies_live: Dict[str, int] = {}
+    vacuous: List[str] = []
+
+    for path in (paths if paths is not None else L.iter_sources(root)):
+        mod = L.index_module(path, root)
+        if mod is None:
+            continue
+        in_runtime = mod.relpath.startswith(_RUNTIME_PREFIX)
+        policy_stmt = _module_assign(mod, "TIER_POLICY")
+        scopes_stmt = _module_assign(mod, "SPILL_SCOPES")
+        parents = _parents(mod.tree)
+        moves = _movement_calls(mod, parents) if in_runtime else []
+        if policy_stmt is None and scopes_stmt is None and not moves:
+            continue
+        checks += 1
+
+        declared_scopes: Optional[Set[str]] = None
+        scopes_line = 0
+        if scopes_stmt is not None:
+            scopes_line = scopes_stmt.lineno
+            declared_scopes = L._string_tuple(scopes_stmt.value)
+            if declared_scopes is None:
+                findings.append(Finding(
+                    "undeclared-tier-movement", mod.relpath,
+                    scopes_line, "<module>",
+                    "SPILL_SCOPES must be a tuple of string function "
+                    "qualnames (the tier pass reads it statically)"))
+                declared_scopes = set()
+
+        # -- movement calls vs the declared boundary ----------------------
+        live_scopes: Set[str] = set()
+        for line, scope, verb in moves:
+            checks += 1
+            if declared_scopes is None:
+                findings.append(Finding(
+                    "undeclared-tier-movement", mod.relpath, line,
+                    scope,
+                    f"tier-movement call ``{verb}`` in a module "
+                    "declaring no SPILL_SCOPES — custody crossed a "
+                    "storage tier off the reviewed boundary (declare "
+                    "the scope beside JIT_ENTRY_POINTS)"))
+            elif scope not in declared_scopes:
+                findings.append(Finding(
+                    "undeclared-tier-movement", mod.relpath, line,
+                    scope,
+                    f"tier-movement call ``{verb}`` in {scope!r}, "
+                    "which SPILL_SCOPES does not declare — demotion/"
+                    "promotion outside the declared tier boundary"))
+            else:
+                live_scopes.add(scope)
+        if declared_scopes is not None:
+            for scope in sorted(declared_scopes - live_scopes):
+                checks += 1
+                findings.append(Finding(
+                    "undeclared-tier-movement", mod.relpath,
+                    scopes_line, scope,
+                    f"SPILL_SCOPES declares {scope!r} but it invokes "
+                    "no tier movement (stale declaration)"))
+
+        # -- the policy's three-ledger cross-checks -----------------------
+        if policy_stmt is None:
+            continue
+        policy = _policy_dict(policy_stmt)
+        if policy is None:
+            findings.append(Finding(
+                "tier-ledger-gap", mod.relpath, policy_stmt.lineno,
+                "<module>",
+                "TIER_POLICY must be a dict literal of string tier -> "
+                "{string key: string value} (the tier pass reads it "
+                "statically)"))
+            continue
+
+        ledger_stmt = _module_assign(mod, "MEMORY_LEDGER")
+        ledger: Dict[str, str] = {}
+        if ledger_stmt is not None:
+            entries = _declared_dict(ledger_stmt)
+            if entries is not None:
+                ledger = {k: v for k, v, _ in entries}
+
+        emits = _emit_sites(mod, parents)
+        emitted_in_scope = {kind for _, scope, kind in emits
+                            if declared_scopes and scope
+                            in declared_scopes}
+        checks += len(emits)
+
+        for tier, (entry, line) in sorted(policy.items()):
+            checks += 1
+            missing = [k for k in _REQUIRED_KEYS if k not in entry]
+            if missing:
+                findings.append(Finding(
+                    "tier-ledger-gap", mod.relpath, line, "<module>",
+                    f"TIER_POLICY tier {tier!r} is missing required "
+                    f"key(s) {missing} — a tier without a declared "
+                    "budget/eviction/holding is an unbounded cache "
+                    "with extra steps"))
+                continue
+            if entry["component"] not in components:
+                findings.append(Finding(
+                    "tier-ledger-gap", mod.relpath, line, "<module>",
+                    f"TIER_POLICY tier {tier!r} attributes to "
+                    f"component {entry['component']!r}, outside the "
+                    f"graftmem vocabulary ({sorted(components)}) — a "
+                    "new residency class is a reviewed "
+                    "graftmem.MEMORY_COMPONENTS change"))
+            holding = entry["holding"]
+            if holding not in ledger:
+                findings.append(Finding(
+                    "tier-ledger-gap", mod.relpath, line, "<module>",
+                    f"TIER_POLICY tier {tier!r} spills into holding "
+                    f"{holding!r}, absent from this module's "
+                    "MEMORY_LEDGER — host bytes the /debug/memory "
+                    "ledger cannot attribute"))
+            elif ledger[holding] != entry["component"]:
+                findings.append(Finding(
+                    "tier-ledger-gap", mod.relpath, line, "<module>",
+                    f"TIER_POLICY tier {tier!r} attributes "
+                    f"{holding!r} to {entry['component']!r} but "
+                    f"MEMORY_LEDGER declares {ledger[holding]!r} — "
+                    "the tier and the byte ledger disagree about the "
+                    "same bytes"))
+            for ev_key in ("demote_event", "promote_event"):
+                checks += 1
+                kind = entry[ev_key]
+                if kind not in event_kinds:
+                    findings.append(Finding(
+                        "tier-event-drift", mod.relpath, line,
+                        "<module>",
+                        f"TIER_POLICY tier {tier!r} declares "
+                        f"{ev_key}={kind!r}, outside the grafttime "
+                        "EVENT_KINDS vocabulary — a movement event "
+                        "the causal stream cannot carry"))
+                elif kind not in emitted_in_scope:
+                    findings.append(Finding(
+                        "tier-event-drift", mod.relpath, line,
+                        "<module>",
+                        f"TIER_POLICY tier {tier!r} declares "
+                        f"{ev_key}={kind!r} but no grafttime.emit"
+                        f"({kind!r}, ...) site lives inside a "
+                        "declared SPILL_SCOPES scope — tier movement "
+                        "invisible to the timeline"))
+
+        policies_live[mod.relpath] = len(live_scopes)
+        if not live_scopes:
+            vacuous.append(mod.relpath)
+
+    summary = {
+        "tier_checks": checks,
+        "tier_policies": policies_live,
+        "vacuous": sorted(vacuous),
+    }
+    return (sorted(findings, key=lambda f: (f.path, f.line, f.rule)),
+            summary)
